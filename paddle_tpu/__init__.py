@@ -1,0 +1,88 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas.
+
+Public surface mirrors `paddle.*`: imperative Tensors with autograd,
+`nn`/`optimizer`/`amp`/`jit`/`io`/`distributed` subpackages, static capture
+via `jit.to_static` → XLA, and SPMD parallelism over `jax.sharding.Mesh`.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core.tensor import Tensor, Parameter  # noqa: F401
+from .core.dtype import (  # noqa: F401
+    bool_ as bool,  # noqa: A001
+    uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+    complex64, complex128, set_default_dtype, get_default_dtype, finfo, iinfo,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPlace, Place, set_device, get_device, device_count,
+    is_compiled_with_tpu,
+)
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .core.autograd import grad_fn as _grad_fn
+
+from . import ops  # noqa: F401  (binds Tensor methods)
+from .ops import *  # noqa: F401,F403
+
+# subpackages (populated progressively; import order matters: nn before optimizer)
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import autograd  # noqa: F401
+from . import metric  # noqa: F401
+from . import linalg  # noqa: F401
+from . import fft  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import utils  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import models  # noqa: F401
+from . import inference  # noqa: F401
+from . import profiler  # noqa: F401
+from . import incubate  # noqa: F401
+from . import distributed  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .nn.layer.layers import Layer  # noqa: F401  (paddle.nn.Layer also reachable)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad parity (python/paddle/fluid/dygraph/base.py grad)."""
+    gs = _grad_fn(outputs, inputs, grad_outputs, retain_graph, create_graph, allow_unused)
+    return [None if g is None else Tensor(g) for g in gs]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    from .ops.creation import to_tensor as _tt
+    return _tt(data, dtype, place, stop_gradient)
+
+
+def disable_static(place=None):
+    return None  # dynamic mode is the default and only eager mode
+
+
+def enable_static():
+    from . import static as _static
+    _static._STATIC_MODE[0] = True
+
+
+def in_dynamic_mode():
+    from . import static as _static
+    return not _static._STATIC_MODE[0]
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def device_guard(*a, **kw):  # static-graph relic; no-op on TPU
+    import contextlib
+    return contextlib.nullcontext()

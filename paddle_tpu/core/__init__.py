@@ -1,0 +1,2 @@
+from . import autograd, dtype, flags, place, random  # noqa: F401
+from .tensor import Tensor, Parameter  # noqa: F401

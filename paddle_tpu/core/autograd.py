@@ -1,0 +1,255 @@
+"""Imperative autograd: a reverse-mode tape over JAX VJPs.
+
+Reference parity: this is the TPU-native answer to the dygraph stack —
+`imperative/tracer.cc:172` (TraceOp records grad nodes) +
+`imperative/basic_engine.cc:391` (reverse-topological execute) +
+`imperative/gradient_accumulator.cc` (grad sums).
+
+TPU-first design: instead of per-op CUDA grad kernels selected by a grad-op
+registry, every traced op captures its VJP via `jax.vjp` at forward time.
+Forward runs eagerly on the XLA backend (each primitive is compile-cached by
+JAX); backward walks the tape in reverse creation order and feeds cotangents
+through the stored VJP closures. Gradients accumulate on leaf tensors'
+`.grad`, matching Paddle dygraph semantics (stop_gradient, leaf-only grads).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.enabled = True
+        self.tape: List["Node"] = []
+
+
+_STATE = _State()
+
+
+class Node:
+    """One traced op: inputs, outputs, and the VJP closure linking them."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "name")
+
+    def __init__(self, vjp_fn, inputs, outputs, name):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs      # list[Tensor] (diff inputs, positional)
+        self.outputs = outputs    # list[Tensor] (diff outputs, positional)
+        self.name = name
+
+
+def is_grad_enabled() -> bool:
+    return _STATE.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _STATE.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager & decorator: disable tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _STATE.enabled
+        _STATE.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = _STATE.enabled
+        _STATE.enabled = True
+        return self
+
+
+def apply_op(
+    fn: Callable,
+    diff_inputs: Sequence["Tensor"],  # noqa: F821
+    name: str = "op",
+    n_outs: int = 1,
+) -> Any:
+    """Run `fn(*arrays) -> array | tuple` over the diff inputs, recording a tape node
+    when grad is enabled and any input requires grad.
+
+    Returns raw jax output(s); wrapping into Tensor happens in the ops layer so
+    this module stays free of Tensor construction policy.
+    """
+    arrays = tuple(t._value for t in diff_inputs)
+    record = _STATE.enabled and any(not t.stop_gradient for t in diff_inputs)
+    # Inside a jax trace (to_static), inputs are tracers: let JAX do the
+    # differentiation; recording a tape of tracers would leak them.
+    if record and any(isinstance(a, jax.core.Tracer) for a in arrays):
+        record = False
+    if not record:
+        return fn(*arrays), None
+    outs, vjp_fn = jax.vjp(fn, *arrays)
+    return outs, vjp_fn
+
+
+def record_node(vjp_fn, diff_inputs, out_tensors, name):
+    node = Node(vjp_fn, list(diff_inputs), list(out_tensors), name)
+    for t in out_tensors:
+        t._node = node
+        t.stop_gradient = False
+    _STATE.tape.append(node)
+    return node
+
+
+def _accumulate(store: dict, tensor, value):
+    key = id(tensor)
+    cur = store.get(key)
+    store[key] = value if cur is None else cur + value
+
+
+def backward(root, grad=None, retain_graph: bool = False):
+    """Run the tape backward from `root` (paddle.Tensor.backward parity)."""
+    tape = _STATE.tape
+    if root._node is None:
+        if not root.stop_gradient:
+            g = jnp.ones_like(root._value) if grad is None else grad
+            root.grad = (root.grad + g) if root.grad is not None else +g
+        return
+
+    if grad is None:
+        if root._value.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad "
+                f"(shape {root._value.shape})"
+            )
+        grad = jnp.ones_like(root._value)
+    elif hasattr(grad, "_value"):
+        grad = grad._value
+
+    # 1. mark ancestor nodes of root (so unrelated graphs on the tape survive)
+    needed = set()
+    stack = [root._node]
+    while stack:
+        node = stack.pop()
+        if id(node) in needed:
+            continue
+        needed.add(id(node))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in needed:
+                stack.append(t._node)
+
+    cot: dict = {id(root): grad}
+    with no_grad():
+        for node in reversed(tape):
+            if id(node) not in needed:
+                continue
+            out_cots = []
+            any_live = False
+            for t in node.outputs:
+                c = cot.pop(id(t), None)
+                if c is None:
+                    c = jnp.zeros_like(t._value)
+                else:
+                    any_live = True
+                out_cots.append(c)
+            if not any_live:
+                continue
+            in_cots = node.vjp_fn(tuple(out_cots) if len(out_cots) > 1 else out_cots[0])
+            for t, c in zip(node.inputs, in_cots):
+                if t.stop_gradient:
+                    continue
+                if t._node is None:  # leaf: accumulate .grad
+                    gc = c.astype(t._value.dtype) if c.dtype != t._value.dtype else c
+                    for h in getattr(t, "_hooks", ()):
+                        r = h(gc)
+                        if r is not None:
+                            gc = r._value if hasattr(r, "_value") else r
+                    t.grad = gc if t.grad is None else t.grad + gc
+                else:
+                    _accumulate(cot, t, c)
+
+    if not retain_graph:
+        kept = [n for n in tape if id(n) not in needed]
+        _STATE.tape = kept
+        for n in tape:
+            if id(n) in needed:
+                for t in n.outputs:
+                    t._node = None
+                n.vjp_fn = None
+                n.inputs = n.outputs = ()
+
+
+def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+            allow_unused=False):
+    """paddle.grad parity (partial_grad_engine.cc): grads of outputs w.r.t. inputs
+    without touching .grad. Single-level (create_graph unsupported round 1)."""
+    if create_graph:
+        raise NotImplementedError("double grad: use paddle_tpu.autograd.functional (jax-based)")
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    tape = _STATE.tape
+
+    needed = set()
+    stack = [o._node for o in outs if o._node is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in needed:
+            continue
+        needed.add(id(node))
+        for t in node.inputs:
+            if t._node is not None:
+                stack.append(t._node)
+
+    cot: dict = {}
+    for i, o in enumerate(outs):
+        g = None
+        if grad_outputs is not None and grad_outputs[i] is not None:
+            g = getattr(grad_outputs[i], "_value", grad_outputs[i])
+        else:
+            g = jnp.ones_like(o._value)
+        _accumulate(cot, o, g)
+
+    target_ids = {id(t): i for i, t in enumerate(ins)}
+    results = [None] * len(ins)
+    with no_grad():
+        for node in reversed(tape):
+            if id(node) not in needed:
+                continue
+            out_cots, any_live = [], False
+            for t in node.outputs:
+                c = cot.get(id(t))
+                if c is None:
+                    c = jnp.zeros_like(t._value)
+                else:
+                    any_live = True
+                out_cots.append(c)
+            if not any_live:
+                continue
+            in_cots = node.vjp_fn(tuple(out_cots) if len(out_cots) > 1 else out_cots[0])
+            for t, c in zip(node.inputs, in_cots):
+                _accumulate(cot, t, c)
+
+    for i, t in enumerate(ins):
+        c = cot.get(id(t))
+        if c is None and not allow_unused:
+            raise RuntimeError(f"input {i} unused in graph (allow_unused=False)")
+        results[i] = c
+    return results
+
+
+def clear_tape():
+    _STATE.tape = []
+
+
+def tape_size() -> int:
+    return len(_STATE.tape)

@@ -1,0 +1,92 @@
+"""Data types for paddle_tpu.
+
+TPU-first notes: bfloat16 is the preferred low-precision dtype (MXU native);
+float64 is discouraged on TPU (emulated) but supported for CPU oracle tests.
+
+Reference parity: mirrors the dtype surface of PaddlePaddle's
+`phi/common/data_type.h` and `python/paddle/fluid/core` VarDesc dtypes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes  # ships with jax
+
+# Canonical dtype objects are numpy dtypes (jax uses them natively).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float": float32,
+    "double": float64,
+    "half": float16,
+    "int": int32,
+    "long": int64,
+}
+
+_DEFAULT_DTYPE = [np.dtype("float32")]
+
+
+def convert_dtype(dtype):
+    """Normalise any dtype spec (str, np.dtype, jnp dtype, paddle-style) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "").replace("fp", "float")
+        if key in _STR2DTYPE:
+            return np.dtype(_STR2DTYPE[key])
+        return np.dtype(key)
+    return np.dtype(dtype)
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype parity (fluid/framework.py)."""
+    d = convert_dtype(dtype)
+    if d not in (np.dtype("float16"), np.dtype(ml_dtypes.bfloat16), np.dtype("float32"), np.dtype("float64")):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {dtype}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer) or d == np.dtype("bool")
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))

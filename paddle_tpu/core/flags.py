@@ -1,0 +1,59 @@
+"""Global exported-flags registry.
+
+Reference parity: `paddle/fluid/platform/flags.cc:48` (PADDLE_DEFINE_EXPORTED_*)
++ `pybind/global_value_getter_setter.cc` + `paddle.set_flags/get_flags`.
+Flags may also be seeded from environment variables named FLAGS_<name>.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    env = os.environ.get(f"FLAGS_{name}")
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for k, v in flags.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag {k}")
+        _REGISTRY[key] = v
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k[6:] if k.startswith("FLAGS_") else k
+        out[f"FLAGS_{key}"] = _REGISTRY[key]
+    return out
+
+
+def flag(name: str) -> Any:
+    return _REGISTRY[name]
+
+
+# ---- core flags (names kept from the reference where they exist) ----
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (operator.cc:1171)")
+define_flag("use_standalone_executor", True, "new-executor opt-in (executor.py:1392)")
+define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (unused on TPU; XLA owns buffers)")
+define_flag("allocator_strategy", "auto_growth", "host allocator strategy name")
+define_flag("tpu_matmul_precision", "default", "default|high|highest - lax precision for matmul/conv")
+define_flag("tpu_eager_jit", True, "jit-cache eager primitive ops instead of op-by-op dispatch")
+define_flag("enable_unused_var_check", False, "unused-var detection parity flag")

@@ -1,0 +1,111 @@
+"""Device / Place abstraction.
+
+Reference parity: `paddle/fluid/platform/place.h:1` (CPUPlace/CUDAPlace/...)
+and `paddle.set_device` (`python/paddle/device/__init__.py`). On TPU the
+device identity maps to a `jax.Device`; multi-chip identity is expressed via
+`jax.sharding.Mesh` (see paddle_tpu.parallel), not per-op placement.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """Tagged device identity."""
+
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform == self._jax_platform()]
+        if not devs:
+            # fall back to whatever the default backend exposes (CI without TPU)
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def _jax_platform(self):
+        return {"cpu": "cpu", "tpu": "tpu", "gpu": "gpu"}.get(self.device_type, "cpu")
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CUDAPlace(Place):  # accepted for API compat; maps to gpu backend if present
+    device_type = "gpu"
+
+
+def _default_place() -> Place:
+    try:
+        plat = jax.default_backend()
+    except Exception:  # pragma: no cover
+        plat = "cpu"
+    if plat == "tpu":
+        return TPUPlace(0)
+    if plat == "gpu":
+        return CUDAPlace(0)
+    return CPUPlace(0)
+
+
+_CURRENT_PLACE = [None]
+
+
+def set_device(device) -> Place:
+    """paddle.set_device('tpu') / 'cpu' / 'tpu:0'."""
+    if isinstance(device, Place):
+        place = device
+    else:
+        spec = str(device).lower()
+        idx = 0
+        if ":" in spec:
+            spec, sidx = spec.split(":", 1)
+            idx = int(sidx)
+        if spec in ("tpu", "xla"):
+            place = TPUPlace(idx)
+        elif spec in ("gpu", "cuda"):
+            place = CUDAPlace(idx)
+        elif spec == "cpu":
+            place = CPUPlace(idx)
+        else:
+            raise ValueError(f"unknown device {device!r}")
+    _CURRENT_PLACE[0] = place
+    jax.config.update("jax_default_device", place.jax_device())
+    return place
+
+
+def get_device() -> str:
+    p = get_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def get_place() -> Place:
+    if _CURRENT_PLACE[0] is None:
+        _CURRENT_PLACE[0] = _default_place()
+    return _CURRENT_PLACE[0]
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,92 @@
+"""Seeded RNG management.
+
+Reference parity: `paddle/fluid/framework/generator.cc` / `phi/core/generator.h`
+(global + per-device Philox generators, `paddle.seed`). TPU-first design: a
+stateful key-splitting `Generator` over `jax.random` (threefry/rbg), so eager
+ops draw fresh keys while jitted programs take keys as explicit inputs.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Generator:
+    """Stateful wrapper over a jax PRNG key; `next_key()` splits off fresh keys."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+            self._count = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self, n: int = 1):
+        with self._lock:
+            self._key, *keys = jax.random.split(self._key, n + 1)
+            self._count += n
+        return keys[0] if n == 1 else keys
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        seed, count = state
+        self.manual_seed(seed)
+        if count:
+            self.next_key(count)
+
+
+_DEFAULT = Generator(0)
+
+# Trace-time key stack: when a jitted/static program is being traced,
+# `jit` pushes a traced key here so stateful eager RNG entry points
+# (dropout etc.) split from the *traced* key instead of baking a constant.
+_TRACE_KEYS = []
+
+
+def push_trace_key(key):
+    _TRACE_KEYS.append(key)
+
+
+def pop_trace_key():
+    return _TRACE_KEYS.pop()
+
+
+def in_trace() -> bool:
+    return bool(_TRACE_KEYS)
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed parity: reseed the global generator."""
+    _DEFAULT.manual_seed(s)
+    return _DEFAULT
+
+
+def default_generator() -> Generator:
+    return _DEFAULT
+
+
+def next_key(n: int = 1):
+    if _TRACE_KEYS:
+        import jax
+        k = _TRACE_KEYS[-1]
+        _TRACE_KEYS[-1], *keys = jax.random.split(k, n + 1)
+        return keys[0] if n == 1 else keys
+    return _DEFAULT.next_key(n)
+
+
+def get_rng_state():
+    return _DEFAULT.get_state()
+
+
+def set_rng_state(state):
+    _DEFAULT.set_state(state)

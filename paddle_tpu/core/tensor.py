@@ -1,0 +1,304 @@
+"""paddle_tpu.Tensor — imperative tensor over a `jax.Array`.
+
+Reference parity: the dygraph `VarBase`/`VariableWrapper`
+(`paddle/fluid/imperative/layer.h`) + python Tensor surface
+(`python/paddle/fluid/framework.py:1098` Variable and the monkey-patched
+varbase methods). TPU-first: the payload is a `jax.Array` living on the XLA
+backend; during `to_static` tracing the payload may be a JAX tracer — every
+op accepts either transparently.
+
+The full op method surface (``t.sum()``, ``t.reshape(...)`` …) is attached by
+``paddle_tpu.ops._bind_tensor_methods`` at package import, mirroring Paddle's
+``monkey_patch_varbase``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import convert_dtype, get_default_dtype
+from .place import get_place, CPUPlace
+
+_ops = None  # set by paddle_tpu.ops at import time (monkey_patch_varbase parity)
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "grad", "_node", "name", "persistable", "_hooks")
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self.name = name
+        self.persistable = False
+        self._hooks = []
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._value.devices())[0]
+            return get_place().__class__(getattr(dev, "id", 0)) if dev.platform != "cpu" else CPUPlace(0)
+        except Exception:
+            return get_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def numel(self) -> int:
+        return self.size
+
+    # ---- conversion ----
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *idx):
+        if idx:
+            return self.numpy().item(*idx)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        return _ops.cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return _ops.cast(self, dtype)
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient, name=self.name)
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def clone(self) -> "Tensor":
+        return _ops.assign(self)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def block_until_ready(self):
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def gradient(self):
+        return None if self.grad is None else np.asarray(
+            self.grad._value if isinstance(self.grad, Tensor) else self.grad)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def set_value(self, value):
+        """In-place payload replacement (keeps shape/dtype contract like Paddle)."""
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value.astype(self._value.dtype)
+        return self
+
+    def get_tensor(self):  # LoDTensor accessor parity
+        return self
+
+    # ---- operators (full surface bound by ops._bind_tensor_methods) ----
+    def __add__(self, o):
+        return _ops.add(self, o)
+
+    def __radd__(self, o):
+        return _ops.add(self, o)
+
+    def __sub__(self, o):
+        return _ops.subtract(self, o)
+
+    def __rsub__(self, o):
+        return _ops.subtract(o, self)
+
+    def __mul__(self, o):
+        return _ops.multiply(self, o)
+
+    def __rmul__(self, o):
+        return _ops.multiply(self, o)
+
+    def __truediv__(self, o):
+        return _ops.divide(self, o)
+
+    def __rtruediv__(self, o):
+        return _ops.divide(o, self)
+
+    def __floordiv__(self, o):
+        return _ops.floor_divide(self, o)
+
+    def __rfloordiv__(self, o):
+        return _ops.floor_divide(o, self)
+
+    def __mod__(self, o):
+        return _ops.remainder(self, o)
+
+    def __pow__(self, o):
+        return _ops.pow(self, o)
+
+    def __rpow__(self, o):
+        return _ops.pow(o, self)
+
+    def __matmul__(self, o):
+        return _ops.matmul(self, o)
+
+    def __rmatmul__(self, o):
+        return _ops.matmul(o, self)
+
+    def __neg__(self):
+        return _ops.scale(self, -1.0)
+
+    def __abs__(self):
+        return _ops.abs(self)
+
+    def __invert__(self):
+        return _ops.logical_not(self)
+
+    def __eq__(self, o):  # noqa: E721  (tensor semantics, like Paddle)
+        return _ops.equal(self, o)
+
+    def __ne__(self, o):
+        return _ops.not_equal(self, o)
+
+    def __lt__(self, o):
+        return _ops.less_than(self, o)
+
+    def __le__(self, o):
+        return _ops.less_equal(self, o)
+
+    def __gt__(self, o):
+        return _ops.greater_than(self, o)
+
+    def __ge__(self, o):
+        return _ops.greater_equal(self, o)
+
+    def __hash__(self):
+        return id(self)
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __getitem__(self, idx):
+        return _ops.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        return _ops.setitem_(self, idx, value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            body = np.array2string(self.numpy(), precision=4, separator=", ")
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={get_place()}, stop_gradient={sg},\n       {body})")
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+class Parameter(Tensor):
+    """Trainable tensor (stop_gradient=False, persistable). Parity:
+    `python/paddle/fluid/framework.py` Parameter / ParamBase."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+def _maybe_wrap(x, stop_gradient=True):
+    return x if isinstance(x, Tensor) else Tensor(x, stop_gradient=stop_gradient)
+
+
+# jax pytree registration so Tensors can cross jit boundaries transparently
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), (t.stop_gradient, t.name)),
+    lambda aux, ch: Tensor(ch[0], stop_gradient=aux[0], name=aux[1]),
+)
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._value,), (t.name, t.trainable)),
+    lambda aux, ch: Parameter(ch[0], name=aux[0], trainable=aux[1]),
+)

@@ -1,0 +1,8 @@
+# placeholder; real hapi.Model lands with the training API milestone
+class Model:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("hapi.Model arrives after nn/optimizer")
+
+
+def summary(net, input_size=None, dtypes=None):
+    raise NotImplementedError

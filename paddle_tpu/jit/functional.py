@@ -1,0 +1,67 @@
+"""Functionalisation of Layers: run a Layer's forward with its parameters и
+buffers temporarily bound to arbitrary arrays (jax tracers included).
+
+This is the TPU-native replacement for the reference's dygraph-to-static
+ProgramDescTracer (`imperative/jit/program_desc_tracer.cc`): instead of
+re-recording ops into a ProgramDesc, we let JAX trace the same Python
+forward with tracer-backed parameters.
+"""
+from __future__ import annotations
+
+import collections
+from contextlib import contextmanager
+from typing import Dict, List, Tuple
+
+from ..core.tensor import Parameter, Tensor
+
+
+def split_state(layer) -> Tuple[Dict[str, Parameter], Dict[str, Tensor]]:
+    """(trainable params, buffers+frozen params) by state_dict name."""
+    trainable = collections.OrderedDict()
+    frozen = collections.OrderedDict()
+    for name, t in layer.state_dict().items():
+        if isinstance(t, Parameter) and not t.stop_gradient:
+            trainable[name] = t
+        else:
+            frozen[name] = t
+    return trainable, frozen
+
+
+@contextmanager
+def bind_arrays(tensors: List[Tensor], arrays):
+    """Temporarily swap each tensor's payload with the given arrays."""
+    saved = [t._value for t in tensors]
+    saved_nodes = [t._node for t in tensors]
+    try:
+        for t, a in zip(tensors, arrays):
+            t._value = a
+            t._node = None
+        yield
+    finally:
+        for t, v, n in zip(tensors, saved, saved_nodes):
+            t._value = v
+            t._node = n
+
+
+def functional_call(layer, param_names, param_arrays, buffer_names, buffer_arrays,
+                    *args, **kwargs):
+    """Run layer(*args) with named state bound to the provided arrays.
+
+    args/kwargs may contain raw arrays (wrapped into Tensors) or Tensors.
+    Returns raw array pytree (Tensor payloads unwrapped).
+    """
+    state = layer.state_dict()
+    ptensors = [state[n] for n in param_names]
+    btensors = [state[n] for n in buffer_names]
+
+    def wrap(x):
+        return Tensor(x) if not isinstance(x, Tensor) else x
+
+    import jax
+    wrapped_args = jax.tree_util.tree_map(
+        wrap, list(args), is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+    with bind_arrays(ptensors + btensors, list(param_arrays) + list(buffer_arrays)):
+        out = layer(*wrapped_args, **kwargs)
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda x: isinstance(x, Tensor))

@@ -1,0 +1,134 @@
+"""@to_static: capture a Layer/function into ONE compiled XLA program.
+
+Reference parity: `python/paddle/fluid/dygraph/jit.py:163` (declarative) +
+`dygraph_to_static/program_translator.py:775`. The reference rewrites Python
+AST into ProgramDesc ops; on TPU we let JAX trace the same Python (data-
+dependent control flow must use paddle_tpu.static.nn.cond/while_loop, the
+lax.cond/while analogue — same restriction the reference's AST transforms
+lift, here made explicit).
+
+Differentiability: the whole compiled program is recorded as ONE tape node
+(vjp through `jax.jit`), so `loss.backward()` works across the static
+boundary exactly like `run_program_op`'s grad in the reference
+(`operators/run_program_op.cc`).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..core import random as rnd
+from ..core.tensor import Tensor
+from ..ops._dispatch import run_op
+from .functional import functional_call, split_state
+from .input_spec import InputSpec  # noqa: F401  (re-export)
+
+
+class StaticFunction:
+    def __init__(self, function, layer=None, input_spec=None):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jit_cache = {}
+        try:
+            functools.update_wrapper(self, function)
+        except Exception:
+            pass
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def _get_jitted(self, training, pnames, bnames, static_kwargs):
+        key = (training, tuple(pnames), tuple(bnames),
+               tuple(sorted(static_kwargs.items())))
+        jitted = self._jit_cache.get(key)
+        if jitted is None:
+            layer, func = self._layer, self._function
+            kw = dict(static_kwargs)
+
+            def pure(param_arrays, buffer_arrays, rng_key, input_arrays):
+                rnd.push_trace_key(rng_key)
+                swapped = layer is not None and isinstance(
+                    layer.__dict__.get("forward"), StaticFunction)
+                if swapped:  # un-hook ourselves so tracing hits the original forward
+                    saved_fwd = layer.__dict__["forward"]
+                    layer.__dict__["forward"] = func
+                try:
+                    if layer is not None:
+                        return functional_call(layer, pnames, param_arrays, bnames,
+                                               buffer_arrays, *input_arrays, **kw)
+                    wrapped = [Tensor(a) for a in input_arrays]
+                    out = func(*wrapped, **kw)
+                    return jax.tree_util.tree_map(
+                        lambda t: t._value if isinstance(t, Tensor) else t, out,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                finally:
+                    rnd.pop_trace_key()
+                    if swapped:
+                        layer.__dict__["forward"] = saved_fwd
+
+            jitted = jax.jit(pure)
+            self._jit_cache[key] = jitted
+        return jitted
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        input_tensors = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+        if any(isinstance(v, Tensor) for v in kwargs.values()):
+            raise ValueError("to_static: pass Tensor arguments positionally")
+        try:
+            hash(tuple(sorted(kwargs.items())))
+            static_kwargs = kwargs
+        except TypeError:
+            raise ValueError("to_static kwargs must be hashable (static) values")
+
+        if layer is not None:
+            trainable, frozen = split_state(layer)
+            pnames, bnames = list(trainable), list(frozen)
+            ptensors = [trainable[n] for n in pnames]
+            barrs = [frozen[n]._value for n in bnames]
+            training = layer.training
+        else:
+            pnames, bnames, ptensors, barrs = [], [], [], []
+            training = True
+
+        jitted = self._get_jitted(training, pnames, bnames, static_kwargs)
+        key = rnd.default_generator().next_key()
+        n_p = len(ptensors)
+        diff_inputs = ptensors + input_tensors
+
+        def fn(*arrays):
+            return jitted(list(arrays[:n_p]), barrs, key, list(arrays[n_p:]))
+
+        return run_op(fn, diff_inputs, "static_program")
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """Decorator/wrapper. Accepts a Layer, a Layer's bound forward, or a pure
+    function of Tensors."""
+
+    def decorate(obj):
+        from ..nn.layer.layers import Layer
+        if isinstance(obj, Layer):
+            static = StaticFunction(obj.forward, layer=obj, input_spec=input_spec)
+            obj.forward = static
+            return obj
+        if hasattr(obj, "__self__") and isinstance(obj.__self__, Layer):
+            return StaticFunction(obj.__func__.__get__(obj.__self__),
+                                  layer=obj.__self__, input_spec=input_spec)
+        return StaticFunction(obj, layer=None, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

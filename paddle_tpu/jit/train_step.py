@@ -1,0 +1,98 @@
+"""Jitted whole-train-step builder — the TPU performance path.
+
+Reference parity: this plays the role of the reference's static-graph
+training program (forward + append_backward + optimizer ops compiled as one
+ProgramDesc, SURVEY §3.1): ONE XLA executable for forward+backward+update,
+with buffer donation on parameters and optimizer state (the XLA answer to
+fluid's in-place Variable updates).
+
+Usage:
+    step = TrainStep(model, loss_fn, optimizer)
+    loss = step(x, y)        # tensors in, python float-able loss out
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as rnd
+from ..core.tensor import Tensor
+from .functional import functional_call, split_state
+
+
+class TrainStep:
+    def __init__(self, model, loss_fn: Callable, optimizer, amp_dtype=None,
+                 donate: bool = True, mesh=None, in_shardings=None,
+                 n_model_inputs: Optional[int] = None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.amp_dtype = amp_dtype
+        self._jitted = None
+        self._donate = donate
+        self._slots = None
+        self._pnames = None
+        self._bnames = None
+        # step(x..., y...): first n go to model.forward, the rest to loss_fn
+        self._n_model_inputs = n_model_inputs
+
+    def _build(self):
+        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+        trainable, frozen = split_state(model)
+        self._pnames, self._bnames = list(trainable), list(frozen)
+        ptensors = [trainable[n] for n in self._pnames]
+        optimizer._parameter_list = optimizer._parameter_list or ptensors
+        self._slots = optimizer.init_state(ptensors)
+        pnames, bnames = self._pnames, self._bnames
+        amp_dtype = self.amp_dtype
+
+        def pure(params, slots, buffers, rng_key, lr, t, inputs, labels):
+            rnd.push_trace_key(rng_key)
+            try:
+                def fwd(ps):
+                    if amp_dtype is not None:
+                        ps = [p.astype(amp_dtype)
+                              if jnp.issubdtype(p.dtype, jnp.floating) else p
+                              for p in ps]
+                    out = functional_call(model, pnames, ps, bnames, buffers, *inputs)
+                    outs = [Tensor(o) for o in out] if isinstance(out, (list, tuple)) \
+                        else [Tensor(out)]
+                    loss = loss_fn(*outs, *[Tensor(l) for l in labels])
+                    return loss._value if isinstance(loss, Tensor) else loss
+
+                loss, grads = jax.value_and_grad(fwd)(run_params)
+                if amp_dtype is not None:
+                    grads = [g.astype(p.dtype) for g, p in zip(grads, params)]
+                new_params, new_slots = optimizer.functional_update(params, grads, slots, lr, t)
+                return new_params, new_slots, loss
+            finally:
+                rnd.pop_trace_key()
+
+        donate = (0, 1) if self._donate else ()
+        self._jitted = jax.jit(pure, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        """batch: input tensors consumed by model.forward; loss_fn receives the
+        model output(s) — close labels into loss_fn or pass them as model inputs.
+        """
+        if self._jitted is None:
+            self._build()
+        trainable, frozen = split_state(self.model)
+        params = [trainable[n]._value for n in self._pnames]
+        buffers = [frozen[n]._value for n in self._bnames]
+        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        n_mi = self._n_model_inputs
+        if n_mi is None:
+            n_mi = len(arrs) if len(arrs) <= 1 else len(arrs) - 1
+        inputs, labels = arrs[:n_mi], arrs[n_mi:]
+        key = rnd.default_generator().next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        t = jnp.asarray(self.optimizer._step_count + 1, jnp.float32)
+        new_params, self._slots, loss = self._jitted(params, self._slots, buffers, key,
+                                                     lr, t, inputs, labels)
+        for n, v in zip(self._pnames, new_params):
+            trainable[n]._value = v
+        self.optimizer._step_count += 1
+        return Tensor(loss)

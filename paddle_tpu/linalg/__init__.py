@@ -1,0 +1,8 @@
+"""paddle.linalg namespace parity — re-exports the linalg op surface."""
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.linalg import (  # noqa: F401
+    norm, vector_norm, dist, cond, inv, pinv, det, slogdet, cholesky,
+    cholesky_solve, solve, triangular_solve, lstsq, qr, svd, eig, eigh,
+    eigvals, eigvalsh, matrix_rank, matrix_power, multi_dot, cross, corrcoef, cov,
+)
+from ..ops.math import matmul, t  # noqa: F401

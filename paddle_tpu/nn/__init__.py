@@ -1,0 +1,8 @@
+"""paddle.nn parity namespace."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer.layers import Layer  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+from . import utils  # noqa: F401

@@ -1,0 +1,77 @@
+"""Gradient clipping.
+
+Reference parity: `python/paddle/fluid/clip.py` (ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Operates on (param, grad) pairs like
+the reference; grads here are raw jax arrays stored on `param.grad`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        return [(p, jnp.clip(g, self.min, self.max) if g is not None else None)
+                for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, None))
+                continue
+            n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, (g * factor.astype(g.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip(self, params_grads):
+        sq = [jnp.sum(g.astype(jnp.float32) ** 2) for p, g in params_grads
+              if g is not None and getattr(p, "need_clip", True)]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        factor = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, g * factor.astype(g.dtype)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility over .grad (paddle.nn.utils.clip_grad_norm_)."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return 0.0
+    if norm_type == float("inf"):
+        total = max(jnp.max(jnp.abs(p.grad)) for p in params)
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.abs(p.grad.astype(jnp.float32)) ** norm_type)
+                              for p in params), 1.0 / norm_type)
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p.grad = p.grad * factor.astype(p.grad.dtype)
+    return total

@@ -1,0 +1,139 @@
+"""Activation functionals.
+
+Reference parity: `python/paddle/nn/functional/activation.py`. All lower to
+single fused XLA elementwise graphs (fused into neighbouring matmuls on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import ensure_tensor, run_op, unary_op
+
+relu = unary_op(jax.nn.relu, "relu")
+relu6 = unary_op(jax.nn.relu6, "relu6")
+sigmoid = unary_op(jax.nn.sigmoid, "sigmoid")
+tanh = unary_op(jnp.tanh, "tanh")
+silu = unary_op(jax.nn.silu, "silu")
+swish = silu
+mish = unary_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), "mish")
+hardswish = unary_op(jax.nn.hard_swish, "hardswish")
+hardsigmoid = unary_op(lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), "hardsigmoid")
+tanhshrink = unary_op(lambda a: a - jnp.tanh(a), "tanhshrink")
+softsign = unary_op(jax.nn.soft_sign, "softsign")
+log_sigmoid = unary_op(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def gelu(x, approximate=False, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jax.nn.gelu(a, approximate=approximate), [x], "gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jax.nn.leaky_relu(a, negative_slope), [x], "leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op(lambda a: jax.nn.elu(a, alpha), [ensure_tensor(x)], "elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return run_op(lambda a: jax.nn.celu(a, alpha), [ensure_tensor(x)], "celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return run_op(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                  [ensure_tensor(x)], "selu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return run_op(lambda a: jnp.clip(a, min, max), [ensure_tensor(x)], "hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+                  [ensure_tensor(x)], "hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        [ensure_tensor(x)], "softshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return run_op(
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta),
+        [ensure_tensor(x)], "softplus")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def f(a, w):
+        if w.size > 1:
+            ax = 1 if data_format.upper().startswith("NC") else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ax] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, a * w)
+
+    return run_op(f, [x, weight], "prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...core import random as rnd
+    x = ensure_tensor(x)
+    if training:
+        k = rnd.next_key()
+        slope = jax.random.uniform(k, tuple(x.shape), dtype=jnp.float32,
+                                   minval=lower, maxval=upper)
+        return run_op(lambda a: jnp.where(a >= 0, a, a * slope.astype(a.dtype)), [x], "rrelu")
+    mid = (lower + upper) / 2.0
+    return run_op(lambda a: jnp.where(a >= 0, a, a * mid), [x], "rrelu")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jax.nn.softmax(a, axis=axis), [x], "softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jax.nn.log_softmax(a, axis=axis), [x], "log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as rnd
+    x = ensure_tensor(x)
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(rnd.next_key(), tuple(x.shape), minval=1e-20, maxval=1.0)))
+
+    def f(a):
+        y = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
+        if hard:
+            y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), a.shape[axis], axis=axis,
+                                    dtype=a.dtype)
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return run_op(f, [x], "gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        shp = list(a.shape)
+        c = shp[axis]
+        shp[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shp), axis=axis + 1)
+
+    return run_op(f, [x], "maxout")
+
+
+def glu(x, axis=-1, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jax.nn.glu(a, axis=axis), [x], "glu")

@@ -1,0 +1,69 @@
+"""Attention functionals.
+
+Reference parity: the reference's `fused_attention_op.cu` /
+`operators/fused/fmha_ref.h` (unfused-softmax FMHA). TPU-first: a single
+jitted softmax(QK^T)V graph that XLA fuses; on TPU hardware the Pallas
+flash-attention kernel (paddle_tpu.kernels.flash_attention) is used for
+long sequences.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import ensure_tensor, run_op
+from ...ops.math import _precision
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """query/key/value: [batch, seqlen, num_heads, head_dim] (paddle layout).
+
+    Uses the Pallas flash-attention kernel on TPU for seq_len >= 1024 with no
+    custom mask; otherwise the fused XLA reference path.
+    """
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    mask_arr = ensure_tensor(attn_mask)._value if attn_mask is not None else None
+
+    seq_len = q.shape[1]
+    head_dim = q.shape[-1]
+    use_flash = False
+    if mask_arr is None and dropout_p == 0.0 and seq_len >= 1024 and head_dim in (64, 128, 256):
+        try:
+            import jax as _j
+            use_flash = any(d.platform == "tpu" for d in _j.devices())
+        except Exception:
+            use_flash = False
+    if use_flash:
+        from ...kernels.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=is_causal)
+
+    scale = 1.0 / math.sqrt(head_dim)
+
+    def f(qa, ka, va):
+        # [B,S,H,D] -> [B,H,S,D]
+        qa = jnp.swapaxes(qa, 1, 2)
+        ka = jnp.swapaxes(ka, 1, 2)
+        va = jnp.swapaxes(va, 1, 2)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qa, ka, precision=_precision()) * scale
+        if is_causal:
+            s, t = logits.shape[-2], logits.shape[-1]
+            cmask = jnp.tril(jnp.ones((s, t), dtype=bool))
+            logits = jnp.where(cmask, logits, jnp.asarray(-1e9, logits.dtype))
+        if mask_arr is not None:
+            m = mask_arr
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, jnp.asarray(-1e9, logits.dtype))
+            else:
+                logits = logits + m.astype(logits.dtype)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if dropout_p > 0.0 and training:
+            from ...core import random as rnd
+            keep = jax.random.bernoulli(rnd.next_key(), 1.0 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, va, precision=_precision())
+        return jnp.swapaxes(out, 1, 2)
+
+    return run_op(f, [q, k, v], "scaled_dot_product_attention")

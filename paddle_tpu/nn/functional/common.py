@@ -1,0 +1,240 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, …
+
+Reference parity: `python/paddle/nn/functional/common.py` + `input.py`.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import random as rnd
+from ...core.tensor import Tensor
+from ...ops._dispatch import ensure_tensor, run_op
+from ...ops.math import _precision
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (paddle convention)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        return run_op(
+            lambda a, w, b: jnp.matmul(a, w, precision=_precision()) + b,
+            [x, weight, bias], "linear")
+    return run_op(lambda a, w: jnp.matmul(a, w, precision=_precision()),
+                  [x, weight], "linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return run_op(lambda a: a * (1.0 - p), [x], "dropout_infer")
+        return x
+    key = rnd.next_key()
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        out = jnp.where(keep, a, jnp.zeros((), a.dtype))
+        if mode == "upscale_in_train":
+            out = out / jnp.asarray(1.0 - p, a.dtype)
+        return out
+
+    return run_op(f, [x], "dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = rnd.next_key()
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(a.shape))
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) + coef_b
+
+    return run_op(f, [x], "alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup rows of weight [vocab, dim] by integer ids."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    ids = x._value.astype(jnp.int32)
+
+    def f(w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+
+    return run_op(f, [weight], "embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.nn.one_hot(x._value.astype(jnp.int32), num_classes, dtype=jnp.float32))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+    k = label.shape[-1]
+
+    def f(a):
+        if prior_dist is not None:
+            pd = ensure_tensor(prior_dist)._value
+            return (1 - epsilon) * a + epsilon * pd
+        return (1 - epsilon) * a + epsilon / k
+
+    return run_op(f, [label], "label_smooth")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    cf = data_format.upper().startswith("NC")
+    spatial = x.shape[2:] if cf else x.shape[1:-1]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_spatial = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            [scale_factor] * len(spatial)
+        out_spatial = [int(s * f) for s, f in zip(spatial, sf)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(a):
+        if cf:
+            full = list(a.shape[:2]) + out_spatial
+        else:
+            full = [a.shape[0]] + out_spatial + [a.shape[-1]]
+        return jax.image.resize(a, full, method=jmode)
+
+    return run_op(f, [x], "interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return run_op(f, [x], "pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return run_op(f, [x], "pixel_unshuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col: [N,C,H,W] -> [N, C*kh*kw, L]."""
+    x = ensure_tensor(x)
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]
+        pl = pr = paddings[1]
+    else:
+        pt, pl, pb, pr = paddings
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+        oh = (a.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (a.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, oh * ow)
+
+    return run_op(f, [x], "unfold")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+
+    return run_op(f, [x1, x2], "cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    ins = [x1, x2, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+    return run_op(f, ins, "bilinear")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return run_op(f, [x], "normalize")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, pad, mode, value, data_format)

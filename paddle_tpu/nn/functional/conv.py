@@ -1,0 +1,161 @@
+"""Convolution functionals over `lax.conv_general_dilated`.
+
+Reference parity: `python/paddle/nn/functional/conv.py` (conv1d/2d/3d,
+conv*_transpose) with paddle's NCHW default + OIHW weights. TPU-first: we
+pass explicit dimension numbers and let XLA pick the internal layout; the
+MXU sees one fused conv per call (vs cuDNN algo selection in the reference).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import ensure_tensor, run_op
+from ...ops.math import _precision
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding_arg(padding, n, strides=None):
+    """paddle padding: int, list[int], list[pair], 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _dims(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    channel_last = data_format.upper().endswith("C") and not data_format.upper().startswith("NC")
+    strides = _tuplize(stride, nd)
+    dilations = _tuplize(dilation, nd)
+    pad = _padding_arg(padding, nd)
+    dn = _dims(nd, channel_last)
+
+    def f(a, w, *rest):
+        if channel_last:
+            # weights stay OIHW (paddle layout); lax wants HWIO for NHWC
+            perm = list(range(2, 2 + nd)) + [1, 0]
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            a, w, strides, pad, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups,
+            precision=_precision())
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[out.ndim - 1 if channel_last else 1] = b.size
+            out = out + b.reshape(shape)
+        return out
+
+    ins = [x, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+    return run_op(f, ins, f"conv{nd}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, nd, data_format, output_size=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    channel_last = data_format.upper().endswith("C") and not data_format.upper().startswith("NC")
+    strides = _tuplize(stride, nd)
+    dilations = _tuplize(dilation, nd)
+    opad = _tuplize(output_padding, nd) if output_padding is not None else (0,) * nd
+    dn = _dims(nd, channel_last)
+
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        pads = _padding_arg(padding, nd)
+
+    def f(a, w, *rest):
+        # paddle transpose-conv weight layout: [in, out/groups, *k] (IOHW)
+        k = w.shape[2:]
+        if isinstance(pads, str):
+            lax_pad = pads
+        else:
+            # grad-of-conv padding: (k-1)*d - p  on each side, + output_padding on high side
+            lax_pad = [((k[i] - 1) * dilations[i] - pads[i][0],
+                        (k[i] - 1) * dilations[i] - pads[i][1] + opad[i])
+                       for i in range(nd)]
+        if groups > 1:
+            ws = jnp.split(w, groups, axis=0)
+            xs = jnp.split(a, groups, axis=-1 if channel_last else 1)
+            outs = []
+            for wi, xi in zip(ws, xs):
+                outs.append(_one(xi, wi, lax_pad))
+            return jnp.concatenate(outs, axis=-1 if channel_last else 1) if not rest else \
+                _add_bias(jnp.concatenate(outs, axis=-1 if channel_last else 1), rest[0])
+        out = _one(a, w, lax_pad)
+        if rest:
+            out = _add_bias(out, rest[0])
+        return out
+
+    def _one(a, w, lax_pad):
+        # flip spatial dims and swap I/O to express transpose conv as dilated conv
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        wf = jnp.swapaxes(wf, 0, 1)  # [out, in, *k] -> OIHW
+        if channel_last:
+            perm = list(range(2, 2 + nd)) + [1, 0]
+            wf = jnp.transpose(wf, perm)
+        return jax.lax.conv_general_dilated(
+            a, wf, (1,) * nd, lax_pad, lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn, precision=_precision())
+
+    def _add_bias(out, b):
+        shape = [1] * out.ndim
+        shape[out.ndim - 1 if channel_last else 1] = b.size
+        return out + b.reshape(shape)
+
+    ins = [x, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+    return run_op(f, ins, f"conv{nd}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format, output_size)

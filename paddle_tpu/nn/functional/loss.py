@@ -1,0 +1,232 @@
+"""Loss functionals.
+
+Reference parity: `python/paddle/nn/functional/loss.py` (cross_entropy with
+soft/hard labels + ignore_index, mse, l1, nll, bce, kl_div, smooth_l1,
+margin losses, ctc excluded this round).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._dispatch import ensure_tensor, run_op
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    lv = label._value
+
+    def f(logits, *rest):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30))
+        n_class = logits.shape[axis]
+        if soft_label:
+            tgt = lv.astype(logp.dtype)
+            if label_smoothing > 0:
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / n_class
+            per = -jnp.sum(tgt * logp, axis=axis)
+            if rest:
+                w = rest[0]
+                cls_w = jnp.sum(tgt * w.reshape((1,) * (logp.ndim - 1) + (-1,)), axis=axis)
+                per = per * cls_w
+            return _reduce(per, reduction)
+        ids = lv.astype(jnp.int32)
+        squeeze = False
+        if ids.ndim == logp.ndim:  # [N,1] style labels
+            ids = jnp.squeeze(ids, axis=axis)
+            squeeze = True
+        safe = jnp.where(ids == ignore_index, 0, ids)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+        per = -jnp.squeeze(picked, axis)
+        if label_smoothing > 0:
+            smooth = -jnp.mean(logp, axis=axis)
+            per = (1 - label_smoothing) * per + label_smoothing * smooth
+        mask = (ids != ignore_index)
+        if rest:
+            w = rest[0]
+            per = per * jnp.take(w, safe)
+        per = jnp.where(mask, per, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(mask.astype(per.dtype)), 1.0)
+            if rest:
+                denom = jnp.maximum(
+                    jnp.sum(jnp.where(mask, jnp.take(rest[0], safe), 0.0)), 1e-12)
+            return jnp.sum(per) / denom
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    ins = [input] + ([ensure_tensor(weight)] if weight is not None else [])
+    return run_op(f, ins, "cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return run_op(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                  [ensure_tensor(input), ensure_tensor(label)], "mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return run_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                  [ensure_tensor(input), ensure_tensor(label)], "l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        v = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(v, reduction)
+
+    return run_op(f, [ensure_tensor(input), ensure_tensor(label)], "smooth_l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    ids = label._value.astype(jnp.int32)
+
+    def f(logp, *rest):
+        safe = jnp.where(ids == ignore_index, 0, ids)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        per = -jnp.squeeze(picked, 1)
+        mask = ids != ignore_index
+        if rest:
+            per = per * jnp.take(rest[0], safe)
+        per = jnp.where(mask, per, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.take(rest[0], safe) * mask) if rest else \
+                jnp.maximum(jnp.sum(mask.astype(per.dtype)), 1.0)
+            return jnp.sum(per) / denom
+        return _reduce(per, reduction) if reduction != "mean" else per
+
+    ins = [input] + ([ensure_tensor(weight)] if weight is not None else [])
+    return run_op(f, ins, "nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *rest):
+        eps = 1e-12
+        v = -(y * jnp.log(jnp.maximum(p, eps)) + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if rest:
+            v = v * rest[0]
+        return _reduce(v, reduction)
+
+    ins = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        ins.append(ensure_tensor(weight))
+    return run_op(f, ins, "bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    pw = ensure_tensor(pos_weight)._value if pos_weight is not None else None
+
+    def f(z, y, *rest):
+        # numerically-stable BCE-with-logits
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            logsig = -jax.nn.softplus(-z)
+            log1msig = -jax.nn.softplus(z)
+            base = -(pw * y * logsig + (1 - y) * log1msig)
+        if rest:
+            base = base * rest[0]
+        return _reduce(base, reduction)
+
+    ins = [ensure_tensor(logit), ensure_tensor(label)]
+    if weight is not None:
+        ins.append(ensure_tensor(weight))
+    return run_op(f, ins, "bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, tgt):
+        v = tgt * (jnp.log(jnp.maximum(tgt, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(v) / logp.shape[0]
+        return _reduce(v, reduction)
+
+    return run_op(f, [ensure_tensor(input), ensure_tensor(label)], "kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return run_op(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        [ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)],
+        "margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return run_op(
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        [ensure_tensor(input), ensure_tensor(label)], "hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        v = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(v, reduction)
+
+    return run_op(f, [ensure_tensor(input1), ensure_tensor(input2), ensure_tensor(label)],
+                  "cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), -1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), -1), 1 / p)
+        if swap:
+            dpn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), -1), 1 / p)
+            dn = jnp.minimum(dn, dpn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return run_op(f, [ensure_tensor(input), ensure_tensor(positive), ensure_tensor(negative)],
+                  "triplet_margin_loss")
+
+
+def square_error_cost(input, label):
+    return run_op(lambda a, b: jnp.square(a - b),
+                  [ensure_tensor(input), ensure_tensor(label)], "square_error_cost")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        v = a_t * jnp.power(1 - p_t, gamma) * ce
+        if rest:
+            v = v / rest[0]
+        return _reduce(v, reduction)
+
+    ins = [ensure_tensor(logit), ensure_tensor(label)]
+    if normalizer is not None:
+        ins.append(ensure_tensor(normalizer))
+    return run_op(f, ins, "sigmoid_focal_loss")

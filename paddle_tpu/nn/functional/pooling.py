@@ -1,0 +1,151 @@
+"""Pooling functionals via `lax.reduce_window`.
+
+Reference parity: `python/paddle/nn/functional/pooling.py`.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import ensure_tensor, run_op
+
+
+def _tuplize(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+def _pool(x, nd, kernel, stride, padding, reducer, init, ceil_mode, exclusive=True,
+          data_format="NCHW", count_include_pad=False):
+    x = ensure_tensor(x)
+    channel_last = not data_format.upper().startswith("NC")
+    k = _tuplize(kernel, nd)
+    s = _tuplize(stride if stride is not None else kernel, nd)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = _tuplize(padding, nd) if not (isinstance(padding, (list, tuple)) and
+                                          isinstance(padding[0], (list, tuple))) else padding
+        pads = [(int(pi), int(pi)) if isinstance(pi, (int, np.integer)) else
+                (int(pi[0]), int(pi[1])) for pi in p]
+
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        full_pads = [(0, 0)] + (pads or [(0, 0)] * nd) + [(0, 0)]
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        full_pads = [(0, 0), (0, 0)] + (pads or [(0, 0)] * nd)
+
+    def f(a):
+        if pad_mode == "SAME":
+            pp = "SAME"
+        elif pad_mode == "VALID":
+            pp = "VALID"
+        else:
+            pp = full_pads
+        if reducer == "max":
+            return jax.lax.reduce_window(a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                                         else jnp.iinfo(a.dtype).min,
+                                         jax.lax.max, window, strides, pp)
+        # avg pool: sum then divide by count
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pp)
+        if count_include_pad or pad_mode == "VALID" or (pads is None and pad_mode is None):
+            return summed / np.prod(k)
+        ones = jnp.ones_like(a)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pp)
+        return summed / counts
+
+    return run_op(f, [x], f"{reducer}_pool{nd}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, kernel_size, stride, padding, "max", None, ceil_mode,
+                 data_format=data_format)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "max", None, ceil_mode,
+                 data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "max", None, ceil_mode,
+                 data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, kernel_size, stride, padding, "avg", None, ceil_mode,
+                 data_format=data_format, count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "avg", None, ceil_mode,
+                 data_format=data_format, count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "avg", None, ceil_mode,
+                 data_format=data_format, count_include_pad=not exclusive)
+
+
+def _adaptive(x, nd, output_size, reducer, data_format):
+    x = ensure_tensor(x)
+    channel_last = not data_format.upper().startswith("NC")
+    out = _tuplize(output_size, nd)
+    spatial = tuple(x.shape[1:-1]) if channel_last else tuple(x.shape[2:])
+    # exact adaptive pooling when divisible; general case via mean over index buckets
+    if all(s % o == 0 for s, o in zip(spatial, out)):
+        k = tuple(s // o for s, o in zip(spatial, out))
+        return _pool(x, nd, k, k, 0, reducer, None, False, data_format=data_format)
+
+    def f(a):
+        arr = a
+        axes = list(range(1, 1 + nd)) if channel_last else list(range(2, 2 + nd))
+        for d, (size, o) in enumerate(zip(spatial, out)):
+            ax = axes[d]
+            starts = (np.arange(o) * size) // o
+            ends = ((np.arange(o) + 1) * size + o - 1) // o
+            pieces = []
+            for s0, e0 in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(arr, int(s0), int(e0), axis=ax)
+                red = jnp.max(seg, axis=ax, keepdims=True) if reducer == "max" else \
+                    jnp.mean(seg, axis=ax, keepdims=True)
+                pieces.append(red)
+            arr = jnp.concatenate(pieces, axis=ax)
+        return arr
+
+    return run_op(f, [x], f"adaptive_{reducer}_pool{nd}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, 1, output_size, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, 2, output_size, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, 3, output_size, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, 1, output_size, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, 2, output_size, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, 3, output_size, "max", "NCDHW")

@@ -1,0 +1,29 @@
+from ..clip import clip_grad_norm_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    return Tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    import numpy as np
+    off = 0
+    arr = vec._value if hasattr(vec, "_value") else vec
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._value = arr[off:off + n].reshape(tuple(p.shape)).astype(p._value.dtype)
+        off += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    raise NotImplementedError("weight_norm: planned (round 2)")
+
+
+def remove_weight_norm(layer, name="weight"):
+    raise NotImplementedError("weight_norm: planned (round 2)")
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    raise NotImplementedError("spectral_norm: planned (round 2)")

@@ -1,0 +1,73 @@
+"""Functional op namespace (the `paddle.tensor` equivalent).
+
+Aggregates all op modules and monkey-patches the method surface onto
+`Tensor`, mirroring Paddle's `monkey_patch_varbase`/`monkey_patch_math_varbase`.
+"""
+from __future__ import annotations
+
+import sys
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from . import random as _random_mod
+from .random import (  # noqa: F401
+    uniform, uniform_, normal, gaussian, standard_normal, randn, rand, randint,
+    randint_like, randperm, bernoulli, multinomial, poisson, exponential_, shuffle,
+)
+
+from ..core import tensor as _tensor_mod
+from ..core.tensor import Tensor
+
+# late-bind the ops module into Tensor dunders
+_tensor_mod._ops = sys.modules[__name__]
+
+_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "abs", "sqrt", "rsqrt",
+    "square", "exp", "expm1", "log", "log2", "log10", "log1p", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "floor", "ceil", "round", "trunc", "frac", "sign", "reciprocal", "neg", "erf",
+    "erfinv", "lgamma", "digamma", "scale", "clip", "lerp", "matmul", "mm", "dot",
+    "outer", "inner", "addmm", "bmm", "t", "kron", "trace", "mv",
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "std", "var", "median",
+    "quantile", "nanmean", "nansum", "logsumexp", "cumsum", "cumprod", "cummax",
+    "cummin", "diff", "isnan", "isinf", "isfinite", "nan_to_num",
+    "add_", "subtract_", "multiply_", "divide_", "scale_", "clip_", "exp_", "sqrt_",
+    "rsqrt_", "floor_", "ceil_", "round_", "reciprocal_", "tanh_", "abs_",
+    # manipulation
+    "cast", "reshape", "reshape_", "transpose", "swapaxes", "moveaxis", "flatten",
+    "squeeze", "unsqueeze", "squeeze_", "unsqueeze_", "split", "chunk", "unbind",
+    "gather", "gather_nd", "index_select", "take_along_axis", "put_along_axis",
+    "scatter", "scatter_", "scatter_nd_add", "tile", "expand", "expand_as",
+    "broadcast_to", "flip", "roll", "rot90", "pad", "repeat_interleave", "unique",
+    "masked_fill", "fill_", "fill_diagonal_", "index_put", "as_complex", "as_real",
+    # logic
+    "equal", "not_equal", "less_than", "less_equal", "greater_than", "greater_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all", "allclose", "isclose",
+    "all", "any", "is_empty",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode", "nonzero",
+    "masked_select", "index_sample", "bucketize",
+    # linalg
+    "norm", "dist", "inv", "pinv", "det", "cholesky", "solve", "qr", "svd", "eig",
+    "eigh", "matrix_power", "cross", "histogram", "bincount",
+    # creation-ish
+    "tril", "triu", "diag",
+    # random inplace
+    "uniform_", "exponential_",
+]
+
+_g = globals()
+for _name in _METHODS:
+    if _name in _g and not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _g[_name])
+
+# a few method-only aliases
+Tensor.rsub = lambda self, y: subtract(y, self)  # noqa: E731
+Tensor.item_ = Tensor.item

@@ -1,0 +1,107 @@
+"""Op dispatch: Tensor-aware wrappers over pure JAX functions.
+
+Reference parity: this replaces the phi KernelFactory/KernelKey dispatch
+(`phi/core/kernel_factory.h:50`) + generated `core.ops.*` bindings
+(`pybind/op_function_generator.cc:388`). On TPU there is one backend — XLA —
+so "kernel selection" degenerates to tracing a jax function; JAX's own
+per-primitive executable cache plays the role of the fluid op kernel cache.
+Autograd recording (tape + VJP) happens here, mirroring Tracer::TraceOp.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+__all__ = ["run_op", "unary_op", "binary_op", "to_arr", "ensure_tensor", "inplace_from"]
+
+
+def to_arr(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def ensure_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    arr = jnp.asarray(x, dtype=dtype)
+    return Tensor(arr)
+
+
+def run_op(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):
+    """Execute fn over the arrays of `tensors`; record a tape node if needed.
+
+    fn must be a pure function of the positional arrays only (close over any
+    static attrs). Returns Tensor or tuple[Tensor].
+    """
+    outs, vjp = autograd.apply_op(fn, tensors, name=name)
+    if isinstance(outs, tuple):
+        wrapped = tuple(Tensor(o) for o in outs)
+        if vjp is not None:
+            autograd.record_node(vjp, tensors, list(wrapped), name)
+        return wrapped
+    out = Tensor(outs)
+    if vjp is not None:
+        autograd.record_node(vjp, tensors, [out], name)
+    return out
+
+
+def nondiff_op(fn: Callable, tensors: Sequence[Tensor]):
+    """Run with no tape recording (integer/boolean outputs)."""
+    arrs = tuple(t._value for t in tensors)
+    outs = fn(*arrs)
+    if isinstance(outs, tuple):
+        return tuple(Tensor(o) for o in outs)
+    return Tensor(outs)
+
+
+def unary_op(jfn: Callable, name: str):
+    def op(x, name_=None, **kw):
+        x = ensure_tensor(x)
+        if kw:
+            return run_op(lambda a: jfn(a, **kw), [x], name)
+        return run_op(jfn, [x], name)
+
+    op.__name__ = name
+    return op
+
+
+def binary_op(jfn: Callable, name: str):
+    def op(x, y, name_=None):
+        tx, ty = isinstance(x, Tensor), isinstance(y, Tensor)
+        if tx and ty:
+            return run_op(jfn, [x, y], name)
+        if tx:
+            yv = y
+            return run_op(lambda a: jfn(a, yv), [x], name)
+        if ty:
+            xv = x
+            return run_op(lambda b: jfn(xv, b), [y], name)
+        return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
+
+    op.__name__ = name
+    return op
+
+
+def inplace_from(x: Tensor, result: Tensor) -> Tensor:
+    """Rebind x's payload to result's, transferring the tape node so backward
+    through later consumers of x routes correctly (inplace `op_` variants).
+
+    When the recorded node consumed x itself, snapshot the pre-modification
+    tensor into a fresh object so the producer chain of the old value stays
+    reachable (no self-loop on the tape)."""
+    node = result._node
+    if node is not None and any(t is x for t in node.inputs):
+        old = Tensor(x._value, stop_gradient=x.stop_gradient)
+        old._node = x._node
+        if old._node is not None:
+            old._node.outputs = [old if o is x else o for o in old._node.outputs]
+        node.inputs = [old if t is x else t for t in node.inputs]
+    x._value = result._value
+    if node is not None:
+        node.outputs = [x if o is result else o for o in node.outputs]
+        x._node = node
+        x.stop_gradient = False
+    return x

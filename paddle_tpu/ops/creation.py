@@ -1,0 +1,170 @@
+"""Tensor creation ops.
+
+Reference parity: `python/paddle/tensor/creation.py` (to_tensor, zeros, ones,
+full, arange, linspace, eye, tril/triu, assign …).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, Parameter
+from ._dispatch import ensure_tensor, run_op, to_arr
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    dtype = convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._value
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    if dtype is None:
+        a = np.asarray(data)
+        if a.dtype == np.float64:
+            a = a.astype(get_default_dtype())
+        arr = jnp.asarray(a)
+    else:
+        arr = jnp.asarray(np.asarray(data), dtype=dtype)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [int(shape)]
+    return [int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = to_arr(fill_value)
+    dt = convert_dtype(dtype)
+    if dt is None:
+        dt = jnp.asarray(fill_value).dtype
+        if dt == jnp.float64:
+            dt = get_default_dtype()
+    return Tensor(jnp.full(_shape_list(shape), fill_value, dtype=dt))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(to_arr(x), dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(to_arr(x), dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(to_arr(x), to_arr(fill_value), dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    start, end, step = to_arr(start), to_arr(end), to_arr(step)
+    dt = convert_dtype(dtype)
+    if dt is None:
+        py = (start, end, step)
+        dt = np.dtype("float32") if any(isinstance(v, float) for v in py) else np.dtype("int64")
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(to_arr(start), to_arr(stop), int(num),
+                               dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                          dtype=convert_dtype(dtype) or get_default_dtype()))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+    if padding_value != 0 and x.ndim == 1:
+        def f(a):
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else \
+                jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return run_op(f, [x], "diag")
+    return run_op(lambda a: jnp.diag(a, k=offset), [x], "diag")
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.diagflat(a, k=offset), [x], "diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    return run_op(lambda a: jnp.tril(a, k=diagonal), [ensure_tensor(x)], "tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return run_op(lambda a: jnp.triu(a, k=diagonal), [ensure_tensor(x)], "triu")
+
+
+def meshgrid(*args, **kwargs):
+    ts = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[t._value for t in ts], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x)
+    out = run_op(lambda a: a + 0, [x], "assign")
+    if output is not None:
+        output._value = out._value
+        output._node = out._node
+        if out._node is not None:
+            out._node.outputs = [output if o is out else o for o in out._node.outputs]
+            output.stop_gradient = False
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size, dtype=jnp.int32))
+
+
+def create_parameter(shape, dtype=None, name=None, default_initializer=None, attr=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    if default_initializer is None:
+        arr = jnp.zeros(_shape_list(shape), dtype=dt)
+        p = Parameter(arr, name=name)
+    else:
+        p = Parameter(jnp.zeros(_shape_list(shape), dtype=dt), name=name)
+        default_initializer(p)
+    return p
+
+
+def clip_by_norm(x, max_norm, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        n = jnp.sqrt(jnp.sum(a * a))
+        return jnp.where(n > max_norm, a * (max_norm / jnp.maximum(n, 1e-12)), a)
+
+    return run_op(f, [x], "clip_by_norm")

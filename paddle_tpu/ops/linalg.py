@@ -1,0 +1,198 @@
+"""Linear algebra + einsum.
+
+Reference parity: `python/paddle/tensor/linalg.py` and `paddle.linalg.*`.
+Heavy decompositions (svd/qr/eigh/...) lower to XLA's native decomposition
+custom-calls; on TPU some run via CPU callback inside XLA — same trade-off
+the reference makes by calling cuSOLVER.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._dispatch import ensure_tensor, nondiff_op, run_op
+
+from .math import matmul, dot, t, bmm, mv  # re-export for paddle.linalg namespace
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def f(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(flat * flat)) if not keepdim else \
+                    jnp.sqrt(jnp.sum(flat * flat)).reshape([1] * a.ndim)
+            if p == float("inf"):
+                return jnp.max(jnp.abs(flat))
+            if p == float("-inf"):
+                return jnp.min(jnp.abs(flat))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum((flat != 0).astype(a.dtype))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+
+    return run_op(f, [x], "norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return run_op(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), [x, y], "dist")
+
+
+def cond(x, p=None, name=None):
+    return nondiff_op(lambda a: jnp.linalg.cond(a, p=p), [ensure_tensor(x)])
+
+
+def inv(x, name=None):
+    return run_op(jnp.linalg.inv, [ensure_tensor(x)], "inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return run_op(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                  [ensure_tensor(x)], "pinv")
+
+
+def det(x, name=None):
+    return run_op(jnp.linalg.det, [ensure_tensor(x)], "det")
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    outs = run_op(lambda a: tuple(jnp.linalg.slogdet(a)), [x], "slogdet")
+    return outs
+
+
+def cholesky(x, upper=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return run_op(f, [x], "cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(b, l):
+        lo = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(lo, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(lo, -1, -2), z, lower=False)
+
+    return run_op(f, [x, y], "cholesky_solve")
+
+
+def solve(x, y, name=None):
+    return run_op(jnp.linalg.solve, [ensure_tensor(x), ensure_tensor(y)], "solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return run_op(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular),
+        [x, y], "triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    q, r = jnp.linalg.qr(x._value, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    u, s, vh = jnp.linalg.svd(x._value, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    w, v = jnp.linalg.eig(x._value)
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    w, v = jnp.linalg.eigh(x._value, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.linalg.eigvals(ensure_tensor(x)._value))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(ensure_tensor(x)._value, UPLO=UPLO))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return nondiff_op(lambda a: jnp.linalg.matrix_rank(a, rtol=tol), [ensure_tensor(x)])
+
+
+def matrix_power(x, n, name=None):
+    return run_op(lambda a: jnp.linalg.matrix_power(a, n), [ensure_tensor(x)], "matrix_power")
+
+
+def multi_dot(x, name=None):
+    ts = [ensure_tensor(a) for a in x]
+    return run_op(lambda *arrs: jnp.linalg.multi_dot(arrs), ts, "multi_dot")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis if axis != 9 else (next((i for i, s in enumerate(x.shape) if s == 3), -1))
+    return run_op(lambda a, b: jnp.cross(a, b, axis=ax), [x, y], "cross")
+
+
+def householder_product(x, tau, name=None):
+    raise NotImplementedError("householder_product: planned (round 2)")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return run_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), [ensure_tensor(x)], "corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return run_op(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+                  [ensure_tensor(x)], "cov")
+
+
+def einsum(equation, *operands):
+    ts = [ensure_tensor(o) for o in operands]
+    return run_op(lambda *arrs: jnp.einsum(equation, *arrs), ts, "einsum")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = ensure_tensor(input)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = jnp.histogram(a._value, bins=bins, range=rng)
+    return Tensor(h)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    w = ensure_tensor(weights)._value if weights is not None else None
+    return Tensor(jnp.bincount(x._value.astype(jnp.int32), weights=w, minlength=minlength))

@@ -1,0 +1,82 @@
+"""Comparison / logical / bitwise ops.
+
+Reference parity: `python/paddle/tensor/logic.py` + `operators/controlflow/`
+logical ops. All outputs are non-differentiable (never recorded on the tape).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._dispatch import ensure_tensor, nondiff_op, to_arr
+
+
+def _cmp(jfn, name):
+    def op(x, y, name_=None):
+        xv, yv = to_arr(x), to_arr(y)
+        return Tensor(jfn(jnp.asarray(xv), jnp.asarray(yv)))
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return Tensor(jnp.logical_not(to_arr(x)))
+
+
+def bitwise_not(x, out=None, name=None):
+    return Tensor(jnp.bitwise_not(to_arr(x)))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(to_arr(x), to_arr(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(to_arr(x), to_arr(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(to_arr(x), to_arr(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return nondiff_op(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), [ensure_tensor(x)])
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return nondiff_op(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), [ensure_tensor(x)])
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in_dynamic_mode():
+    return True
+
+
+bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")

@@ -1,0 +1,464 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: `python/paddle/tensor/manipulation.py` (reshape, transpose,
+concat, split, gather, scatter, tile, expand, pad, …) over the fluid op corpus.
+All are XLA-friendly: static shapes, no data-dependent output sizes except
+where noted (masked_select/nonzero are host-synced, as on any accelerator).
+"""
+from __future__ import annotations
+
+import builtins
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ._dispatch import ensure_tensor, inplace_from, nondiff_op, run_op, to_arr
+
+
+def _norm_shape(shape, cur_shape):
+    """Paddle reshape semantics: -1 infers, 0 copies the input dim."""
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s) for s in shape]
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(cur_shape[i])
+        else:
+            out.append(s)
+    return out
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    dt = convert_dtype(dtype)
+    from ..core.dtype import is_floating
+    if is_floating(x.dtype) and is_floating(dt):
+        return run_op(lambda a: a.astype(dt), [x], "cast")
+    return nondiff_op(lambda a: a.astype(dt), [x])
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    ns = _norm_shape(shape, x.shape)
+    return run_op(lambda a: a.reshape(ns), [x], "reshape")
+
+
+def reshape_(x, shape, name=None):
+    return inplace_from(x, reshape(x, shape))
+
+
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    perm = [int(p) for p in perm]
+    return run_op(lambda a: jnp.transpose(a, perm), [x], "transpose")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.swapaxes(a, axis0, axis1), [x], "swapaxes")
+
+
+moveaxis = lambda x, source, destination, name=None: run_op(
+    lambda a: jnp.moveaxis(a, source, destination), [ensure_tensor(x)], "moveaxis")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    so = stop_axis % nd if nd else 0
+    shp = x.shape
+    new = shp[:sa] + [int(np.prod(shp[sa:so + 1])) if shp[sa:so + 1] else 1] + shp[so + 1:]
+    return run_op(lambda a: a.reshape(new), [x], "flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = None
+    elif isinstance(axis, (list, tuple)):
+        ax = tuple(int(a) for a in axis if x.shape[int(a)] == 1)
+    else:
+        ax = int(axis)
+        if x.shape[ax] != 1:
+            return run_op(lambda a: a, [x], "squeeze")
+    return run_op(lambda a: jnp.squeeze(a, axis=ax), [x], "squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(int(a) for a in axis) if isinstance(axis, (list, tuple)) else int(axis)
+    return run_op(lambda a: jnp.expand_dims(a, ax), [x], "unsqueeze")
+
+
+squeeze_ = lambda x, axis=None, name=None: inplace_from(x, squeeze(x, axis))
+unsqueeze_ = lambda x, axis=None, name=None: inplace_from(x, unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    ax = int(to_arr(axis)) if isinstance(axis, Tensor) else int(axis)
+    return run_op(lambda *arrs: jnp.concatenate(arrs, axis=ax), ts, "concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return run_op(lambda *arrs: jnp.stack(arrs, axis=int(axis)), ts, "stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = int(to_arr(axis)) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_unknown = builtins.sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = builtins.sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offs = np.cumsum([0] + sizes)
+
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, int(offs[i]), int(offs[i + 1]), axis=ax)
+                     for i in range(len(sizes)))
+
+    return list(run_op(f, [x], "split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[int(axis)]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis=int(axis)) for o in outs]
+
+
+def slice(x, axes, starts, ends, name=None):
+    x = ensure_tensor(x)
+    axes = [int(a) for a in axes]
+    starts = [int(to_arr(s)) for s in (starts.tolist() if isinstance(starts, Tensor) else starts)]
+    ends = [int(to_arr(e)) for e in (ends.tolist() if isinstance(ends, Tensor) else ends)]
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+
+    return run_op(f, [x], "slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
+        return a[tuple(idx)]
+
+    return run_op(f, [x], "strided_slice")
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    ax = int(to_arr(axis)) if isinstance(axis, Tensor) else int(axis)
+    return run_op(lambda a: jnp.take(a, index._value.astype(jnp.int32), axis=ax), [x], "gather")
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    ind = index._value.astype(jnp.int32)
+
+    def f(a):
+        k = ind.shape[-1]
+        return a[tuple(jnp.moveaxis(ind, -1, 0)[i] for i in range(k))]
+
+    return run_op(f, [x], "gather_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    ind = indices._value.astype(jnp.int32)
+    return run_op(lambda a: jnp.take_along_axis(a, ind, axis=int(axis)), [arr], "take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr = ensure_tensor(arr)
+    ind = ensure_tensor(indices)._value.astype(jnp.int32)
+    vt = isinstance(values, Tensor)
+    vv = values if vt else None
+
+    def f(a, *rest):
+        v = rest[0] if rest else jnp.asarray(values, a.dtype)
+        v = jnp.broadcast_to(v, ind.shape).astype(a.dtype)
+        dims = list(range(a.ndim))
+        ax = int(axis) % a.ndim
+        idx_grids = jnp.meshgrid(*[jnp.arange(s) for s in ind.shape], indexing="ij")
+        full_idx = tuple(ind if d == ax else idx_grids[d] for d in dims)
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[full_idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[full_idx].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    ins = [arr, vv] if vt else [arr]
+    return run_op(f, ins, "put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, updates = ensure_tensor(x), ensure_tensor(updates)
+    ind = ensure_tensor(index)._value.astype(jnp.int32)
+
+    def f(a, u):
+        if overwrite:
+            return a.at[ind].set(u.astype(a.dtype))
+        return a.at[ind].add(u.astype(a.dtype))
+
+    return run_op(f, [x, updates], "scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return inplace_from(x, scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, updates = ensure_tensor(x), ensure_tensor(updates)
+    ind = ensure_tensor(index)._value.astype(jnp.int32)
+
+    def f(a, u):
+        k = ind.shape[-1]
+        idx = tuple(jnp.moveaxis(ind, -1, 0)[i] for i in range(k))
+        return a.at[idx].add(u.astype(a.dtype))
+
+    return run_op(f, [x, updates], "scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=ensure_tensor(updates).dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = [int(r) for r in repeat_times]
+    return run_op(lambda a: jnp.tile(a, reps), [x], "tile")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    tgt = []
+    shape = [int(s) for s in shape]
+    xs = [1] * (len(shape) - x.ndim) + x.shape
+    for s, xd in zip(shape, xs):
+        tgt.append(xd if s == -1 else s)
+    return run_op(lambda a: jnp.broadcast_to(a, tgt), [x], "expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [expand(t, list(shape)) for t in ts]
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return run_op(lambda a: jnp.flip(a, axis=ax), [x], "flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.roll(a, shifts, axis=axis), [x], "roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return run_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [ensure_tensor(x)], "rot90")
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = ensure_tensor(condition)
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(cond, as_tuple=True)
+    tx, ty = isinstance(x, Tensor), isinstance(y, Tensor)
+    c = cond._value.astype(bool)
+    if tx and ty:
+        return run_op(lambda a, b: jnp.where(c, a, b), [x, y], "where")
+    if tx:
+        return run_op(lambda a: jnp.where(c, a, y), [x], "where")
+    if ty:
+        return run_op(lambda b: jnp.where(c, x, b), [y], "where")
+    return Tensor(jnp.where(c, x, y))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pad applies to the last len(pad)//2 spatial dims,
+        # ordered (left, right, top, bottom, ...) innermost-first
+        n = len(pad) // 2
+        spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n)][::-1]  # innermost-first
+        if data_format.upper().endswith("C"):  # NHWC/NLC/NDHWC: channel last
+            widths = [(0, 0)] * (nd - n - 1) + spatial + [(0, 0)]
+        else:  # NCHW/NCL/NCDHW
+            widths = [(0, 0)] * (nd - n) + spatial
+    mode_map = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                "circular": "wrap"}
+    jmode = mode_map[mode]
+
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+
+    return run_op(f, [x], "pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    reps = to_arr(repeats)
+    return run_op(lambda a: jnp.repeat(a, reps, axis=axis), [x], "repeat_interleave")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    a = ensure_tensor(x).numpy()  # host-synced, like any dynamic-shape op on TPU
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    a = ensure_tensor(x).numpy()
+    vals = []
+    prev = object()
+    for v in a.reshape(-1) if axis is None else a:
+        if not np.array_equal(v, prev):
+            vals.append(v)
+        prev = v
+    return Tensor(jnp.asarray(np.array(vals)))
+
+
+def as_complex(x, name=None):
+    return run_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [ensure_tensor(x)], "as_complex")
+
+
+def as_real(x, name=None):
+    return run_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                  [ensure_tensor(x)], "as_real")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = ensure_tensor(input)
+    size = index_num // nshards
+
+    def f(a):
+        shard = a // size
+        return jnp.where(shard == shard_id, a % size, ignore_value)
+
+    return nondiff_op(f, [input])
+
+
+# ---- indexing (Tensor __getitem__ / __setitem__) ----
+def _conv_idx(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_conv_idx(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+def getitem(x, idx):
+    x = ensure_tensor(x)
+    jidx = _conv_idx(idx)
+    # boolean-mask indexing produces dynamic shape -> host sync (documented)
+    if isinstance(jidx, (jax.Array, np.ndarray)) and np.asarray(jidx).dtype == np.bool_:
+        mask = np.asarray(jidx)
+        sel = np.nonzero(mask.reshape(-1))[0]
+        flatn = int(np.prod(x.shape[:mask.ndim]))
+        def f(a):
+            lead = a.reshape((flatn,) + a.shape[mask.ndim:])
+            return jnp.take(lead, jnp.asarray(sel), axis=0)
+        return run_op(f, [x], "getitem_mask")
+    return run_op(lambda a: a[jidx], [x], "getitem")
+
+
+def setitem_(x, idx, value):
+    x = ensure_tensor(x)
+    jidx = _conv_idx(idx)
+    if isinstance(value, Tensor):
+        out = run_op(lambda a, v: a.at[jidx].set(v.astype(a.dtype)), [x, value], "setitem")
+    else:
+        out = run_op(lambda a: a.at[jidx].set(jnp.asarray(value, a.dtype)), [x], "setitem")
+    return inplace_from(x, out)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    idx = tuple(_conv_idx(i) for i in indices)
+    v = ensure_tensor(value)
+
+    def f(a, u):
+        return a.at[idx].add(u.astype(a.dtype)) if accumulate else a.at[idx].set(u.astype(a.dtype))
+
+    return run_op(f, [x, v], "index_put")
+
+
+def masked_fill(x, mask, value, name=None):
+    x = ensure_tensor(x)
+    m = ensure_tensor(mask)._value.astype(bool)
+    if isinstance(value, Tensor):
+        return run_op(lambda a, v: jnp.where(m, v.astype(a.dtype), a), [x, value], "masked_fill")
+    return run_op(lambda a: jnp.where(m, jnp.asarray(value, a.dtype), a), [x], "masked_fill")
+
+
+def fill_(x, value):
+    x = ensure_tensor(x)
+    x._value = jnp.full_like(x._value, value)
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x = ensure_tensor(x)
+    n = builtins.min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n)
+    x._value = x._value.at[..., i, i].set(value)
+    return x

@@ -1,0 +1,385 @@
+"""Elementwise & reduction math ops.
+
+Reference parity: `python/paddle/tensor/math.py` + the elementwise/reduce op
+corpus (`paddle/fluid/operators/elementwise/`, `operators/reduce_ops/`).
+Broadcasting/dtype promotion follow jnp (numpy rules), matching Paddle's.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import flag
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ._dispatch import binary_op, ensure_tensor, inplace_from, run_op, to_arr, unary_op
+
+# ---- binary elementwise ----
+add = binary_op(jnp.add, "add")
+subtract = binary_op(jnp.subtract, "subtract")
+multiply = binary_op(jnp.multiply, "multiply")
+divide = binary_op(jnp.divide, "divide")
+floor_divide = binary_op(jnp.floor_divide, "floor_divide")
+remainder = binary_op(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+pow = binary_op(jnp.power, "pow")
+maximum = binary_op(jnp.maximum, "maximum")
+minimum = binary_op(jnp.minimum, "minimum")
+fmax = binary_op(jnp.fmax, "fmax")
+fmin = binary_op(jnp.fmin, "fmin")
+atan2 = binary_op(jnp.arctan2, "atan2")
+heaviside = binary_op(jnp.heaviside, "heaviside")
+hypot = binary_op(lambda a, b: jnp.sqrt(a * a + b * b), "hypot")
+logaddexp = binary_op(jnp.logaddexp, "logaddexp")
+nextafter = binary_op(jnp.nextafter, "nextafter")
+copysign = binary_op(jnp.copysign, "copysign")
+gcd = binary_op(jnp.gcd, "gcd")
+lcm = binary_op(jnp.lcm, "lcm")
+
+elementwise_add = add
+elementwise_sub = subtract
+elementwise_mul = multiply
+elementwise_div = divide
+
+# ---- unary elementwise ----
+abs = unary_op(jnp.abs, "abs")
+sqrt = unary_op(jnp.sqrt, "sqrt")
+rsqrt = unary_op(jax.lax.rsqrt, "rsqrt")
+square = unary_op(jnp.square, "square")
+exp = unary_op(jnp.exp, "exp")
+expm1 = unary_op(jnp.expm1, "expm1")
+log = unary_op(jnp.log, "log")
+log2 = unary_op(jnp.log2, "log2")
+log10 = unary_op(jnp.log10, "log10")
+log1p = unary_op(jnp.log1p, "log1p")
+sin = unary_op(jnp.sin, "sin")
+cos = unary_op(jnp.cos, "cos")
+tan = unary_op(jnp.tan, "tan")
+asin = unary_op(jnp.arcsin, "asin")
+acos = unary_op(jnp.arccos, "acos")
+atan = unary_op(jnp.arctan, "atan")
+sinh = unary_op(jnp.sinh, "sinh")
+cosh = unary_op(jnp.cosh, "cosh")
+tanh = unary_op(jnp.tanh, "tanh")
+asinh = unary_op(jnp.arcsinh, "asinh")
+acosh = unary_op(jnp.arccosh, "acosh")
+atanh = unary_op(jnp.arctanh, "atanh")
+floor = unary_op(jnp.floor, "floor")
+ceil = unary_op(jnp.ceil, "ceil")
+round = unary_op(jnp.round, "round")
+trunc = unary_op(jnp.trunc, "trunc")
+frac = unary_op(lambda a: a - jnp.trunc(a), "frac")
+sign = unary_op(jnp.sign, "sign")
+reciprocal = unary_op(lambda a: 1.0 / a, "reciprocal")
+neg = unary_op(jnp.negative, "neg")
+erf = unary_op(jax.lax.erf, "erf")
+erfinv = unary_op(jax.lax.erf_inv, "erfinv")
+lgamma = unary_op(jax.lax.lgamma, "lgamma")
+digamma = unary_op(jax.lax.digamma, "digamma")
+angle = unary_op(jnp.angle, "angle")
+conj = unary_op(jnp.conj, "conj")
+real = unary_op(jnp.real, "real")
+imag = unary_op(jnp.imag, "imag")
+deg2rad = unary_op(jnp.deg2rad, "deg2rad")
+rad2deg = unary_op(jnp.rad2deg, "rad2deg")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    s, b = to_arr(scale), to_arr(bias)
+    if bias_after_scale:
+        f = lambda a: a * jnp.asarray(s, a.dtype) + jnp.asarray(b, a.dtype)
+    else:
+        f = lambda a: (a + jnp.asarray(b, a.dtype)) * jnp.asarray(s, a.dtype)
+    return run_op(f, [x], "scale")
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = to_arr(min) if min is not None else None
+    hi = to_arr(max) if max is not None else None
+    return run_op(lambda a: jnp.clip(a, lo, hi), [x], "clip")
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return run_op(lambda a, b, w: a + w * (b - a), [x, y, weight], "lerp")
+    w = weight
+    return run_op(lambda a, b: a + w * (b - a), [x, y], "lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return run_op(lambda a: scale_b * jnp.tanh(scale_a * a), [ensure_tensor(x)], "stanh")
+
+
+def multiplex(inputs, index, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    idx = ensure_tensor(index)
+
+    def f(*arrs):
+        stacked = jnp.stack(arrs, axis=0)
+        ind = idx._value.reshape(-1).astype(jnp.int32)
+        return stacked[ind, jnp.arange(arrs[0].shape[0])]
+
+    return run_op(f, ts, "multiplex")
+
+
+# ---- matmul family ----
+def _precision():
+    p = flag("tpu_matmul_precision")
+    return {"default": None, "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}.get(p, None)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_precision())
+
+    return run_op(f, [x, y], "matmul")
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    return run_op(lambda a, b: jnp.sum(a * b, axis=-1), [ensure_tensor(x), ensure_tensor(y)], "dot")
+
+
+def outer(x, y, name=None):
+    return run_op(lambda a, b: jnp.outer(a, b), [ensure_tensor(x), ensure_tensor(y)], "outer")
+
+
+def inner(x, y, name=None):
+    return run_op(lambda a, b: jnp.inner(a, b), [ensure_tensor(x), ensure_tensor(y)], "inner")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b, precision=_precision()),
+        [ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)], "addmm")
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim > 2:
+        raise ValueError("t() expects ndim<=2")
+    return run_op(lambda a: a.T, [x], "t")
+
+
+def kron(x, y, name=None):
+    return run_op(jnp.kron, [ensure_tensor(x), ensure_tensor(y)], "kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                  [ensure_tensor(x)], "trace")
+
+
+def mv(x, vec, name=None):
+    return run_op(lambda a, v: jnp.matmul(a, v, precision=_precision()),
+                  [ensure_tensor(x), ensure_tensor(vec)], "mv")
+
+
+# ---- reductions ----
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax, dt = _axis_arg(axis), convert_dtype(dtype)
+    return run_op(lambda a: jnp.sum(a, axis=ax, dtype=dt, keepdims=keepdim), [x], "sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return run_op(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), [x], "mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    x = ensure_tensor(x)
+    ax, dt = _axis_arg(axis), convert_dtype(dtype)
+    return run_op(lambda a: jnp.prod(a, axis=ax, dtype=dt, keepdims=keepdim), [x], "prod")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return run_op(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), [x], "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return run_op(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), [x], "min")
+
+
+amax = max
+amin = min
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    dd = 1 if unbiased else 0
+    return run_op(lambda a: jnp.std(a, axis=ax, ddof=dd, keepdims=keepdim), [x], "std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    dd = 1 if unbiased else 0
+    return run_op(lambda a: jnp.var(a, axis=ax, ddof=dd, keepdims=keepdim), [x], "var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return run_op(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), [x], "median")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return run_op(lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim),
+                  [x], "quantile")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return run_op(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), [x], "nanmean")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return run_op(lambda a: jnp.nansum(a, axis=ax, dtype=convert_dtype(dtype), keepdims=keepdim),
+                  [x], "nansum")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return run_op(lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                  [x], "logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = convert_dtype(dtype)
+    if axis is None:
+        return run_op(lambda a: jnp.cumsum(a.reshape(-1), dtype=dt), [x], "cumsum")
+    return run_op(lambda a: jnp.cumsum(a, axis=int(axis), dtype=dt), [x], "cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = convert_dtype(dtype)
+    return run_op(lambda a: jnp.cumprod(a, axis=dim, dtype=dt), [x], "cumprod")
+
+
+def _cum_extreme(x, axis, is_max):
+    x = ensure_tensor(x)
+    flatten = axis is None
+    ax = 0 if flatten else int(axis)
+
+    def f(a):
+        if flatten:
+            a = a.reshape(-1)
+        idx = jnp.broadcast_to(
+            jnp.arange(a.shape[ax], dtype=jnp.int32).reshape(
+                [-1 if d == (ax % a.ndim) else 1 for d in range(a.ndim)]),
+            a.shape)
+
+        def combine(c1, c2):
+            v1, i1 = c1
+            v2, i2 = c2
+            take2 = (v2 >= v1) if is_max else (v2 <= v1)
+            return jnp.where(take2, v2, v1), jnp.where(take2, i2, i1)
+
+        return jax.lax.associative_scan(combine, (a, idx), axis=ax)
+
+    vals = run_op(lambda a: f(a)[0], [x], "cummax" if is_max else "cummin")
+    from ._dispatch import nondiff_op
+    inds = nondiff_op(lambda a: f(a)[1], [x])
+    return vals, inds
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, True)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, False)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    pre = to_arr(prepend) if prepend is not None else None
+    app = to_arr(append) if append is not None else None
+    return run_op(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), [x], "diff")
+
+
+# ---- nan/inf checks ----
+def isnan(x, name=None):
+    from ._dispatch import nondiff_op
+    return nondiff_op(jnp.isnan, [ensure_tensor(x)])
+
+
+def isinf(x, name=None):
+    from ._dispatch import nondiff_op
+    return nondiff_op(jnp.isinf, [ensure_tensor(x)])
+
+
+def isfinite(x, name=None):
+    from ._dispatch import nondiff_op
+    return nondiff_op(jnp.isfinite, [ensure_tensor(x)])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return run_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                  [ensure_tensor(x)], "nan_to_num")
+
+
+# ---- inplace variants (Paddle `op_` spelling) ----
+def _make_inplace(op):
+    def f(x, *a, **kw):
+        return inplace_from(x, op(x, *a, **kw))
+    f.__name__ = op.__name__ + "_"
+    return f
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+divide_ = _make_inplace(divide)
+scale_ = _make_inplace(scale)
+clip_ = _make_inplace(clip)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+round_ = _make_inplace(round)
+reciprocal_ = _make_inplace(reciprocal)
+tanh_ = _make_inplace(tanh)
+abs_ = _make_inplace(abs)

@@ -1,0 +1,133 @@
+"""Random sampling ops.
+
+Reference parity: `python/paddle/tensor/random.py` (uniform, normal, randint,
+randperm, bernoulli, multinomial, …) over the phi RNG kernels. TPU-first:
+eager calls draw fresh keys from the global `Generator`
+(`paddle_tpu.core.random`); inside jitted/static programs use
+`paddle_tpu.jit`'s key plumbing instead of these stateful entry points.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as rnd
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+from ._dispatch import ensure_tensor, to_arr
+
+__all__ = [
+    "uniform", "uniform_", "normal", "gaussian", "standard_normal", "randn", "rand",
+    "randint", "randint_like", "randperm", "bernoulli", "multinomial", "poisson",
+    "exponential_", "shuffle",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [int(shape)]
+    return [int(s) for s in shape]
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    key = rnd.next_key() if seed == 0 else jax.random.key(seed)
+    return Tensor(jax.random.uniform(key, _shape_list(shape), dtype=dt,
+                                     minval=to_arr(min), maxval=to_arr(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x = ensure_tensor(x)
+    x._value = jax.random.uniform(rnd.next_key(), tuple(x.shape), dtype=x._value.dtype,
+                                  minval=min, maxval=max)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    key = rnd.next_key()
+    return Tensor(jax.random.normal(key, _shape_list(shape), dtype=dt) * std + mean)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = jnp.asarray(to_arr(mean)), jnp.asarray(to_arr(std))
+        shp = jnp.broadcast_shapes(m.shape, s.shape)
+        key = rnd.next_key()
+        return Tensor(jax.random.normal(key, shp, dtype=m.dtype if m.dtype != jnp.int32 else jnp.float32) * s + m)
+    return gaussian(shape if shape is not None else [1], mean, std)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype)
+    key = rnd.next_key()
+    return Tensor(jax.random.randint(key, _shape_list(shape), int(low), int(high),
+                                     dtype=dt if np.issubdtype(dt, np.integer) else jnp.int32))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, tuple(x.shape), dtype or "int32")
+
+
+def randperm(n, dtype="int64", name=None):
+    key = rnd.next_key()
+    return Tensor(jax.random.permutation(key, int(n)).astype(convert_dtype(dtype) if
+                                                             np.issubdtype(convert_dtype(dtype), np.integer) else jnp.int32))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    key = rnd.next_key()
+    return Tensor(jax.random.bernoulli(key, x._value).astype(x._value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = rnd.next_key()
+    p = x._value / jnp.sum(x._value, axis=-1, keepdims=True)
+    if x.ndim == 1:
+        out = jax.random.choice(key, x.shape[0], shape=(num_samples,), replace=replacement, p=p)
+    else:
+        keys = jax.random.split(key, x.shape[0])
+        out = jnp.stack([
+            jax.random.choice(keys[i], x.shape[-1], shape=(num_samples,),
+                              replace=replacement, p=p[i])
+            for i in range(x.shape[0])])
+    return Tensor(out)
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    key = rnd.next_key()
+    return Tensor(jax.random.poisson(key, x._value).astype(x._value.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = ensure_tensor(x)
+    key = rnd.next_key()
+    x._value = (jax.random.exponential(key, tuple(x.shape), dtype=x._value.dtype) / lam)
+    return x
+
+
+def shuffle(x, axis=0):
+    x = ensure_tensor(x)
+    key = rnd.next_key()
+    return Tensor(jax.random.permutation(key, x._value, axis=axis))

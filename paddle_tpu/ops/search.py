@@ -1,0 +1,130 @@
+"""Search / sort / sampling-index ops.
+
+Reference parity: `python/paddle/tensor/search.py` (argmax, argsort, topk,
+where/nonzero, masked_select, searchsorted, index_sample).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ._dispatch import ensure_tensor, nondiff_op, run_op
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    return nondiff_op(lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim), [x])
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    return nondiff_op(lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim), [x])
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx
+
+    return nondiff_op(f, [x])
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        s = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return s
+
+    return run_op(f, [x], "sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    k = int(k)
+    ax = int(axis)
+
+    def fval(a):
+        b = jnp.moveaxis(a, ax, -1)
+        src = b if largest else -b
+        v = jax.lax.top_k(src, k)[0]
+        v = v if largest else -v
+        return jnp.moveaxis(v, -1, ax)
+
+    def find(a):
+        b = jnp.moveaxis(a, ax, -1)
+        src = b if largest else -b
+        i = jax.lax.top_k(src, k)[1]
+        return jnp.moveaxis(i, -1, ax)
+
+    vals = run_op(fval, [x], "topk")
+    inds = nondiff_op(find, [x])
+    return vals, inds
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = int(axis)
+
+    def f(a):
+        s = jnp.sort(a, axis=ax)
+        v = jnp.take(s, k - 1, axis=ax)
+        return jnp.expand_dims(v, ax) if keepdim else v
+
+    vals = run_op(f, [x], "kthvalue")
+    inds = nondiff_op(lambda a: jnp.take(jnp.argsort(a, axis=ax), k - 1, axis=ax), [x])
+    if keepdim:
+        inds = Tensor(jnp.expand_dims(inds._value, ax))
+    return vals, inds
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = ensure_tensor(x).numpy()
+    from scipy import stats  # available in the image; fallback below if not
+    m = stats.mode(a, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+
+
+def nonzero(x, as_tuple=False):
+    a = ensure_tensor(x).numpy()  # dynamic output shape → host sync
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n)) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def masked_select(x, mask, name=None):
+    x = ensure_tensor(x)
+    m = np.broadcast_to(ensure_tensor(mask).numpy().astype(bool), tuple(x.shape))
+    sel = np.nonzero(m.reshape(-1))[0]
+
+    def f(a):
+        return jnp.take(a.reshape(-1), jnp.asarray(sel))
+
+    return run_op(f, [x], "masked_select")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    ss, v = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+
+    def f(s):
+        return jnp.searchsorted(s, v._value, side=side).astype(
+            jnp.int32 if out_int32 else jnp.int32)
+
+    return nondiff_op(f, [ss])
+
+
+def index_sample(x, index):
+    x = ensure_tensor(x)
+    ind = ensure_tensor(index)._value.astype(jnp.int32)
+    return run_op(lambda a: jnp.take_along_axis(a, ind, axis=1), [x], "index_sample")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,16 @@
+"""paddle.optimizer parity namespace."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer, SGD, Momentum  # noqa: F401
+from .adam import Adam, AdamW, Adamax, Lamb, Adagrad, RMSProp, Adadelta  # noqa: F401
+
+
+class L2Decay:
+    """paddle.regularizer.L2Decay parity (coefficient consumed by optimizers)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)

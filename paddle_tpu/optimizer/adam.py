@@ -1,0 +1,178 @@
+"""Adam family + Lamb + classic optimizers.
+
+Reference parity: `python/paddle/optimizer/{adam,adamw,lamb,adagrad,rmsprop,
+adadelta,adamax}.py` over the fluid adam/lamb kernels
+(`operators/optimizers/adam_op.h`, `lamb_op.h`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None,
+                 lazy_mode=False, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_slots(self, p):
+        return {"moment1": jnp.zeros_like(p._value, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p._value, dtype=jnp.float32)}
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd and not self._decoupled():
+            g32 = g32 + wd * p.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * (g32 * g32)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if wd and self._decoupled():
+            upd = upd + wd * p.astype(jnp.float32)
+        p_new = (p.astype(jnp.float32) - lr * upd).astype(p.dtype)
+        return p_new, {"moment1": m, "moment2": v}
+
+    def _decoupled(self):
+        return False
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_slots(self, p):
+        return {"moment": jnp.zeros_like(p._value, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(p._value, dtype=jnp.float32)}
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p.astype(jnp.float32)
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g32))
+        p_new = (p.astype(jnp.float32)
+                 - (lr / (1 - self._beta1 ** t)) * m / (u + self._epsilon)).astype(p.dtype)
+        return p_new, {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _param_wd(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._weight_decay
+
+    def _create_slots(self, p):
+        return {"moment1": jnp.zeros_like(p._value, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p._value, dtype=jnp.float32)}
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * (g32 * g32)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p32
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_slots(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_acc, dtype=jnp.float32)}
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p.astype(jnp.float32)
+        acc = slots["moment"] + g32 * g32
+        p_new = (p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(acc) + self._epsilon)).astype(p.dtype)
+        return p_new, {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_slots(self, p):
+        s = {"mean_square": jnp.zeros_like(p._value, dtype=jnp.float32),
+             "momentum": jnp.zeros_like(p._value, dtype=jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p._value, dtype=jnp.float32)
+        return s
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p.astype(jnp.float32)
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * g32 * g32
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * g32 / denom
+        out["momentum"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _create_slots(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p._value, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p._value, dtype=jnp.float32)}
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * p.astype(jnp.float32)
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * g32 * g32
+        upd = g32 * jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}

@@ -1,0 +1,229 @@
+"""Optimizer base + SGD/Momentum.
+
+Reference parity: `python/paddle/optimizer/optimizer.py` (modern API) over the
+fluid optimizer ops (`operators/optimizers/sgd_op.cc`, `momentum_op.cc`,
+`merged_adam` multi-tensor).
+
+TPU-first design: `step()` applies ONE jitted, fused update over all
+parameters at once (the multi-tensor "merged" optimizer the reference only
+has for adam) — gradient clip, weight decay, and the update rule all fuse
+into a single XLA program per parameter-group structure. The same pure
+`_apply` core is reused by the jitted train-step builder (paddle_tpu.jit)
+so eager and static training share optimizer semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list: Optional[List[Parameter]] = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay object
+            self._weight_decay = float(getattr(weight_decay, "_coeff", 0.0))
+        self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+        self._jit_cache = {}
+
+    # ---- lr ----
+    def get_lr(self) -> float:
+        lr = self._learning_rate
+        if hasattr(lr, "get_lr"):
+            return float(lr.get_lr())
+        return float(lr)
+
+    def set_lr(self, value):
+        if hasattr(self._learning_rate, "get_lr"):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ---- subclass hooks ----
+    def _create_slots(self, p: Parameter) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        """Pure update rule: arrays in, (new_param, new_slots) out."""
+        raise NotImplementedError
+
+    def _uses_decoupled_wd(self) -> bool:
+        return False
+
+    def _param_wd(self, p) -> float:
+        """Per-parameter weight-decay coefficient (0 for excluded params)."""
+        fn = getattr(self, "_apply_decay_param_fun", None)
+        if fn is not None and not fn(p.name or ""):
+            return 0.0
+        return self._weight_decay
+
+    # ---- step ----
+    def step(self):
+        params = [p for p in (self._parameter_list or [])
+                  if not p.stop_gradient and p.grad is not None]
+        if not params:
+            self._step_count += 1
+            if hasattr(self._learning_rate, "step") and False:
+                pass
+            return
+        grads = [p.grad._value if isinstance(p.grad, Tensor) else p.grad for p in params]
+
+        for p in params:
+            if id(p) not in self._accumulators:
+                self._accumulators[id(p)] = self._create_slots(p)
+        slots = [self._accumulators[id(p)] for p in params]
+
+        clip = self._grad_clip
+        wds = tuple(self._param_wd(p) for p in params)
+        need_clip = tuple(getattr(p, "need_clip", True) for p in params)
+        lrs = tuple(p.optimize_attr.get("learning_rate", 1.0) for p in params)
+
+        key = (tuple((tuple(p.shape), str(p.dtype)) for p in params), wds, need_clip, lrs,
+               type(clip).__name__)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._make_update(clip, wds, need_clip, lrs))
+            self._jit_cache[key] = fn
+
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        t = jnp.asarray(self._step_count + 1, jnp.float32)
+        new_vals, new_slots = fn([p._value for p in params], grads, slots, lr, t)
+        for p, v, s in zip(params, new_vals, new_slots):
+            p._value = v
+            self._accumulators[id(p)] = s
+        self._step_count += 1
+
+    def _make_update(self, clip, wds, need_clip, lrs):
+        def update(values, grads, slots, lr, t):
+            grads = [g.astype(jnp.float32) if g.dtype != v.dtype and
+                     jnp.issubdtype(v.dtype, jnp.floating) else g
+                     for g, v in zip(grads, values)]
+            grads = _clip_fn(clip, grads, need_clip)
+            outs, outslots = [], []
+            for v, g, s, wd, plr in zip(values, grads, slots, wds, lrs):
+                nv, ns = self._apply(v, g.astype(v.dtype), s, lr=lr * plr, t=t, wd=wd)
+                outs.append(nv)
+                outslots.append(ns)
+            return outs, outslots
+
+        return update
+
+    def clear_grad(self, set_to_zero=True):
+        for p in (self._parameter_list or []):
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameter_list or [])]
+
+    # ---- state dict ----
+    def state_dict(self):
+        sd = {"step_count": self._step_count, "accumulators": {}}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                acc = self._accumulators.get(id(p))
+                if acc:
+                    sd["accumulators"][p.name or str(i)] = {
+                        k: np.asarray(v) for k, v in acc.items()}
+        if hasattr(self._learning_rate, "state_dict"):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = state_dict.get("step_count", 0)
+        accs = state_dict.get("accumulators", {})
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                key = p.name or str(i)
+                if key in accs:
+                    self._accumulators[id(p)] = {
+                        k: jnp.asarray(v) for k, v in accs[key].items()}
+        if "LR_Scheduler" in state_dict and hasattr(self._learning_rate, "set_state_dict"):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    # functional API for the jitted train-step builder (paddle_tpu.jit)
+    def init_state(self, params):
+        return [self._create_slots(p) for p in params]
+
+    def functional_update(self, values, grads, slots, lr, t, params_meta=None):
+        params = self._parameter_list or []
+        wds = tuple(self._param_wd(p) for p in params) if params else (self._weight_decay,) * len(values)
+        need_clip = tuple(getattr(p, "need_clip", True) for p in params) or (True,) * len(values)
+        fn = self._make_update(self._grad_clip, wds, need_clip,
+                               tuple(p.optimize_attr.get("learning_rate", 1.0) for p in params)
+                               or (1.0,) * len(values))
+        return fn(values, grads, slots, lr, t)
+
+
+def _clip_fn(clip, grads, need_clip):
+    if clip is None:
+        return grads
+    if isinstance(clip, ClipGradByValue):
+        return [jnp.clip(g, clip.min, clip.max) if nc else g
+                for g, nc in zip(grads, need_clip)]
+    if isinstance(clip, ClipGradByNorm):
+        out = []
+        for g, nc in zip(grads, need_clip):
+            if not nc:
+                out.append(g)
+                continue
+            n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            out.append(g * jnp.minimum(clip.clip_norm / jnp.maximum(n, 1e-12), 1.0).astype(g.dtype))
+        return out
+    if isinstance(clip, ClipGradByGlobalNorm):
+        sq = [jnp.sum(g.astype(jnp.float32) ** 2) for g, nc in zip(grads, need_clip) if nc]
+        if not sq:
+            return grads
+        gn = jnp.sqrt(sum(sq))
+        factor = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+        return [g * factor.astype(g.dtype) if nc else g for g, nc in zip(grads, need_clip)]
+    return grads
+
+
+class SGD(Optimizer):
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr.astype(p.dtype) * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_slots(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        v = self._momentum * slots["velocity"] + g
+        if self._use_nesterov:
+            p_new = p - lr.astype(p.dtype) * (g + self._momentum * v)
+        else:
+            p_new = p - lr.astype(p.dtype) * v
+        return p_new, {"velocity": v}

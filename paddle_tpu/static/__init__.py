@@ -1,0 +1,6 @@
+"""Static-graph surface (paddle.static parity) — on TPU, "static graph" is a
+jax-traced program; see paddle_tpu.jit. This module keeps the mode switch and
+InputSpec so `enable_static()`-style code imports cleanly."""
+_STATIC_MODE = [False]
+
+from ..jit.input_spec import InputSpec  # noqa: F401,E402

@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh so sharding/collective
+tests run without TPU hardware (SURVEY.md §4 test strategy).
+
+Note: this image pre-imports jax from sitecustomize with JAX_PLATFORMS=axon
+(the TPU tunnel), so plain env vars are too late — we must go through
+jax.config before the backend is first initialized.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", f"tests must run on CPU, got {jax.default_backend()}"
+assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.device_count()}"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    np.random.seed(0)
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    yield

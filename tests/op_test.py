@@ -1,0 +1,58 @@
+"""OpTest-style harness: numpy oracle + finite-difference gradient checks.
+
+Mirrors the reference's `python/paddle/fluid/tests/unittests/op_test.py:283`
+(check_output / check_grad) for the TPU build.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op, np_ref, arrays, atol=1e-5, rtol=1e-5, kwargs=None):
+    kwargs = kwargs or {}
+    ts = [paddle.to_tensor(a) for a in arrays]
+    out = op(*ts, **kwargs)
+    ref = np_ref(*arrays, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), np.asarray(r), atol=atol, rtol=rtol)
+    return outs
+
+
+def check_grad(op, arrays, kwargs=None, eps=1e-3, atol=1e-2, rtol=1e-2, grad_idx=None):
+    """Compare tape-backward grads against central finite differences of sum(op)."""
+    kwargs = kwargs or {}
+    grad_idx = grad_idx if grad_idx is not None else range(len(arrays))
+
+    ts = [paddle.to_tensor(a.astype("float64") if a.dtype.kind == "f" else a,
+                           dtype="float32", stop_gradient=False) for a in arrays]
+    out = op(*ts, **kwargs)
+    out = out[0] if isinstance(out, (tuple, list)) else out
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+
+    for i in grad_idx:
+        a = arrays[i].astype("float64")
+        num = np.zeros_like(a)
+        it = np.nditer(a, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            ap, am = a.copy(), a.copy()
+            ap[idx] += eps
+            am[idx] -= eps
+
+            def run(arr):
+                args = [paddle.to_tensor(arrays[j].astype("float32")) if j != i
+                        else paddle.to_tensor(arr.astype("float32")) for j in range(len(arrays))]
+                with paddle.no_grad():
+                    o = op(*args, **kwargs)
+                o = o[0] if isinstance(o, (tuple, list)) else o
+                return float(o.sum().numpy())
+
+            num[idx] = (run(ap) - run(am)) / (2 * eps)
+            it.iternext()
+        got = ts[i].gradient()
+        assert got is not None, f"no grad for input {i}"
+        np.testing.assert_allclose(got, num, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch for input {i}")

@@ -1,0 +1,103 @@
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core import autograd
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32")
+
+
+def test_simple_chain():
+    x = paddle.to_tensor(np.array([2.0, 3.0], dtype="float32"), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.gradient(), [4.0, 6.0], rtol=1e-6)
+
+
+def test_branching_accumulation():
+    x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"), stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    ((a + b).sum()).backward()
+    np.testing.assert_allclose(x.gradient(), [5.0, 5.0], rtol=1e-6)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(_r(3), stop_gradient=False)
+    y = paddle.to_tensor(_r(3))  # stop_gradient=True
+    ((x * y).sum()).backward()
+    assert x.gradient() is not None
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor(_r(3), stop_gradient=False)
+    d = (x * 2).detach()
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.gradient(), d.numpy(), rtol=1e-6)
+
+
+def test_grad_accumulates_across_backwards():
+    x = paddle.to_tensor(np.ones(2, dtype="float32"), stop_gradient=False)
+    (x.sum()).backward()
+    (x.sum() * 2).backward()
+    np.testing.assert_allclose(x.gradient(), [3.0, 3.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor(_r(3), stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_tape_freed_after_backward():
+    x = paddle.to_tensor(_r(3), stop_gradient=False)
+    y = (x * 2).sum()
+    before = autograd.tape_size()
+    assert before >= 2
+    y.backward()
+    assert autograd.tape_size() < before
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(np.array([3.0], dtype="float32"), stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0], rtol=1e-6)
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(_r(4), stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    g = x.gradient()
+    assert g.sum() == 2.0 and ((g == 0) | (g == 1)).all()
+
+
+def test_register_hook():
+    x = paddle.to_tensor(np.ones(2, dtype="float32"), stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x.sum()).backward()
+    np.testing.assert_allclose(x.gradient(), [10.0, 10.0])
+
+
+def test_backward_nonscalar_requires_grad_tensor():
+    x = paddle.to_tensor(_r(2, 2), stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.ones([2, 2]))
+    np.testing.assert_allclose(x.gradient(), np.full((2, 2), 2.0), rtol=1e-6)
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(np.array([2.0], dtype="float32"), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.gradient(), [8.0], rtol=1e-6)

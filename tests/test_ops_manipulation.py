@@ -1,0 +1,139 @@
+import numpy as np
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32")
+
+
+def test_reshape_semantics():
+    x = paddle.to_tensor(_r(2, 3, 4))
+    assert paddle.reshape(x, [0, -1]).shape == [2, 12]
+    assert x.reshape([-1]).shape == [24]
+    assert x.reshape([4, 0, 2]).shape == [4, 3, 2]
+
+
+def test_transpose_flatten():
+    a = _r(2, 3, 4)
+    x = paddle.to_tensor(a)
+    np.testing.assert_array_equal(paddle.transpose(x, [2, 0, 1]).numpy(), a.transpose(2, 0, 1))
+    assert paddle.flatten(x, 1, 2).shape == [2, 12]
+    assert paddle.flatten(x).shape == [24]
+
+
+def test_squeeze_unsqueeze():
+    x = paddle.to_tensor(_r(1, 3, 1, 4))
+    assert paddle.squeeze(x).shape == [3, 4]
+    assert paddle.squeeze(x, axis=0).shape == [3, 1, 4]
+    assert paddle.unsqueeze(paddle.to_tensor(_r(3, 4)), [0, 2]).shape == [1, 3, 1, 4]
+
+
+def test_concat_stack_split():
+    a, b = _r(2, 3), _r(2, 3)
+    np.testing.assert_array_equal(
+        paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1).numpy(),
+        np.concatenate([a, b], axis=1))
+    np.testing.assert_array_equal(
+        paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0).numpy(),
+        np.stack([a, b]))
+    parts = paddle.split(paddle.to_tensor(_r(6, 4)), 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 4]
+    parts = paddle.split(paddle.to_tensor(_r(7, 4)), [2, -1, 3], axis=0)
+    assert [p.shape[0] for p in parts] == [2, 2, 3]
+
+
+def test_concat_grad():
+    check_grad(lambda a, b: paddle.concat([a, b], axis=0), [_r(2, 3), _r(1, 3)])
+
+
+def test_gather_scatter():
+    a = _r(5, 3)
+    idx = np.array([0, 2, 4])
+    x = paddle.to_tensor(a)
+    np.testing.assert_array_equal(paddle.gather(x, paddle.to_tensor(idx)).numpy(), a[idx])
+    upd = _r(2, 3)
+    out = paddle.scatter(x, paddle.to_tensor(np.array([1, 3])), paddle.to_tensor(upd))
+    ref = a.copy()
+    ref[[1, 3]] = upd
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_gather_nd():
+    a = _r(3, 4, 5)
+    idx = np.array([[0, 1], [2, 3]])
+    out = paddle.gather_nd(paddle.to_tensor(a), paddle.to_tensor(idx))
+    np.testing.assert_array_equal(out.numpy(), a[[0, 2], [1, 3]])
+
+
+def test_tile_expand_pad():
+    a = _r(2, 3)
+    x = paddle.to_tensor(a)
+    np.testing.assert_array_equal(paddle.tile(x, [2, 1]).numpy(), np.tile(a, (2, 1)))
+    assert paddle.expand(x, [4, 2, 3]).shape == [4, 2, 3]
+    out = paddle.nn_pad if False else paddle.pad(x, [1, 1], value=9.0)
+    ref = np.pad(a, [(0, 0), (1, 1)], constant_values=9.0)
+    np.testing.assert_array_equal(out.numpy(), ref)
+
+
+def test_getitem_setitem():
+    a = _r(4, 5)
+    x = paddle.to_tensor(a)
+    np.testing.assert_array_equal(x[1:3, ::2].numpy(), a[1:3, ::2])
+    np.testing.assert_array_equal(x[paddle.to_tensor(np.array([0, 2]))].numpy(), a[[0, 2]])
+    x[0, 0] = 42.0
+    assert float(x[0, 0]) == 42.0
+    mask = a > 0.5
+    np.testing.assert_array_equal(x[1:].numpy(), x.numpy()[1:])
+
+
+def test_getitem_grad():
+    check_grad(lambda x: x[1:, :2], [_r(3, 4)])
+
+
+def test_where_masked_fill():
+    a, b = _r(3, 3), _r(3, 3)
+    c = a > 0.5
+    out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_array_equal(out.numpy(), np.where(c, a, b))
+    mf = paddle.masked_fill(paddle.to_tensor(a), paddle.to_tensor(c), -1.0)
+    np.testing.assert_array_equal(mf.numpy(), np.where(c, -1.0, a))
+
+
+def test_cast():
+    x = paddle.to_tensor(_r(2, 2))
+    assert x.astype("int32").dtype == np.dtype("int32")
+    assert x.astype(paddle.bfloat16).dtype.itemsize == 2
+
+
+def test_flip_roll():
+    a = _r(3, 4)
+    np.testing.assert_array_equal(paddle.flip(paddle.to_tensor(a), [0]).numpy(), a[::-1])
+    np.testing.assert_array_equal(paddle.roll(paddle.to_tensor(a), 1, 0).numpy(),
+                                  np.roll(a, 1, 0))
+
+
+def test_take_put_along_axis():
+    a = _r(3, 4)
+    idx = np.argsort(a, axis=1)
+    out = paddle.take_along_axis(paddle.to_tensor(a), paddle.to_tensor(idx), 1)
+    np.testing.assert_array_equal(out.numpy(), np.take_along_axis(a, idx, 1))
+
+
+def test_unique_nonzero():
+    a = np.array([1, 3, 1, 2, 3])
+    u = paddle.unique(paddle.to_tensor(a))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+    nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+def test_inplace_autograd():
+    # y = x*2 (inplace-scaled) then consumed: grad must flow through the rebind
+    x = paddle.to_tensor(_r(2, 2), stop_gradient=False)
+    y = x * 1.0
+    y.scale_(2.0)
+    z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.gradient(), np.full((2, 2), 2.0), rtol=1e-6)

@@ -1,0 +1,108 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32") + 0.1
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.add, np.add), (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+    ])
+    def test_output(self, op, ref):
+        check_output(op, ref, [_r(3, 4), _r(3, 4)])
+        check_output(op, ref, [_r(3, 4), _r(4)])  # broadcast
+
+    def test_grad(self):
+        check_grad(paddle.multiply, [_r(2, 3), _r(2, 3)])
+        check_grad(paddle.divide, [_r(2, 3), _r(2, 3)])
+
+    def test_scalar_rhs(self):
+        x = paddle.to_tensor(_r(2, 2))
+        np.testing.assert_allclose((x + 1.5).numpy(), x.numpy() + 1.5, rtol=1e-6)
+        np.testing.assert_allclose((2 ** x).numpy(), 2 ** x.numpy(), rtol=1e-5)
+        np.testing.assert_allclose((1 - x).numpy(), 1 - x.numpy(), rtol=1e-6)
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.exp, np.exp), (paddle.log, np.log), (paddle.sqrt, np.sqrt),
+        (paddle.tanh, np.tanh), (paddle.abs, np.abs), (paddle.floor, np.floor),
+        (paddle.sin, np.sin), (paddle.cos, np.cos), (paddle.square, np.square),
+    ])
+    def test_output(self, op, ref):
+        # XLA CPU's f32 transcendental approximations differ from libm by ~1e-4
+        check_output(op, ref, [_r(4, 5)], atol=5e-4, rtol=5e-4)
+
+    def test_grad(self):
+        check_grad(paddle.exp, [_r(3, 3)])
+        check_grad(paddle.tanh, [_r(3, 3)])
+        check_grad(paddle.sqrt, [_r(3, 3) + 0.5])
+
+
+class TestMatmul:
+    def test_2d(self):
+        check_output(paddle.matmul, np.matmul, [_r(3, 4), _r(4, 5)], atol=1e-4)
+
+    def test_batched(self):
+        check_output(paddle.matmul, np.matmul, [_r(2, 3, 4), _r(2, 4, 5)], atol=1e-4)
+
+    def test_transpose_flags(self):
+        a, b = _r(4, 3), _r(4, 5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-4, atol=1e-4)
+
+    def test_grad(self):
+        check_grad(paddle.matmul, [_r(3, 4), _r(4, 2)])
+
+
+class TestReductions:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.sum, np.sum), (paddle.mean, np.mean),
+        (paddle.max, np.max), (paddle.min, np.min), (paddle.prod, np.prod),
+    ])
+    def test_full(self, op, ref):
+        check_output(op, ref, [_r(3, 4)], atol=1e-4)
+
+    def test_axis_keepdim(self):
+        x = _r(2, 3, 4)
+        out = paddle.sum(paddle.to_tensor(x), axis=[1, 2], keepdim=True)
+        np.testing.assert_allclose(out.numpy(), x.sum(axis=(1, 2), keepdims=True), rtol=1e-5)
+
+    def test_grad(self):
+        check_grad(paddle.mean, [_r(3, 4)])
+        check_grad(lambda x: paddle.sum(x, axis=1), [_r(3, 4)])
+
+    def test_cumsum(self):
+        x = _r(3, 4)
+        np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.cumsum(x, axis=1), rtol=1e-5)
+
+    def test_logsumexp(self):
+        x = _r(3, 4)
+        ref = np.log(np.exp(x).sum())
+        np.testing.assert_allclose(paddle.logsumexp(paddle.to_tensor(x)).numpy(), ref, rtol=1e-5)
+
+    def test_cummax(self):
+        x = np.array([[1.0, 3.0, 2.0, 5.0, 4.0]], dtype="float32")
+        v, i = paddle.cummax(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(v.numpy(), [[1, 3, 3, 5, 5]])
+        np.testing.assert_array_equal(i.numpy(), [[0, 1, 1, 3, 3]])
+
+
+class TestClipScale:
+    def test_clip(self):
+        check_output(lambda x: paddle.clip(x, 0.3, 0.7),
+                     lambda x: np.clip(x, 0.3, 0.7), [_r(3, 3)])
+
+    def test_scale(self):
+        x = _r(2, 2)
+        out = paddle.scale(paddle.to_tensor(x), scale=2.0, bias=1.0)
+        np.testing.assert_allclose(out.numpy(), x * 2 + 1, rtol=1e-6)

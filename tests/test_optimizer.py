@@ -1,0 +1,108 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32")
+
+
+def _quad_problem(opt_cls, steps=60, **kw):
+    """Minimise ||Wx - y||^2; returns loss trajectory."""
+    np.random.seed(1)
+    lin = nn.Linear(4, 4, bias_attr=False)
+    x = paddle.to_tensor(_r(16, 4))
+    y = paddle.to_tensor(_r(16, 4))
+    opt = opt_cls(parameters=lin.parameters(), **kw)
+    losses = []
+    for _ in range(steps):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (paddle.optimizer.SGD, {"learning_rate": 0.1}),
+    (paddle.optimizer.Momentum, {"learning_rate": 0.05, "momentum": 0.9}),
+    (paddle.optimizer.Adam, {"learning_rate": 0.05}),
+    (paddle.optimizer.AdamW, {"learning_rate": 0.05, "weight_decay": 0.01}),
+    (paddle.optimizer.Lamb, {"learning_rate": 0.05}),
+    (paddle.optimizer.RMSProp, {"learning_rate": 0.01}),
+    (paddle.optimizer.Adagrad, {"learning_rate": 0.1}),
+    (paddle.optimizer.Adadelta, {"learning_rate": 1.0, "steps": 250}),
+    (paddle.optimizer.Adamax, {"learning_rate": 0.05}),
+])
+def test_optimizers_descend(cls, kw):
+    kw = dict(kw)
+    steps = kw.pop("steps", 60)
+    losses = _quad_problem(cls, steps=steps, **kw)
+    assert losses[-1] < losses[0] * 0.5, f"{cls.__name__}: {losses[0]} -> {losses[-1]}"
+
+
+def test_adam_matches_reference_formula():
+    p0 = np.array([1.0, -2.0], dtype="float32")
+    g = np.array([0.5, 0.3], dtype="float32")
+    p = paddle.Parameter(p0.copy())
+    p.grad = paddle.to_tensor(g)._value
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p])
+    opt.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    ref = p0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), ref, rtol=1e-5)
+
+
+def test_global_norm_clip():
+    p = paddle.Parameter(np.zeros(4, dtype="float32"))
+    p.grad = paddle.to_tensor(np.full(4, 10.0, dtype="float32"))._value
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    opt.step()
+    # grad norm 20 clipped to 1 -> update each = 10/20
+    np.testing.assert_allclose(p.numpy(), -np.full(4, 0.5), rtol=1e-5)
+
+
+def test_lr_scheduler_drives_optimizer():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.1)
+    p = paddle.Parameter(np.array([1.0], dtype="float32"))
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.01) < 1e-9
+
+
+def test_noam_and_warmup():
+    s = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+    lrs = [s.step() for _ in range(20)]
+    assert np.argmax(lrs) in (8, 9, 10)
+    w = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    w_lrs = [w.step() for _ in range(8)]
+    assert w_lrs[-1] == pytest.approx(0.1)
+
+
+def test_optimizer_state_dict_roundtrip():
+    lin = nn.Linear(3, 3)
+    opt = paddle.optimizer.Adam(parameters=lin.parameters(), learning_rate=0.01)
+    x = paddle.to_tensor(_r(4, 3))
+    (lin(x).sum()).backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(parameters=lin.parameters(), learning_rate=0.01)
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+
+
+def test_minimize_api():
+    lin = nn.Linear(3, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    loss = (lin(paddle.to_tensor(_r(2, 3))) ** 2).mean()
+    opt.minimize(loss)
+    assert lin.weight.grad is not None
