@@ -1,0 +1,5 @@
+"""paddle.amp parity namespace (bf16-first on TPU)."""
+from .state import auto_cast, decorate, amp_enabled, amp_state, maybe_cast  # noqa: F401
+from .grad_scaler import GradScaler  # noqa: F401
+
+amp_guard = auto_cast  # fluid alias

@@ -1,0 +1,89 @@
+"""AMP autocast state, consumed by matmul/conv/linear dispatch.
+
+Reference parity: `imperative/amp_auto_cast.cc` (tracer-hooked input casting
+with white/black lists). TPU-first: bf16 is the default low precision (MXU
+native, no loss scaling needed); fp16 supported for parity.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+
+# ops cast to low precision (white list — matmul-class, reference
+# fluid/contrib/mixed_precision/fp16_lists.py white_list)
+WHITE_LIST = {"matmul", "conv2d", "linear", "einsum", "bmm", "mm", "attention"}
+# ops kept in fp32 (black list: softmax_with_cross_entropy, norms, exp, …)
+BLACK_LIST = {"cross_entropy", "softmax", "log_softmax", "layer_norm", "batch_norm",
+              "mean", "sum", "exp", "log"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_STATE = _AmpState()
+
+
+def amp_state():
+    return _STATE
+
+
+def amp_enabled() -> bool:
+    return _STATE.enabled
+
+
+def maybe_cast(*arrays):
+    """Cast floating arrays to the autocast dtype when AMP is active (white-list op)."""
+    if not _STATE.enabled:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(a.astype(_STATE.dtype)
+                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                and a.dtype != _STATE.dtype else a
+                for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+class auto_cast:
+    """paddle.amp.auto_cast parity (context manager / decorator)."""
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None,
+                 level="O1", dtype="bfloat16"):
+        self.enable = enable
+        self.level = level
+        self.dtype = convert_dtype(dtype)
+
+    def __enter__(self):
+        self._prev = (_STATE.enabled, _STATE.dtype, _STATE.level)
+        _STATE.enabled = self.enable
+        _STATE.dtype = self.dtype
+        _STATE.level = self.level
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled, _STATE.dtype, _STATE.level = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **kw):
+            with auto_cast(self.enable, level=self.level, dtype=str(self.dtype)):
+                return fn(*a, **kw)
+        return wrapper
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model parameters to low precision (paddle.amp.decorate)."""
+    dt = convert_dtype(dtype)
+    items = models if isinstance(models, (list, tuple)) else [models]
+    for m in items:
+        if m is not None:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models if len(items) > 1 else items[0]
+    return (models, optimizers)

@@ -27,7 +27,8 @@ _ops = None  # set by paddle_tpu.ops at import time (monkey_patch_varbase parity
 
 
 class Tensor:
-    __slots__ = ("_value", "stop_gradient", "grad", "_node", "name", "persistable", "_hooks")
+    __slots__ = ("_value", "stop_gradient", "grad", "_node", "name", "persistable",
+                 "_hooks", "dist_attr")
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
@@ -41,6 +42,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._hooks = []
+        self.dist_attr = None  # PartitionSpec-like tuple for SPMD placement
 
     # ---- metadata ----
     @property

@@ -1,0 +1,4 @@
+"""paddle.distributed parity namespace — implemented by paddle_tpu.parallel.
+This module re-exports it so user code can `import paddle.distributed`."""
+from ..parallel import *  # noqa: F401,F403
+from ..parallel import fleet  # noqa: F401

@@ -1,0 +1,162 @@
+"""paddle.distribution parity: Normal/Uniform/Categorical/Bernoulli/... .
+
+Reference parity: `python/paddle/distribution/` (Distribution base with
+sample/log_prob/entropy/kl_divergence). log_prob/entropy route through the
+autograd tape (run_op) so dygraph gradients flow to distribution parameters.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as rnd
+from ..core.tensor import Tensor
+from ..ops._dispatch import ensure_tensor, run_op, to_arr
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def probs(self, value):
+        import paddle_tpu as paddle
+        return paddle.exp(self.log_prob(value))
+
+
+def _t(x):
+    return ensure_tensor(x, dtype=jnp.float32) if not isinstance(x, Tensor) else x
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(tuple(self.loc.shape),
+                                                        tuple(self.scale.shape)))
+        z = jax.random.normal(rnd.next_key(), shp)
+        return run_op(lambda m, s: m + s * z, [self.loc, self.scale], "normal_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(
+            lambda m, s, x: -((x - m) ** 2) / (2 * s * s) - jnp.log(s)
+            - 0.5 * math.log(2 * math.pi),
+            [self.loc, self.scale, v], "normal_log_prob")
+
+    def entropy(self):
+        return run_op(
+            lambda m, s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+            + jnp.zeros_like(m),
+            [self.loc, self.scale], "normal_entropy")
+
+    def kl_divergence(self, other):
+        return run_op(
+            lambda m1, s1, m2, s2: 0.5 * ((s1 / s2) ** 2 + ((m1 - m2) / s2) ** 2
+                                          - 1 - 2 * jnp.log(s1 / s2)),
+            [self.loc, self.scale, other.loc, other.scale], "normal_kl")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(tuple(self.low.shape),
+                                                        tuple(self.high.shape)))
+        u = jax.random.uniform(rnd.next_key(), shp)
+        return run_op(lambda lo, hi: lo + (hi - lo) * u, [self.low, self.high],
+                      "uniform_sample")
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(
+            lambda lo, hi, x: jnp.where((x >= lo) & (x < hi), -jnp.log(hi - lo),
+                                        -jnp.inf),
+            [self.low, self.high, v], "uniform_log_prob")
+
+    def entropy(self):
+        return run_op(lambda lo, hi: jnp.log(hi - lo), [self.low, self.high],
+                      "uniform_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _t(probs)
+
+    def sample(self, shape=()):
+        p = self.probs_._value
+        shp = tuple(shape) + tuple(p.shape)
+        return Tensor(jax.random.bernoulli(rnd.next_key(), p, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(
+            lambda p, x: x * jnp.log(jnp.clip(p, 1e-7, 1 - 1e-7))
+            + (1 - x) * jnp.log1p(-jnp.clip(p, 1e-7, 1 - 1e-7)),
+            [self.probs_, v], "bernoulli_log_prob")
+
+    def entropy(self):
+        def f(p):
+            pc = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(pc * jnp.log(pc) + (1 - pc) * jnp.log1p(-pc))
+
+        return run_op(f, [self.probs_], "bernoulli_entropy")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+
+    def sample(self, shape=()):
+        lg = self.logits._value
+        return Tensor(jax.random.categorical(rnd.next_key(), lg,
+                                             shape=tuple(shape) + tuple(lg.shape[:-1])))
+
+    def log_prob(self, value):
+        ids = ensure_tensor(value)._value.astype(jnp.int32)
+
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
+
+        return run_op(f, [self.logits], "categorical_log_prob")
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+        return run_op(f, [self.logits], "categorical_entropy")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _t(probs)
+
+    def sample(self, shape=()):
+        p = self.probs_._value
+        draws = jax.random.categorical(
+            rnd.next_key(), jnp.log(p), shape=tuple(shape) + (self.total_count,))
+        k = p.shape[-1]
+        return Tensor(jax.nn.one_hot(draws, k).sum(axis=-2))
+
+
+def kl_divergence(p, q):
+    if hasattr(p, "kl_divergence"):
+        return p.kl_divergence(q)
+    raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")

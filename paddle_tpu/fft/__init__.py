@@ -1,0 +1,56 @@
+"""paddle.fft parity over jnp.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops._dispatch import ensure_tensor, run_op
+
+
+def _wrap1(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return run_op(lambda a: jfn(a, n=n, axis=axis, norm=norm), [ensure_tensor(x)], name)
+
+    op.__name__ = name
+    return op
+
+
+def _wrapn(jfn, name):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return run_op(lambda a: jfn(a, s=s, axes=axes, norm=norm), [ensure_tensor(x)], name)
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+fft2 = _wrapn(lambda a, s=None, axes=None, norm=None: jnp.fft.fft2(a, s=s, axes=axes or (-2, -1), norm=norm), "fft2")
+ifft2 = _wrapn(lambda a, s=None, axes=None, norm=None: jnp.fft.ifft2(a, s=s, axes=axes or (-2, -1), norm=norm), "ifft2")
+rfft2 = _wrapn(lambda a, s=None, axes=None, norm=None: jnp.fft.rfft2(a, s=s, axes=axes or (-2, -1), norm=norm), "rfft2")
+irfft2 = _wrapn(lambda a, s=None, axes=None, norm=None: jnp.fft.irfft2(a, s=s, axes=axes or (-2, -1), norm=norm), "irfft2")
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from ..core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from ..core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return run_op(lambda a: jnp.fft.fftshift(a, axes=axes), [ensure_tensor(x)], "fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return run_op(lambda a: jnp.fft.ifftshift(a, axes=axes), [ensure_tensor(x)], "ifftshift")

@@ -1,7 +1,76 @@
-# placeholder; real paddle.save/load lands with the checkpoint milestone
-def save(obj, path, **kw):
-    raise NotImplementedError
+"""paddle.save / paddle.load — object checkpointing.
+
+Reference parity: `python/paddle/framework/io.py:562,778` (pickle + per-
+tensor payloads; handles Layer state_dict and optimizer state). Tensors are
+stored as numpy inside an npz sidecar to keep the pickle small and portable.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
 
 
-def load(path, **kw):
-    raise NotImplementedError
+class _TensorRef:
+    def __init__(self, key, is_param, name):
+        self.key, self.is_param, self.name = key, is_param, name
+
+
+def _pack(obj, store, prefix=""):
+    if isinstance(obj, Tensor):
+        key = f"t{len(store)}"
+        store[key] = np.asarray(obj._value)
+        return _TensorRef(key, isinstance(obj, Parameter), obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v, store) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_pack(v, store) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    if isinstance(obj, jnp.ndarray):
+        key = f"t{len(store)}"
+        store[key] = np.asarray(obj)
+        return _TensorRef(key, False, None)
+    return obj
+
+
+def _unpack(obj, store, return_numpy=False):
+    if isinstance(obj, _TensorRef):
+        arr = store[obj.key]
+        if return_numpy:
+            return arr
+        t = Parameter(jnp.asarray(arr), name=obj.name) if obj.is_param else \
+            Tensor(jnp.asarray(arr), name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, store, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_unpack(v, store, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    store = {}
+    packed = _pack(obj, store)
+    buf = _io.BytesIO()
+    np.savez(buf, **store)
+    with open(path, "wb") as f:
+        pickle.dump({"__paddle_tpu__": 1, "obj": packed, "npz": buf.getvalue()},
+                    f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    if not (isinstance(blob, dict) and "__paddle_tpu__" in blob):
+        return blob  # plain pickle fallback
+    store = dict(np.load(_io.BytesIO(blob["npz"]), allow_pickle=False))
+    return _unpack(blob["obj"], store, return_numpy)

@@ -1,8 +1,204 @@
-# placeholder; real hapi.Model lands with the training API milestone
+"""hapi.Model — Keras-like fit/evaluate/predict.
+
+Reference parity: `python/paddle/hapi/model.py:906 (fit), 1556 (evaluate),
+1786 (predict), 1889 (save)`. TPU-first: `fit` drives the jitted TrainStep
+(one XLA program per step — forward+backward+update), not op-by-op dygraph.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from .callbacks import config_callbacks
+
+
 class Model:
-    def __init__(self, *a, **kw):
-        raise NotImplementedError("hapi.Model arrives after nn/optimizer")
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics is not None else [])
+        amp_dtype = None
+        if amp_configs:
+            level = amp_configs.get("level", "O1") if isinstance(amp_configs, dict) \
+                else amp_configs
+            if level in ("O1", "O2"):
+                amp_dtype = amp_configs.get("dtype", "bfloat16") if \
+                    isinstance(amp_configs, dict) else "bfloat16"
+        if optimizer is not None and loss is not None:
+            from ..jit.train_step import TrainStep
+            self._train_step = TrainStep(self.network, loss, optimizer,
+                                         amp_dtype=amp_dtype)
+        return self
+
+    def _as_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        if self._train_step is not None and update:
+            self._train_step._n_model_inputs = len(inputs)
+            loss = self._train_step(*inputs, *(labels or []))
+            return float(loss.numpy())
+        out = self.network(*inputs)
+        loss = self._loss(out, *(labels or []))
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return float(loss.numpy())
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        out = self.network(*inputs)
+        loss = self._loss(out, *(labels or [])) if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            r = m.compute(out, *(labels or []))
+            m.update(*r) if isinstance(r, tuple) else m.update(r)
+            metrics.append(m.accumulate())
+        return (float(loss.numpy()) if loss is not None else None), metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core.autograd import no_grad
+        with no_grad():
+            out = self.network(*inputs)
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbs = config_callbacks(callbacks, self, epochs, steps, log_freq, verbose,
+                               save_freq, save_dir,
+                               metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        for cb in cbs:
+            cb.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                for cb in cbs:
+                    cb.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                logs = {"loss": loss}
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+                it += 1
+                if (num_iters and it >= num_iters) or self.stop_training:
+                    break
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0, num_workers=num_workers)
+                for cb in cbs:
+                    cb.on_eval_end(eval_logs)
+            if (num_iters and it >= num_iters) or self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end(logs)
+        return self
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        if isinstance(batch, (list, tuple)):
+            return list(batch), None
+        return [batch], None
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._as_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            loss, _ = self.eval_batch(inputs, labels)
+            if loss is not None:
+                losses.append(loss)
+        logs = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            name = m.name()
+            logs[name if isinstance(name, str) else name[0]] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            inputs = batch if not isinstance(batch, (list, tuple)) else batch[0]
+            outputs.append(self.predict_batch(inputs))
+        return outputs
+
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        if training:
+            fsave(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                fsave(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            raise ValueError("inference save requires input_spec: use paddle.jit.save")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary_str(self.network)
+
+
+def summary_str(network):
+    lines = []
+    total = 0
+    for name, p in network.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        lines.append(f"{name:60s} {str(p.shape):24s} {n:>12,d}")
+    lines.append(f"{'Total params:':60s} {'':24s} {total:>12,d}")
+    return "\n".join(lines)
 
 
 def summary(net, input_size=None, dtypes=None):
-    raise NotImplementedError
+    s = summary_str(net)
+    print(s)
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    return {"total_params": total, "trainable_params":
+            sum(int(np.prod(p.shape)) for p in net.parameters() if not p.stop_gradient)}

@@ -1,0 +1,2 @@
+"""paddle.incubate parity surface (experimental APIs live elsewhere in this
+build; kept for import compatibility)."""

@@ -1,0 +1,160 @@
+"""paddle.inference parity: Config + Predictor.
+
+Reference parity: `paddle/fluid/inference/api/analysis_predictor.cc`
+(AnalysisPredictor: load → optimize program → ZeroCopyRun) and
+`paddle_analysis_config.h`. TPU-native: the "optimized program" IS the XLA
+executable — jit.save's exported StableHLO artifact (or a live Layer traced
+on the fly); ir-pass fusion work is done by XLA. ZeroCopyTensor maps to
+device arrays handed across with no host copy.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    """AnalysisConfig parity (device/precision knobs that matter on TPU)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._device = "tpu"
+        self._precision = "float32"
+        self._memory_optim = True
+
+    # paddle API spellings
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # gpu requests route to the accelerator
+
+    def enable_tpu(self):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def enable_mkldnn(self):
+        pass
+
+    def enable_tensorrt_engine(self, workspace_size=1 << 30, max_batch_size=1,
+                               min_subgraph_size=3, precision_mode="float32",
+                               use_static=False, use_calib_mode=False):
+        # TRT subgraphs ⇒ XLA whole-graph; accept precision hint
+        self._precision = precision_mode if isinstance(precision_mode, str) else "float16"
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def precision(self):
+        return self._precision
+
+
+class PredictorTensor:
+    """ZeroCopyTensor parity — a named input/output slot."""
+
+    def __init__(self, predictor, name, is_input):
+        self._pred = predictor
+        self.name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._pred._feeds[self.name] = jnp.asarray(np.asarray(arr))
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self):
+        return np.asarray(self._pred._results[self.name])
+
+    def share_external_data(self, tensor):
+        self._pred._feeds[self.name] = tensor._value if isinstance(tensor, Tensor) else tensor
+
+
+class Predictor:
+    def __init__(self, config_or_layer, input_spec=None):
+        self._feeds = {}
+        self._results = {}
+        self._fn = None
+        self._input_names = []
+        self._output_names = []
+        if isinstance(config_or_layer, Config):
+            cfg = config_or_layer
+            from ..jit.save_load import load as jload
+            path = cfg.model_path
+            if path.endswith(".pdmodel"):
+                path = path[:-len(".pdmodel")]
+            self._translated = jload(path)
+            n_in = len(self._translated._meta["input_specs"])
+            self._input_names = [f"input_{i}" for i in range(n_in)]
+            self._bf16 = cfg.precision() in ("float16", "bfloat16", "half")
+        else:
+            layer = config_or_layer
+            layer.eval()
+            self._translated = None
+            self._layer = layer
+            self._input_spec = input_spec
+            self._input_names = [f"input_{i}" for i in range(len(input_spec or [1]))]
+            self._bf16 = False
+        self._output_names = ["output_0"]
+
+    # --- paddle.inference API ---
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_input_handle(self, name):
+        return PredictorTensor(self, name, True)
+
+    def get_output_handle(self, name):
+        return PredictorTensor(self, name, False)
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(np.asarray(a))
+                    for a in inputs]
+        else:
+            arrs = [self._feeds[n] for n in self._input_names]
+        if self._bf16:
+            arrs = [a.astype(jnp.bfloat16) if jnp.issubdtype(a.dtype, jnp.floating) else a
+                    for a in arrs]
+        if self._translated is not None:
+            out = self._translated(*arrs)
+        else:
+            if self._fn is None:
+                from ..jit.to_static import to_static
+                self._fn = to_static(self._layer.forward)
+            from ..core.autograd import no_grad
+            with no_grad():
+                out = self._fn(*[Tensor(a) for a in arrs])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        outs = [o._value.astype(jnp.float32) if jnp.issubdtype(o._value.dtype, jnp.bfloat16)
+                else o._value for o in outs]
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._results = dict(zip(self._output_names, outs))
+        if inputs is not None:
+            return [Tensor(o) for o in outs]
+        return None
+
+    # ZeroCopyRun parity
+    zero_copy_run = run
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]

@@ -1,0 +1,5 @@
+"""paddle.jit parity: to_static capture, jitted train step, save/load."""
+from .input_spec import InputSpec  # noqa: F401
+from .to_static import StaticFunction, declarative, not_to_static, to_static  # noqa: F401
+from .train_step import TrainStep  # noqa: F401
+from .save_load import TranslatedLayer, load, save  # noqa: F401

@@ -62,9 +62,7 @@ class TrainStep:
                     loss = loss_fn(*outs, *[Tensor(l) for l in labels])
                     return loss._value if isinstance(loss, Tensor) else loss
 
-                loss, grads = jax.value_and_grad(fwd)(run_params)
-                if amp_dtype is not None:
-                    grads = [g.astype(p.dtype) for g, p in zip(grads, params)]
+                loss, grads = jax.value_and_grad(fwd)(params)
                 new_params, new_slots = optimizer.functional_update(params, grads, slots, lr, t)
                 return new_params, new_slots, loss
             finally:

@@ -1,0 +1,1 @@
+"""Model zoo (filled by the models milestone)."""

@@ -16,14 +16,22 @@ from ...ops.math import _precision
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with W shaped [in, out] (paddle convention)."""
+    from ...amp.state import maybe_cast
     x, weight = ensure_tensor(x), ensure_tensor(weight)
     if bias is not None:
         bias = ensure_tensor(bias)
-        return run_op(
-            lambda a, w, b: jnp.matmul(a, w, precision=_precision()) + b,
-            [x, weight, bias], "linear")
-    return run_op(lambda a, w: jnp.matmul(a, w, precision=_precision()),
-                  [x, weight], "linear")
+
+        def f(a, w, b):
+            a, w, b = maybe_cast(a, w, b)
+            return jnp.matmul(a, w, precision=_precision()) + b
+
+        return run_op(f, [x, weight, bias], "linear")
+
+    def f2(a, w):
+        a, w = maybe_cast(a, w)
+        return jnp.matmul(a, w, precision=_precision())
+
+    return run_op(f2, [x, weight], "linear")
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):

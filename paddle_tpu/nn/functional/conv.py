@@ -52,6 +52,9 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
     dn = _dims(nd, channel_last)
 
     def f(a, w, *rest):
+        from ...amp.state import maybe_cast
+        a, w = maybe_cast(a, w)
+        rest = tuple(maybe_cast(r) for r in rest)
         if channel_last:
             # weights stay OIHW (paddle layout); lax wants HWIO for NHWC
             perm = list(range(2, 2 + nd)) + [1, 0]

@@ -14,11 +14,15 @@ from ._dispatch import ensure_tensor, run_op, to_arr
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    import jax
     dtype = convert_dtype(dtype)
     if isinstance(data, Tensor):
         arr = data._value
         if dtype is not None and arr.dtype != dtype:
             arr = arr.astype(dtype)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        arr = data if dtype is None or data.dtype == dtype else data.astype(dtype)
         return Tensor(arr, stop_gradient=stop_gradient)
     if dtype is None:
         a = np.asarray(data)

@@ -136,6 +136,8 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     x, y = ensure_tensor(x), ensure_tensor(y)
 
     def f(a, b):
+        from ..amp.state import maybe_cast
+        a, b = maybe_cast(a, b)
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:

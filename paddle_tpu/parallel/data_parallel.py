@@ -1,0 +1,46 @@
+"""DataParallel wrapper.
+
+Reference parity: `python/paddle/fluid/dygraph/parallel.py:400` (DataParallel
+→ C++ Reducer bucketed allreduce overlapped with backward).
+
+TPU-native: in the single-controller model there are no per-rank replicas to
+reduce across eagerly — data parallelism is batch sharding over the 'dp'
+mesh axis inside the jitted step, with XLA fusing the gradient all-reduce
+into the backward (the Reducer's overlap, done by the compiler). The wrapper
+therefore (a) passes forward through unchanged for eager use, and (b) marks
+the model so TrainStep/SPMDTrainStep shard the batch.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .topology import get_mesh
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, hcg=None,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.hcg = hcg
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, state_dict, *a, **kw):
+        return self._layers.set_state_dict(state_dict, *a, **kw)
+
+    def scale_loss(self, loss):
+        return loss  # grads averaged inside the jitted step (pmean semantics)
+
+    def apply_collective_grads(self):
+        pass  # XLA inserts the collective in the compiled backward
+
+    @property
+    def _inner_layers(self):
+        return self._layers

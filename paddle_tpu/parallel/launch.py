@@ -1,0 +1,75 @@
+"""Distributed launcher: `python -m paddle_tpu.distributed.launch train.py`.
+
+Reference parity: `python/paddle/distributed/fleet/launch.py:523` (launch →
+launch_collective:380 → start_local_trainers with PADDLE_* env).
+
+TPU-native process model: ONE process per HOST (chips inside a host are
+addressed by the mesh, not by processes), so on a single host the launcher
+simply execs the script with rank env set; multi-host launch sets the
+coordinator address for jax.distributed. `--nproc_per_node` is accepted for
+CPU-mesh simulation (spawns N processes with a device-count override).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int, default=int(os.environ.get("PADDLE_NNODES", 1)))
+    p.add_argument("--node_rank", type=int, default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER", "127.0.0.1:6170"))
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--devices", "--gpus", dest="devices", default=None)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch():
+    args = _parse()
+    base_env = dict(os.environ)
+    base_env["PADDLE_MASTER"] = args.master
+    base_env["PADDLE_TRAINERS_NUM"] = str(args.nnodes * args.nproc_per_node)
+
+    if args.nproc_per_node == 1:
+        os.environ.update(base_env)
+        os.environ["PADDLE_TRAINER_ID"] = str(args.node_rank)
+        os.environ["PADDLE_CURRENT_ENDPOINT"] = args.master if args.node_rank == 0 \
+            else f"127.0.0.1:{6171 + args.node_rank}"
+        sys.argv = [args.training_script] + args.training_script_args
+        runpy.run_path(args.training_script, run_name="__main__")
+        return
+
+    # multi-process simulation (CPU mesh per process)
+    procs = []
+    for local in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local
+        env = dict(base_env)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_RANK_IN_NODE"] = str(local)
+        env["PADDLE_CURRENT_ENDPOINT"] = f"127.0.0.1:{6171 + rank}"
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(
+            f"127.0.0.1:{6171 + r}" for r in range(args.nnodes * args.nproc_per_node))
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            out = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+        else:
+            out = None
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script] + args.training_script_args,
+            env=env, stdout=out, stderr=subprocess.STDOUT if out else None))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

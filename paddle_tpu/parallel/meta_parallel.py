@@ -1,0 +1,31 @@
+"""TensorParallel / ShardingParallel model wrappers.
+
+Reference parity: `fleet/meta_parallel/tensor_parallel.py` and
+`meta_parallel/sharding/*`. On TPU these wrappers carry the mesh + stage
+config; the actual partitioning happens in SPMDTrainStep via the sharding
+specs that mp_layers put on their weights.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.hcg = hcg
+        self.strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)
+
+
+ShardingParallel = TensorParallel

@@ -1,0 +1,64 @@
+"""MoE expert-parallel alltoall utilities.
+
+Reference parity: `operators/collective/global_scatter_op.cc` /
+`global_gather_op.cc` + python wrappers (`distributed/utils.py:52-129`).
+TPU-native: expert dispatch is `lax.all_to_all` over the 'mp' (or dedicated
+'ep') axis inside an SPMD region, with capacity-bucketed dense tensors
+(static shapes for XLA) instead of LoD-style variable counts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..ops._dispatch import ensure_tensor, run_op
+from .collective import _in_spmd
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    t = ensure_tensor(x)
+    ax = group if isinstance(group, str) else "mp"
+    if _in_spmd(ax):
+        return run_op(lambda a: lax.all_to_all(a, ax, 0, 0, tiled=True), [t],
+                      "global_scatter")
+    return t
+
+
+def global_gather(x, local_count, global_count, group=None):
+    t = ensure_tensor(x)
+    ax = group if isinstance(group, str) else "mp"
+    if _in_spmd(ax):
+        return run_op(lambda a: lax.all_to_all(a, ax, 0, 0, tiled=True), [t],
+                      "global_gather")
+    return t
+
+
+def moe_dispatch(x, gate_logits, num_experts, capacity_factor=1.25, axis_name="ep"):
+    """Top-1 switch routing with static capacity (call inside shard_map).
+
+    x: [tokens, d]; returns (expert_inputs [E_local, capacity, d], combine info).
+    """
+    tokens, d = x.shape
+    capacity = int(capacity_factor * tokens / num_experts)
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # position of each token within its expert bucket
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
+    pos_in_expert = jnp.sum(pos, axis=-1)
+    keep = pos_in_expert < capacity
+
+    buckets = jnp.zeros((num_experts, capacity, d), x.dtype)
+    buckets = buckets.at[expert, jnp.clip(pos_in_expert, 0, capacity - 1)].add(
+        jnp.where(keep[:, None], x, 0.0))
+    return buckets, (expert, pos_in_expert, keep, gate, capacity)
+
+
+def moe_combine(expert_out, dispatch_info):
+    expert, pos_in_expert, keep, gate, capacity = dispatch_info
+    gathered = expert_out[expert, jnp.clip(pos_in_expert, 0, capacity - 1)]
+    return jnp.where(keep[:, None], gathered * gate[:, None], 0.0)

@@ -1,0 +1,254 @@
+"""Pipeline-parallel engine — single-controller microbatch pipelining.
+
+Reference parity: `fleet/meta_parallel/pipeline_parallel.py:30,152`
+(PipelineParallel.train_batch, 1F1B `_forward_step:229`) + p2p via
+`partial_send/recv` (`pp_utils/p2p_communication.py`).
+
+TPU-native design: each stage owns a contiguous slice of chips, expressed as
+a per-stage sub-`Mesh` (axes dp×mp inside the stage — the reference's
+hybrid 4-D grid with the pp axis peeled off). Stage programs are pjit'ed on
+their submesh; microbatch activations move stage→stage as device_put between
+differently-placed arrays (ICI device-to-device DMA — the `send_v2/recv_v2`
+replacement). The single controller enqueues work asynchronously, so stage
+s can compute microbatch m while stage s+1 computes m-1: the 1F1B overlap
+emerges from XLA's async dispatch rather than per-rank schedules.
+
+Backward is rematerialized: each stage's backward recomputes its forward
+from the saved stage INPUT (recompute-in-backward — the reference's
+RecomputeOptimizer fused into the schedule), so activation memory is
+O(microbatches × boundary) instead of O(all intermediates).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import random as rnd
+from ..core.tensor import Tensor
+from ..jit.functional import functional_call, split_state
+from .pp_layers import PipelineLayer
+from .topology import get_hybrid_communicate_group
+
+
+class PipelineParallel:
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        self.pipeline_layer = layers
+        self.hcg = hcg or get_hybrid_communicate_group()
+        cfg = strategy.pipeline_configs if strategy is not None else {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.num_stages = layers.num_stages
+        self.loss_fn = layers.loss_fn
+        self.stages = layers.get_stage_modules()
+        self._stage_meshes = self._make_stage_meshes()
+        self._fwd_fns: List = [None] * self.num_stages
+        self._bwd_fns: List = [None] * self.num_stages
+        self._upd_fns: List = [None] * self.num_stages
+        self._stage_state = []
+        for s, mod in enumerate(self.stages):
+            trainable, frozen = split_state(mod)
+            self._stage_state.append((list(trainable), list(frozen)))
+        self._placed = False
+        self._opt_slots = None
+
+    def _make_stage_meshes(self):
+        if self.hcg is None:
+            # single mesh over all devices, stages share devices (degenerate)
+            devs = jax.devices()
+            per = max(1, len(devs) // self.num_stages)
+            return [Mesh(np.asarray(devs[s * per:(s + 1) * per]).reshape(-1, 1),
+                         ("dp", "mp")) for s in range(self.num_stages)]
+        mesh = self.hcg.get_mesh()
+        arr = np.asarray(mesh.devices)  # [dp, pp, sharding, mp, (sp)]
+        meshes = []
+        for s in range(self.num_stages):
+            sub = arr[:, s]  # [dp, sharding, mp, ...]
+            sub = sub.reshape(arr.shape[0] * int(np.prod(sub.shape[1:-1] or [1])),
+                              sub.shape[-1])
+            meshes.append(Mesh(sub, ("dp", "mp")))
+        return meshes
+
+    # ---- per-stage compiled programs ----
+    def _stage_fwd(self, s):
+        if self._fwd_fns[s] is None:
+            mod = self.stages[s]
+            pnames, bnames = self._stage_state[s]
+            mesh = self._stage_meshes[s]
+
+            def f(params, buffers, x, key):
+                rnd.push_trace_key(key)
+                try:
+                    return functional_call(mod, pnames, params, bnames, buffers, Tensor(x))
+                finally:
+                    rnd.pop_trace_key()
+
+            batch_sh = NamedSharding(mesh, P("dp"))
+            rep = NamedSharding(mesh, P())
+            trainable, frozen = split_state(mod)
+            psh = [NamedSharding(mesh, P(*(t.dist_attr or ())) if t.dist_attr else P())
+                   for t in (trainable[n] for n in pnames)]
+            self._fwd_fns[s] = jax.jit(
+                f, in_shardings=(psh, [rep] * len(bnames), batch_sh, None),
+                out_shardings=batch_sh)
+        return self._fwd_fns[s]
+
+    def _stage_bwd(self, s):
+        if self._bwd_fns[s] is None:
+            mod = self.stages[s]
+            pnames, bnames = self._stage_state[s]
+            mesh = self._stage_meshes[s]
+
+            def b(params, buffers, x, g, key):
+                rnd.push_trace_key(key)
+                try:
+                    def f2(ps, xx):
+                        return functional_call(mod, pnames, ps, bnames, buffers,
+                                               Tensor(xx))
+                    _, vjp = jax.vjp(f2, params, x)
+                    gp, gx = vjp(g)
+                    return gp, gx
+                finally:
+                    rnd.pop_trace_key()
+
+            self._bwd_fns[s] = jax.jit(b)
+        return self._bwd_fns[s]
+
+    def _loss_grad(self, out, labels):
+        def lf(o, lab):
+            loss = self.loss_fn(Tensor(o), *[Tensor(l) for l in lab])
+            return loss._value if isinstance(loss, Tensor) else loss
+
+        if not hasattr(self, "_loss_fn_jit"):
+            self._loss_fn_jit = jax.jit(jax.value_and_grad(lf))
+        return self._loss_fn_jit(out, labels)
+
+    def _place_stage_params(self):
+        for s, mod in enumerate(self.stages):
+            mesh = self._stage_meshes[s]
+            trainable, frozen = split_state(mod)
+            pnames, bnames = self._stage_state[s]
+            for n in pnames:
+                t = trainable[n]
+                spec = P(*t.dist_attr) if t.dist_attr else P()
+                t._value = jax.device_put(t._value, NamedSharding(mesh, spec))
+            for n in bnames:
+                b = frozen[n]
+                b._value = jax.device_put(b._value, NamedSharding(mesh, P()))
+        self._placed = True
+
+    # ---- the schedule ----
+    def forward_backward_pipeline(self, data, labels):
+        """GPipe-with-remat schedule; returns (mean_loss, stage_grads)."""
+        if not self._placed:
+            self._place_stage_params()
+        n_micro = self.accumulate_steps
+        micro_x = jnp.split(data, n_micro, axis=0)
+        micro_y = [jnp.split(l, n_micro, axis=0) for l in labels]
+
+        stage_params = []
+        stage_buffers = []
+        for s, mod in enumerate(self.stages):
+            trainable, frozen = split_state(mod)
+            pnames, bnames = self._stage_state[s]
+            stage_params.append([trainable[n]._value for n in pnames])
+            stage_buffers.append([frozen[n]._value for n in bnames])
+
+        # forward: stream each microbatch through the stage chain (async dispatch
+        # lets stage s work on micro m while stage s+1 handles m-1)
+        keys = [[rnd.default_generator().next_key() for _ in range(self.num_stages)]
+                for _ in range(n_micro)]
+        boundary_inputs = [[None] * self.num_stages for _ in range(n_micro)]
+        outs = [None] * n_micro
+        for m in range(n_micro):
+            x = micro_x[m]
+            for s in range(self.num_stages):
+                mesh = self._stage_meshes[s]
+                x = jax.device_put(x, NamedSharding(mesh, P("dp")))  # ICI p2p hop
+                boundary_inputs[m][s] = x
+                x = self._stage_fwd(s)(stage_params[s], stage_buffers[s], x, keys[m][s])
+            outs[m] = x
+
+        # loss + backward per microbatch, reverse stage order
+        grads = [None] * self.num_stages
+        losses = []
+        for m in range(n_micro):
+            lab = [y[m] for y in micro_y]
+            loss, g = self._loss_grad(outs[m], lab)
+            losses.append(loss)
+            for s in reversed(range(self.num_stages)):
+                mesh = self._stage_meshes[s]
+                g = jax.device_put(g, NamedSharding(mesh, P("dp")))
+                gp, g = self._stage_bwd(s)(stage_params[s], stage_buffers[s],
+                                           boundary_inputs[m][s], g, keys[m][s])
+                if grads[s] is None:
+                    grads[s] = gp
+                else:
+                    grads[s] = [a + b for a, b in zip(grads[s], gp)]
+        scale = 1.0 / n_micro
+        grads = [[g * scale for g in gs] for gs in grads]
+        mean_loss = sum(jnp.mean(l) for l in losses) / n_micro
+        return mean_loss, grads
+
+    def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
+        if isinstance(data, (list, tuple)):
+            x = data[0]._value if isinstance(data[0], Tensor) else jnp.asarray(data[0])
+            labels = [d._value if isinstance(d, Tensor) else jnp.asarray(d)
+                      for d in data[1:]]
+        else:
+            x, labels = (data._value if isinstance(data, Tensor) else jnp.asarray(data)), []
+        loss, grads = self.forward_backward_pipeline(x, labels)
+
+        if optimizer is not None:
+            if self._opt_slots is None:
+                self._opt_slots = []
+                for s, mod in enumerate(self.stages):
+                    trainable, _ = split_state(mod)
+                    pts = [trainable[n] for n in self._stage_state[s][0]]
+                    self._opt_slots.append(optimizer.init_state(pts))
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+            t = jnp.asarray(optimizer._step_count + 1, jnp.float32)
+            for s, mod in enumerate(self.stages):
+                trainable, _ = split_state(mod)
+                pnames = self._stage_state[s][0]
+                vals = [trainable[n]._value for n in pnames]
+                if self._upd_fns[s] is None:
+                    opt = optimizer
+
+                    def upd(values, gs, slots, lr_, t_):
+                        return opt.functional_update(values, gs, slots, lr_, t_)
+
+                    self._upd_fns[s] = jax.jit(upd, donate_argnums=(0, 2))
+                new_vals, self._opt_slots[s] = self._upd_fns[s](
+                    vals, grads[s], self._opt_slots[s], lr, t)
+                for n, v in zip(pnames, new_vals):
+                    trainable[n]._value = v
+            optimizer._step_count += 1
+        return Tensor(loss)
+
+    def eval_batch(self, data, compute_loss=True):
+        if isinstance(data, (list, tuple)):
+            x = data[0]._value if isinstance(data[0], Tensor) else jnp.asarray(data[0])
+            labels = [d._value if isinstance(d, Tensor) else jnp.asarray(d)
+                      for d in data[1:]]
+        else:
+            x, labels = jnp.asarray(data), []
+        if not self._placed:
+            self._place_stage_params()
+        stage_params, stage_buffers = [], []
+        for s, mod in enumerate(self.stages):
+            trainable, frozen = split_state(mod)
+            pnames, bnames = self._stage_state[s]
+            stage_params.append([trainable[n]._value for n in pnames])
+            stage_buffers.append([frozen[n]._value for n in bnames])
+        key = rnd.default_generator().next_key()
+        for s in range(self.num_stages):
+            mesh = self._stage_meshes[s]
+            x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+            x = self._stage_fwd(s)(stage_params[s], stage_buffers[s], x, key)
+        if compute_loss and self.loss_fn is not None and labels:
+            loss = self.loss_fn(Tensor(x), *[Tensor(l) for l in labels])
+            return loss
+        return Tensor(x)

@@ -1,0 +1,105 @@
+"""Pipeline layer segmentation.
+
+Reference parity: `fleet/meta_parallel/parallel_layers/pp_layers.py:132,282`
+(PipelineLayer with LayerDesc/SharedLayerDesc, seg_method segmentation).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..nn.layer.container import Sequential
+from ..nn.layer.layers import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Declares the full model as a flat list of LayerDescs, segmented into
+    `num_stages` contiguous stages (uniform or param-weighted split)."""
+
+    def __init__(self, layers: List, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None, num_virtual_pipeline_stages=1):
+        super().__init__()
+        self.descs = list(layers)
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self.seg_method = seg_method
+        self._built_layers = []
+        self._shared = {}
+        for i, d in enumerate(self.descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+            elif isinstance(d, Layer):
+                layer = d
+            elif callable(d):
+                layer = _FnLayer(d)
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+            self._built_layers.append(layer)
+            self.add_sublayer(str(i), layer)
+        self._segment()
+
+    def _segment(self):
+        n = len(self._built_layers)
+        k = self.num_stages
+        if self.seg_method.startswith("layer:"):
+            # split at layers whose class name matches (reference seg_method)
+            cls_name = self.seg_method.split(":", 1)[1]
+            marks = [i for i, l in enumerate(self._built_layers)
+                     if type(l).__name__ == cls_name]
+            per = max(1, len(marks) // k)
+            bounds = [0]
+            for s in range(1, k):
+                bounds.append(marks[min(s * per, len(marks) - 1)])
+            bounds.append(n)
+        else:
+            per = (n + k - 1) // k
+            bounds = [min(i * per, n) for i in range(k)] + [n]
+        self.segments = [(bounds[i], bounds[i + 1]) for i in range(k)]
+
+    def get_stage_module(self, stage: int) -> Sequential:
+        lo, hi = self.segments[stage]
+        return Sequential(*self._built_layers[lo:hi])
+
+    def get_stage_modules(self) -> List[Sequential]:
+        return [self.get_stage_module(s) for s in range(self.num_stages)]
+
+    def forward(self, x):
+        for layer in self._built_layers:
+            x = layer(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)

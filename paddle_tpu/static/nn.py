@@ -1,0 +1,83 @@
+"""Structured control flow for static capture.
+
+Reference parity: `operators/controlflow/` (`conditional_block_op.cc`,
+`while_op.cc`) exposed as `paddle.static.nn.cond/while_loop/case/switch_case`.
+TPU-native: these ARE `lax.cond`/`lax.while_loop` — the XLA-compilable
+control flow that @to_static requires for data-dependent branches.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _unwrap(x):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, x,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap(x):
+    return jax.tree_util.tree_map(Tensor, x)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    p = pred._value if isinstance(pred, Tensor) else jnp.asarray(pred)
+    out = jax.lax.cond(p.reshape(()),
+                       lambda _: _unwrap(true_fn()),
+                       lambda _: _unwrap(false_fn()),
+                       operand=None)
+    return _wrap(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    init = _unwrap(list(loop_vars))
+
+    def c(vs):
+        r = cond_fn(*_wrap(vs))
+        return (r._value if isinstance(r, Tensor) else jnp.asarray(r)).reshape(())
+
+    def b(vs):
+        out = body_fn(*_wrap(vs))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return _unwrap(list(out))
+
+    final = jax.lax.while_loop(c, b, init)
+    return _wrap(final)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    preds = [p._value.reshape(()) if isinstance(p, Tensor) else jnp.asarray(p)
+             for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is not None:
+        fns = fns + [default]
+    idx = jnp.argmax(jnp.stack([p.astype(jnp.int32) for p in preds] +
+                               ([jnp.asarray(1)] if default is not None else [])))
+    out = jax.lax.switch(idx, [lambda f=f: _unwrap(f()) for f in fns])
+    return _wrap(out)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    bi = branch_index._value if isinstance(branch_index, Tensor) else jnp.asarray(branch_index)
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        # map branch_index -> position
+        pos = sum(jnp.where(bi == k, i, 0) for i, k in enumerate(keys))
+    else:
+        fns = list(branch_fns)
+        pos = bi
+    if default is not None:
+        fns = fns + [default]
+        pos = jnp.clip(pos, 0, len(fns) - 1)
+    out = jax.lax.switch(pos.reshape(()).astype(jnp.int32),
+                         [lambda f=f: _unwrap(f()) for f in fns])
+    return _wrap(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    raise NotImplementedError("static.nn.fc: use paddle_tpu.nn.Linear")

@@ -1,0 +1,35 @@
+"""paddle.text parity: tiny synthetic text datasets (zero-egress image)."""
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 1024 if mode == "train" else 256
+        self.docs = [rng.randint(1, 5000, (rng.randint(20, 100),)).astype("int64")
+                     for _ in range(n)]
+        self.labels = rng.randint(0, 2, (n,)).astype("int64")
+        self.word_idx = {f"w{i}": i for i in range(5000)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train", download=True):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        self.x = rng.rand(n, 13).astype("float32")
+        w = rng.rand(13).astype("float32")
+        self.y = (self.x @ w + 0.1 * rng.rand(n)).astype("float32")[:, None]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)

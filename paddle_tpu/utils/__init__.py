@@ -1,0 +1,66 @@
+"""paddle.utils parity: deprecated decorator, unique_name, download stub,
+cpp_extension pointer, try_import."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(f"{func.__name__} is deprecated since {since}: {reason}. "
+                          f"Use {update_to} instead.", DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is required but not installed")
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+generate = _UniqueNameGenerator()
+
+
+class unique_name:
+    _gen = _UniqueNameGenerator()
+
+    @classmethod
+    def generate(cls, key):
+        return cls._gen(key)
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the TPU stack works."""
+    import jax
+    import jax.numpy as jnp
+    n = jax.device_count()
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    backend = jax.default_backend()
+    print(f"paddle_tpu is installed successfully! backend={backend}, devices={n}, "
+          f"matmul checksum={float(y.sum()):.0f}")
+    return True
+
+
+def download(url, path=None, md5sum=None):
+    raise RuntimeError("zero-egress environment: datasets must be local "
+                       "(use paddle_tpu.vision.datasets with mode='synthetic')")

@@ -1,0 +1,116 @@
+"""paddle.vision.datasets parity.
+
+Reference parity: `python/paddle/vision/datasets/` (MNIST, Cifar10/100,
+FashionMNIST, Flowers). This image is zero-egress, so every dataset reads a
+local file when present (same formats the reference downloads) and otherwise
+generates a deterministic synthetic stand-in with identical shapes/dtypes —
+keeping model code and tests identical to the reference's usage.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+def _synthetic_images(n, shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    xs = (rng.rand(n, *shape) * 255).astype("uint8")
+    ys = rng.randint(0, num_classes, (n,)).astype("int64")
+    # make classes separable: add a class-dependent bright band
+    for i in range(n):
+        c = int(ys[i])
+        row = (c * shape[-2]) // num_classes
+        xs[i, ..., row:row + 2, :] = 255
+    return xs, ys
+
+
+class MNIST(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        n = 2048 if mode == "train" else 512
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(num, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), dtype=np.uint8).astype("int64")
+        else:
+            self.images, self.labels = _synthetic_images(n, (28, 28), 10,
+                                                         0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype("float32")[None] / 255.0
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend="cv2"):
+        self.transform = transform
+        n = 2048 if mode == "train" else 512
+        if data_file and os.path.exists(data_file):
+            with open(data_file, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            self.images = d[b"data"].reshape(-1, 3, 32, 32)
+            self.labels = np.asarray(d[b"labels"], dtype="int64")
+        else:
+            self.images, self.labels = _synthetic_images(
+                n, (3, 32, 32), self.NUM_CLASSES, 2 if mode == "train" else 3)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        else:
+            img = img.astype("float32") / 255.0
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.transform = transform
+        n = 512 if mode == "train" else 128
+        self.images, self.labels = _synthetic_images(n, (3, 64, 64), 102, 4)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        else:
+            img = img.astype("float32") / 255.0
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.images)

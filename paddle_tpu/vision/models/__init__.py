@@ -1,0 +1,1 @@
+"""paddle.vision.models parity — re-exported from paddle_tpu.models."""

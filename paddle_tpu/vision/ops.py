@@ -1,0 +1,114 @@
+"""paddle.vision.ops parity: detection ops (nms, box coding, roi pooling,
+yolo utilities). Reference parity: `paddle/fluid/operators/detection/`.
+Dynamic-size outputs (nms keep-lists) host-sync, as on any accelerator.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._dispatch import ensure_tensor, run_op
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    b = ensure_tensor(boxes).numpy()
+    s = ensure_tensor(scores).numpy() if scores is not None else np.ones(len(b), "float32")
+    order = np.argsort(-s)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(b[i, 0], b[rest, 0])
+        yy1 = np.maximum(b[i, 1], b[rest, 1])
+        xx2 = np.minimum(b[i, 2], b[rest, 2])
+        yy2 = np.minimum(b[i, 3], b[rest, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        a_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        a_r = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+        iou = inter / np.maximum(a_i + a_r - inter, 1e-9)
+        order = rest[iou <= iou_threshold]
+    keep = np.asarray(keep[:top_k] if top_k else keep, dtype="int64")
+    return Tensor(jnp.asarray(keep))
+
+
+def box_iou(boxes1, boxes2):
+    b1, b2 = ensure_tensor(boxes1), ensure_tensor(boxes2)
+
+    def f(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None] - inter, 1e-9)
+
+    return run_op(f, [b1, b2], "box_iou")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLO head output [N, A*(5+C), H, W] -> boxes + scores."""
+    x = ensure_tensor(x)
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, dtype="float32").reshape(na, 2)
+
+    def f(a):
+        n, _, h, w = a.shape
+        a = a.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=a.dtype)
+        gy = jnp.arange(h, dtype=a.dtype)
+        cx = (jax_sigmoid(a[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx[None, None, None, :]) / w
+        cy = (jax_sigmoid(a[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy[None, None, :, None]) / h
+        bw = jnp.exp(a[:, :, 2]) * anc[None, :, 0, None, None] / (w * downsample_ratio)
+        bh = jnp.exp(a[:, :, 3]) * anc[None, :, 1, None, None] / (h * downsample_ratio)
+        obj = jax_sigmoid(a[:, :, 4])
+        cls = jax_sigmoid(a[:, :, 5:])
+        scores = obj[:, :, None] * cls
+        x1, y1 = cx - bw / 2, cy - bh / 2
+        x2, y2 = cx + bw / 2, cy + bh / 2
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [n,na,h,w,4]
+        boxes = boxes.reshape(n, -1, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        if clip_bbox:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return boxes, scores
+
+    import jax
+    jax_sigmoid = jax.nn.sigmoid
+    return run_op(f, [x], "yolo_box")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              aligned=True):
+    """RoIAlign via bilinear sampling (jax.scipy map_coordinates)."""
+    import jax
+    x = ensure_tensor(x)
+    b = ensure_tensor(boxes)._value
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+
+    def f(feat):
+        n, c, h, w = feat.shape
+        outs = []
+        off = 0.5 if aligned else 0.0
+        for i in range(b.shape[0]):
+            x1, y1, x2, y2 = b[i] * spatial_scale - off
+            ys = y1 + (jnp.arange(oh) + 0.5) * (y2 - y1) / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * (x2 - x1) / ow
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            coords = jnp.stack([gy.reshape(-1), gx.reshape(-1)])
+            sampled = jax.vmap(
+                lambda ch: jax.scipy.ndimage.map_coordinates(ch, coords, order=1))(feat[0])
+            outs.append(sampled.reshape(c, oh, ow))
+        return jnp.stack(outs)
+
+    return run_op(f, [x], "roi_align")
+
+
+def deform_conv2d(*a, **kw):
+    raise NotImplementedError("deform_conv2d: planned (round 2)")

@@ -1,0 +1,258 @@
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import TrainStep, to_static
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32")
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+class TestToStatic:
+    def test_matches_eager(self):
+        net = SmallNet()
+        net.eval()
+        x = paddle.to_tensor(_r(3, 8))
+        eager = net(x).numpy()
+        snet = to_static(net)
+        static = snet(x).numpy()
+        np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-6)
+
+    def test_backward_through_static(self):
+        net = SmallNet()
+        to_static(net)
+        x = paddle.to_tensor(_r(3, 8))
+        loss = net(x).sum()
+        loss.backward()
+        assert net.fc1.weight.grad is not None
+        assert np.isfinite(np.asarray(net.fc1.weight.grad)).all()
+
+    def test_training_with_static_descends(self):
+        net = SmallNet()
+        to_static(net)
+        opt = paddle.optimizer.Adam(parameters=net.parameters(), learning_rate=1e-2)
+        x = paddle.to_tensor(_r(16, 8))
+        y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+        lossfn = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(60):
+            loss = lossfn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_function_decorator(self):
+        @to_static
+        def f(a, b):
+            return a * 2 + b
+
+        out = f(paddle.to_tensor(_r(2, 2)), paddle.to_tensor(_r(2, 2)))
+        assert out.shape == [2, 2]
+
+    def test_control_flow_cond(self):
+        from paddle_tpu.static.nn import cond
+
+        @to_static
+        def f(x):
+            return cond(x.sum() > 0, lambda: x * 2, lambda: x * -1)
+
+        out = f(paddle.to_tensor(np.ones((2,), "float32")))
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+
+    def test_while_loop(self):
+        from paddle_tpu.static.nn import while_loop
+
+        i = paddle.to_tensor(np.asarray(0, "int32"))
+        ten = paddle.to_tensor(np.asarray(10, "int32"))
+        out = while_loop(lambda i: i < ten, lambda i: i + 2, [i])
+        assert int(out[0]) == 10
+
+
+class TestTrainStep:
+    def test_trainstep_descends_and_matches_semantics(self):
+        paddle.seed(0)
+        net = SmallNet()
+        opt = paddle.optimizer.Adam(parameters=net.parameters(), learning_rate=1e-2)
+        step = TrainStep(net, nn.CrossEntropyLoss(), opt)
+        x = paddle.to_tensor(_r(16, 8))
+        y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+        losses = [float(step(x, y)) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_trainstep_amp_bf16(self):
+        net = SmallNet()
+        opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+        step = TrainStep(net, nn.CrossEntropyLoss(), opt, amp_dtype="bfloat16")
+        x = paddle.to_tensor(_r(8, 8))
+        y = paddle.to_tensor(np.random.randint(0, 4, (8,)))
+        l0 = float(step(x, y))
+        l1 = float(step(x, y))
+        assert np.isfinite([l0, l1]).all()
+        assert net.fc1.weight.dtype == np.dtype("float32")  # master weights stay fp32
+
+
+class TestJitSaveLoad:
+    def test_roundtrip(self, tmp_path):
+        from paddle_tpu.jit import InputSpec, load, save
+        net = SmallNet()
+        net.eval()
+        x = paddle.to_tensor(_r(2, 8))
+        ref = net(x).numpy()
+        path = str(tmp_path / "model")
+        save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+        loaded = load(path)
+        out = loaded(x)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        x = paddle.to_tensor(_r(4, 4))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(x, x)
+        assert out.dtype.itemsize == 2
+        out2 = paddle.matmul(x, x)
+        assert out2.dtype == np.dtype("float32")
+
+    def test_grad_scaler_skips_on_inf(self):
+        p = paddle.Parameter(np.ones(2, dtype="float32"))
+        p.grad = paddle.to_tensor(np.array([np.inf, 1.0], "float32"))._value
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), [1.0, 1.0])  # step skipped
+
+    def test_grad_scaler_scales(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = paddle.to_tensor(np.asarray(2.0, "float32"))
+        assert float(scaler.scale(loss)) == 8.0
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path):
+        net = SmallNet()
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+        x = paddle.to_tensor(_r(4, 8))
+        net(x).sum().backward()
+        opt.step()
+        p = str(tmp_path / "ckpt.pdparams")
+        paddle.save(net.state_dict(), p)
+        paddle.save(opt.state_dict(), str(tmp_path / "ckpt.pdopt"))
+        net2 = SmallNet()
+        net2.set_state_dict(paddle.load(p))
+        np.testing.assert_allclose(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+        opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+        opt2.set_state_dict(paddle.load(str(tmp_path / "ckpt.pdopt")))
+        assert opt2._step_count == 1
+
+    def test_save_nested_objects(self, tmp_path):
+        obj = {"a": paddle.to_tensor(_r(2, 2)), "b": [1, paddle.to_tensor(_r(3))],
+               "c": "text"}
+        p = str(tmp_path / "obj.pkl")
+        paddle.save(obj, p)
+        loaded = paddle.load(p)
+        np.testing.assert_allclose(loaded["a"].numpy(), obj["a"].numpy())
+        assert loaded["c"] == "text"
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.full((3,), i, "float32"), np.int64(i % 2)
+
+            def __len__(self):
+                return 10
+
+        dl = DataLoader(DS(), batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        xb, yb = batches[0]
+        assert xb.shape == [4, 3] and yb.shape == [4]
+
+    def test_prefetch_workers_preserve_order(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.asarray([i], "float32")
+
+            def __len__(self):
+                return 32
+
+        dl = DataLoader(DS(), batch_size=4, num_workers=2)
+        vals = [b.numpy()[:, 0].tolist() for b in dl]
+        flat = [v for batch in vals for v in batch]
+        assert flat == list(range(32))
+
+    def test_distributed_batch_sampler(self):
+        from paddle_tpu.io import DistributedBatchSampler, Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return i
+
+            def __len__(self):
+                return 16
+
+        s0 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=4, rank=0)
+        s1 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=4, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == 4 and not set(i0) & set(i1)
+
+
+class TestInference:
+    def test_predictor_roundtrip(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.jit import InputSpec, save
+        net = SmallNet()
+        net.eval()
+        x = _r(2, 8)
+        ref = net(paddle.to_tensor(x)).numpy()
+        path = str(tmp_path / "infer")
+        save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+        cfg = Config(path + ".pdmodel")
+        pred = create_predictor(cfg)
+        inp = pred.get_input_handle(pred.get_input_names()[0])
+        inp.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestHapiModel:
+    def test_fit_evaluate_predict(self):
+        from paddle_tpu.io import TensorDataset
+        paddle.seed(0)
+        x = paddle.to_tensor(_r(32, 8))
+        y = paddle.to_tensor(np.random.randint(0, 4, (32,)).astype("int64"))
+        ds = TensorDataset([x, y])
+        model = paddle.Model(SmallNet())
+        model.prepare(paddle.optimizer.Adam(parameters=model.parameters(),
+                                            learning_rate=1e-2),
+                      nn.CrossEntropyLoss(),
+                      paddle.metric.Accuracy())
+        model.fit(ds, epochs=3, batch_size=8, verbose=0)
+        logs = model.evaluate(ds, batch_size=8)
+        assert "loss" in logs and logs["loss"] is not None
+        preds = model.predict(ds, batch_size=8)
+        assert len(preds) == 4

@@ -1,0 +1,250 @@
+"""Hybrid-parallel tests on the 8-device virtual CPU mesh (SURVEY §4:
+single-host multi-device runners replace the reference's multi-process NCCL
+tests; equality-vs-single-device replaces loss-delta comparison)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.parallel import (
+    ColumnParallelLinear, DistributedStrategy, HybridCommunicateGroup,
+    ParallelCrossEntropy, RowParallelLinear, SPMDTrainStep, VocabParallelEmbedding,
+    create_mesh, fleet, sequence_parallel_attention,
+)
+from paddle_tpu.parallel.pp_layers import LayerDesc, PipelineLayer
+from paddle_tpu.parallel.pipeline_parallel import PipelineParallel
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32")
+
+
+class MLP(nn.Layer):
+    def __init__(self, d=16, use_mp=False):
+        super().__init__()
+        if use_mp:
+            self.fc1 = ColumnParallelLinear(d, 4 * d, gather_output=False)
+            self.fc2 = RowParallelLinear(4 * d, d, input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(d, 4 * d)
+            self.fc2 = nn.Linear(4 * d, d)
+        self.act = nn.GELU()
+        self.head = nn.Linear(d, 4)
+
+    def forward(self, x):
+        return self.head(self.fc2(self.act(self.fc1(x))))
+
+
+class TestMeshTopology:
+    def test_hcg_builds_mesh(self):
+        hcg = HybridCommunicateGroup(hybrid_configs={"dp_degree": 2, "mp_degree": 4})
+        assert dict(hcg.get_mesh().shape) == {"dp": 2, "pp": 1, "sharding": 1, "mp": 4}
+        assert hcg.get_parallel_mode() == "tensor"
+
+    def test_topology_coords(self):
+        hcg = HybridCommunicateGroup(hybrid_configs={"dp_degree": 2, "mp_degree": 2,
+                                                     "pp_degree": 2})
+        topo = hcg.topology
+        assert topo.world_size() == 8
+        assert topo.get_coord(topo.get_rank(data=1, pipe=1, sharding=0, model=1)) \
+            == (1, 1, 0, 1)
+
+    def test_fleet_init(self):
+        strat = DistributedStrategy()
+        strat.hybrid_configs["dp_degree"] = 8
+        hcg = fleet.init(is_collective=True, strategy=strat)
+        assert hcg.get_data_parallel_world_size() == 8
+
+
+class TestSPMDTrainStep:
+    def _train(self, mesh_cfg, sharding_stage=0, use_mp=False, steps=8):
+        paddle.seed(42)
+        np.random.seed(42)
+        hcg = HybridCommunicateGroup(hybrid_configs=mesh_cfg)
+        model = MLP(use_mp=use_mp)
+        opt = paddle.optimizer.Adam(parameters=model.parameters(), learning_rate=1e-2)
+        lossfn = nn.CrossEntropyLoss()
+        step = SPMDTrainStep(model, lossfn, opt, mesh=hcg.get_mesh(),
+                             sharding_stage=sharding_stage, donate=False)
+        x = paddle.to_tensor(_r(16, 16))
+        y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+        losses = [float(step(x, y)) for _ in range(steps)]
+        return losses
+
+    def test_dp_descends(self):
+        losses = self._train({"dp_degree": 8})
+        assert losses[-1] < losses[0]
+
+    def test_tp_descends(self):
+        losses = self._train({"mp_degree": 4}, use_mp=True)
+        assert losses[-1] < losses[0]
+
+    def test_zero1_matches_dp(self):
+        l_dp = self._train({"dp_degree": 4}, sharding_stage=0)
+        l_z1 = self._train({"sharding_degree": 4}, sharding_stage=1)
+        np.testing.assert_allclose(l_dp, l_z1, rtol=2e-3, atol=2e-4)
+
+    def test_zero3_matches_dp(self):
+        l_dp = self._train({"dp_degree": 4}, sharding_stage=0)
+        l_z3 = self._train({"sharding_degree": 4}, sharding_stage=3)
+        np.testing.assert_allclose(l_dp, l_z3, rtol=2e-3, atol=2e-4)
+
+    def test_hybrid_dp_mp_sharding(self):
+        losses = self._train({"dp_degree": 2, "mp_degree": 2, "sharding_degree": 2},
+                             sharding_stage=1, use_mp=True)
+        assert losses[-1] < losses[0]
+
+    def test_param_shardings_applied(self):
+        hcg = HybridCommunicateGroup(hybrid_configs={"mp_degree": 4})
+        model = MLP(use_mp=True)
+        opt = paddle.optimizer.SGD(parameters=model.parameters(), learning_rate=0.1)
+        step = SPMDTrainStep(model, nn.CrossEntropyLoss(), opt, mesh=hcg.get_mesh(),
+                             donate=False)
+        x = paddle.to_tensor(_r(8, 16))
+        y = paddle.to_tensor(np.random.randint(0, 4, (8,)))
+        step(x, y)
+        w = model.fc1.weight._value
+        # column-parallel weight sharded over mp on its out dim
+        shard_shape = w.sharding.shard_shape(w.shape)
+        assert shard_shape[1] == w.shape[1] // 4
+
+
+class TestCollectivesInShardMap:
+    def test_allreduce_psum(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = create_mesh({"dp": 8})
+
+        def body(x):
+            t = paddle.to_tensor(x)
+            out = dist.all_reduce(t)
+            return out._value
+
+        f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                      check_vma=False)
+        x = np.arange(8, dtype="float32")
+        out = f(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()), rtol=1e-6)
+
+    def test_reduce_scatter_and_allgather(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = create_mesh({"dp": 4})
+
+        def body(x):
+            t = paddle.to_tensor(x)
+            rs = dist.reduce_scatter(None, t)
+            gathered = dist.all_gather(None, rs)
+            return gathered._value.reshape(1, -1)
+
+        f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                      check_vma=False)
+        x = np.tile(np.arange(8, dtype="float32"), (4, 1)).reshape(-1)  # 4 shards of 8
+        out = np.asarray(f(jnp.asarray(x)))
+        # each shard contributes arange(8); rs gives 4*arange chunk per device
+        expect_full = 4 * np.arange(8, dtype="float32")
+        np.testing.assert_allclose(out.reshape(4, 8)[0], expect_full, rtol=1e-6)
+
+
+class TestSequenceParallel:
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_attention(self, impl, causal):
+        create_mesh({"sp": 4})
+        b, s, h, d = 2, 32, 4, 8
+        q, k, v = _r(b, s, h, d), _r(b, s, h, d), _r(b, s, h, d)
+        out = sequence_parallel_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                          paddle.to_tensor(v), impl=impl, causal=causal)
+        from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
+        from paddle_tpu.parallel import topology
+        topology._GLOBAL_MESH[0] = None  # reference path without mesh
+        ref = scaled_dot_product_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                           paddle.to_tensor(v), is_causal=causal)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-3, atol=2e-3)
+
+    def test_ring_attention_grad_flows(self):
+        create_mesh({"sp": 4})
+        q = paddle.to_tensor(_r(1, 16, 2, 8), stop_gradient=False)
+        k = paddle.to_tensor(_r(1, 16, 2, 8), stop_gradient=False)
+        v = paddle.to_tensor(_r(1, 16, 2, 8), stop_gradient=False)
+        out = sequence_parallel_attention(q, k, v, impl="ring", causal=True)
+        out.sum().backward()
+        assert q.grad is not None and k.grad is not None and v.grad is not None
+        assert np.isfinite(q.gradient()).all()
+
+
+class TestPipelineParallel:
+    def _make_pipeline(self, pp=2, dp=2, n_layers=4, d=8):
+        paddle.seed(7)
+        hcg = HybridCommunicateGroup(hybrid_configs={"dp_degree": dp, "pp_degree": pp})
+        descs = [LayerDesc(nn.Linear, d, d) for _ in range(n_layers - 1)]
+        descs.append(LayerDesc(nn.Linear, d, 2))
+        pl = PipelineLayer(descs, num_stages=pp, loss_fn=nn.CrossEntropyLoss())
+        return PipelineParallel(pl, hcg, None), pl
+
+    def test_pipeline_trains(self):
+        engine, pl = self._make_pipeline()
+        engine.accumulate_steps = 2
+        opt = paddle.optimizer.SGD(parameters=pl.parameters(), learning_rate=0.1)
+        x = paddle.to_tensor(_r(8, 8))
+        y = paddle.to_tensor(np.random.randint(0, 2, (8,)))
+        losses = [float(engine.train_batch([x, y], opt)) for _ in range(10)]
+        assert losses[-1] < losses[0], losses
+
+    def test_pipeline_matches_sequential(self):
+        engine, pl = self._make_pipeline(pp=2, dp=1)
+        x = paddle.to_tensor(_r(4, 8))
+        out_seq = pl(x)  # reference first: engine placement moves stage params
+        out_pipe = engine.eval_batch([x], compute_loss=False)
+        np.testing.assert_allclose(out_pipe.numpy(), out_seq.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_segmentation(self):
+        descs = [LayerDesc(nn.Linear, 4, 4) for _ in range(7)]
+        pl = PipelineLayer(descs, num_stages=4)
+        sizes = [hi - lo for lo, hi in pl.segments]
+        assert sum(sizes) == 7 and max(sizes) - min(sizes) <= 1
+
+
+class TestVocabParallelAndCE:
+    def test_vocab_embedding_matches_dense(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = create_mesh({"mp": 4})
+        vocab, dim = 16, 8
+        emb = VocabParallelEmbedding(vocab, dim)
+        w_full = emb.weight.numpy()
+        ids = np.random.randint(0, vocab, (2, 5))
+
+        def body(w):
+            emb.weight._value = w
+            out = emb(paddle.to_tensor(ids))
+            return out._value
+
+        f = shard_map(body, mesh=mesh, in_specs=P("mp", None), out_specs=P(),
+                      check_vma=False)
+        out = np.asarray(f(jnp.asarray(w_full)))
+        np.testing.assert_allclose(out, w_full[ids], rtol=1e-6)
+
+    def test_parallel_ce_matches_dense(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = create_mesh({"mp": 4})
+        logits = _r(6, 16)
+        labels = np.random.randint(0, 16, (6, 1))
+        pce = ParallelCrossEntropy()
+
+        def body(lg):
+            out = pce(paddle.to_tensor(lg), paddle.to_tensor(labels))
+            return out._value
+
+        f = shard_map(body, mesh=mesh, in_specs=P(None, "mp"), out_specs=P(),
+                      check_vma=False)
+        got = np.asarray(f(jnp.asarray(logits)))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(6), labels[:, 0]])[:, None]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
