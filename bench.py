@@ -1,0 +1,80 @@
+"""Flagship benchmark: ERNIE/BERT-base pretraining-style train step on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-repo numbers (BASELINE.md) — vs_baseline
+compares against the recorded best from previous rounds when present
+(bench_baseline.json), else 1.0.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import models
+    from paddle_tpu.jit import TrainStep
+
+    backend = jax.default_backend()
+    batch, seqlen = (32, 128) if backend == "tpu" else (8, 64)
+
+    paddle.seed(0)
+    base = models.ernie_base(hidden_dropout_prob=0.0) if backend == "tpu" else \
+        models.ErnieModel(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, intermediate_size=512,
+                          hidden_dropout_prob=0.0)
+    net = models.ErnieForPretraining(base)
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(logits, nsp_logits, ids, nsp):
+        v = logits.shape[-1]
+        return ce(logits.reshape([-1, v]), ids.reshape([-1])) + ce(nsp_logits, nsp)
+
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(), learning_rate=1e-4)
+    step = TrainStep(net, loss_fn, opt, amp_dtype="bfloat16", n_model_inputs=1)
+
+    vocab = base.embeddings.word_embeddings.weight.shape[0]
+    ids = paddle.to_tensor(np.random.randint(0, vocab, (batch, seqlen)).astype(np.int32))
+    nsp = paddle.to_tensor(np.random.randint(0, 2, (batch,)).astype(np.int32))
+
+    # warmup / compile (sync via host transfer: on the axon tunnel
+    # block_until_ready returns early, so D2H is the only true barrier)
+    loss = step(ids, ids, nsp)
+    float(loss.numpy())
+
+    n_steps = 20 if backend == "tpu" else 5
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step(ids, ids, nsp)
+    float(loss.numpy())
+    dt = time.perf_counter() - t0
+
+    sps = batch * n_steps / dt
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_baseline.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                ref = json.load(f).get("value")
+            if ref:
+                vs = sps / ref
+        except Exception:
+            pass
+    print(json.dumps({
+        "metric": f"ernie_base_train_samples_per_sec_per_chip[{backend},b{batch},s{seqlen},bf16]",
+        "value": round(sps, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,19 @@
-"""Model zoo (filled by the models milestone)."""
+"""Model zoo covering the baseline configs (BASELINE.md):
+LeNet (1), ResNet-50 (2), ERNIE/BERT-base (3), PP-YOLOE (4),
+ERNIE-10B / GPT hybrid-parallel (5)."""
+from .lenet import LeNet  # noqa: F401
+from .resnet import (  # noqa: F401
+    BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50, resnet101,
+    resnet152, wide_resnet50_2, wide_resnet101_2,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieForPretraining, ErnieForSequenceClassification, ErnieModel, bert_base,
+    bert_large, ernie_base, ernie_large, ernie_titan_10b,
+)
+from .gpt import (  # noqa: F401
+    GPTForCausalLM, GPTModel, GPTPretrainingCriterion, gpt2_medium, gpt2_small,
+    gpt_10b, gpt_pipeline_layer,
+)
+from .yoloe import PPYOLOE, ppyoloe_l, ppyoloe_m, ppyoloe_s  # noqa: F401

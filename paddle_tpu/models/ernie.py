@@ -1,0 +1,203 @@
+"""ERNIE/BERT encoder family — the flagship benchmark model (config 3).
+
+Reference parity: ERNIE as consumed through PaddleNLP on the reference stack
+(transformer encoder per `python/paddle/nn/layer/transformer.py`, trained
+via Fleet). The TPU build wires tensor-parallel variants through
+paddle_tpu.parallel.mp_layers so the same class scales from one chip to a
+pod slice; attention lowers to the fused XLA/Pallas path.
+
+Configs: ernie_base (12L/768H/12A — BERT-base geometry), ernie_large,
+ernie_titan_10b approximation (48L/4096H/64A ≈ 10B params) for config 5.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..ops.creation import arange, ones, zeros
+from ..ops.manipulation import reshape, unsqueeze
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings=512,
+                 type_vocab_size=2, dropout=0.1, use_mp=False):
+        super().__init__()
+        if use_mp:
+            from ..parallel.mp_layers import VocabParallelEmbedding
+            self.word_embeddings = VocabParallelEmbedding(vocab_size, hidden_size)
+        else:
+            self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings, hidden_size)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size, hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = arange(seq_len, dtype="int32")
+            position_ids = unsqueeze(position_ids, 0)
+        if token_type_ids is None:
+            token_type_ids = zeros(list(input_ids.shape), dtype="int32")
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieMLP(nn.Layer):
+    def __init__(self, hidden_size, intermediate_size, dropout=0.1, use_mp=False):
+        super().__init__()
+        if use_mp:
+            from ..parallel.mp_layers import ColumnParallelLinear, RowParallelLinear
+            self.fc1 = ColumnParallelLinear(hidden_size, intermediate_size,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(intermediate_size, hidden_size,
+                                         input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(hidden_size, intermediate_size)
+            self.fc2 = nn.Linear(intermediate_size, hidden_size)
+        self.act = nn.GELU()
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(self.act(self.fc1(x))))
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, hidden_size, num_heads, dropout=0.1, use_mp=False,
+                 use_sp=False, causal=False):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.causal = causal
+        self.use_sp = use_sp
+        if use_mp:
+            from ..parallel.mp_layers import ColumnParallelLinear, RowParallelLinear
+            self.qkv = ColumnParallelLinear(hidden_size, 3 * hidden_size,
+                                            gather_output=True)
+            self.out = RowParallelLinear(hidden_size, hidden_size)
+        else:
+            self.qkv = nn.Linear(hidden_size, 3 * hidden_size)
+            self.out = nn.Linear(hidden_size, hidden_size)
+        self.dropout_p = dropout
+
+    def forward(self, x, attn_mask=None):
+        from ..nn.functional.attention import scaled_dot_product_attention
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.use_sp:
+            from ..parallel.sp import sequence_parallel_attention
+            ctx = sequence_parallel_attention(q, k, v, impl="ring", causal=self.causal)
+        else:
+            ctx = scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.dropout_p if self.training else 0.0,
+                is_causal=self.causal, training=self.training)
+        ctx = reshape(ctx, [b, s, self.num_heads * self.head_dim])
+        return self.out(ctx)
+
+
+class ErnieLayer(nn.Layer):
+    def __init__(self, hidden_size, num_heads, intermediate_size, dropout=0.1,
+                 use_mp=False, use_sp=False, causal=False):
+        super().__init__()
+        self.attention = ErnieSelfAttention(hidden_size, num_heads, dropout, use_mp,
+                                            use_sp, causal)
+        self.mlp = ErnieMLP(hidden_size, intermediate_size, dropout, use_mp)
+        self.norm1 = nn.LayerNorm(hidden_size)
+        self.norm2 = nn.LayerNorm(hidden_size)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.norm1(x + self.dropout(self.attention(x, attn_mask)))
+        x = self.norm2(x + self.mlp(x))
+        return x
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 hidden_dropout_prob=0.1, use_mp=False, use_sp=False, causal=False):
+        super().__init__()
+        self.embeddings = ErnieEmbeddings(vocab_size, hidden_size,
+                                          max_position_embeddings, type_vocab_size,
+                                          hidden_dropout_prob, use_mp)
+        self.layers = nn.LayerList([
+            ErnieLayer(hidden_size, num_attention_heads, intermediate_size,
+                       hidden_dropout_prob, use_mp, use_sp, causal)
+            for _ in range(num_hidden_layers)])
+        self.pooler = nn.Linear(hidden_size, hidden_size)
+        self.pooler_act = nn.Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [B,S] 1/0 mask -> additive [B,1,1,S]
+            m = unsqueeze(unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - m.astype("float32")) * -1e4
+        for layer in self.layers:
+            x = layer(x, attention_mask)
+        pooled = self.pooler_act(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, ernie: ErnieModel, num_classes=2, dropout=0.1):
+        super().__init__()
+        self.ernie = ernie
+        self.dropout = nn.Dropout(dropout)
+        self.classifier = nn.Linear(ernie.pooler.weight.shape[1], num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM + NSP heads (the pretraining objective the benchmark measures)."""
+
+    def __init__(self, ernie: ErnieModel, use_mp=False):
+        super().__init__()
+        self.ernie = ernie
+        hidden = ernie.pooler.weight.shape[1]
+        self.transform = nn.Linear(hidden, hidden)
+        self.transform_act = nn.GELU()
+        self.transform_norm = nn.LayerNorm(hidden)
+        self.nsp = nn.Linear(hidden, 2)
+        self._use_mp = use_mp
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(self.transform_act(self.transform(seq)))
+        # weight-tied MLM logits against the (possibly vocab-sharded) embedding
+        from ..ops.math import matmul
+        w = self.ernie.embeddings.word_embeddings.weight
+        logits = matmul(h, w, transpose_y=True)
+        return logits, self.nsp(pooled)
+
+
+# ---- configs ----
+def ernie_base(**kw):
+    return ErnieModel(vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                      num_attention_heads=12, intermediate_size=3072, **kw)
+
+
+def ernie_large(**kw):
+    return ErnieModel(vocab_size=30522, hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096, **kw)
+
+
+def ernie_titan_10b(**kw):
+    """≈10B-parameter geometry for the sharding+pipeline config (config 5)."""
+    return ErnieModel(vocab_size=50304, hidden_size=4096, num_hidden_layers=48,
+                      num_attention_heads=64, intermediate_size=16384,
+                      max_position_embeddings=2048, **kw)
+
+
+bert_base = ernie_base
+bert_large = ernie_large

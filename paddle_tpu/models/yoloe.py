@@ -1,0 +1,95 @@
+"""PP-YOLOE-style detector (config 4: conv-heavy inference).
+
+Reference parity: PP-YOLOE as served through Paddle Inference in the
+reference ecosystem (CSPRepResNet backbone + PAN neck + ET-head, simplified
+to the inference-relevant compute graph: RepVGG-style blocks fold to single
+convs at deploy time, which is what the XLA program sees anyway).
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..ops.manipulation import concat
+
+
+class ConvBNAct(nn.Layer):
+    def __init__(self, in_c, out_c, k=3, stride=1, groups=1, act="silu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=(k - 1) // 2,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = nn.Silu() if act else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act else x
+
+
+class CSPResStage(nn.Layer):
+    def __init__(self, in_c, out_c, n_blocks, stride=2):
+        super().__init__()
+        self.down = ConvBNAct(in_c, out_c, 3, stride=stride)
+        mid = out_c // 2
+        self.conv1 = ConvBNAct(out_c, mid, 1)
+        self.conv2 = ConvBNAct(out_c, mid, 1)
+        self.blocks = nn.Sequential(*[
+            nn.Sequential(ConvBNAct(mid, mid, 3), ConvBNAct(mid, mid, 3))
+            for _ in range(n_blocks)])
+        self.fuse = ConvBNAct(out_c, out_c, 1)
+
+    def forward(self, x):
+        x = self.down(x)
+        a = self.conv1(x)
+        b = self.blocks(self.conv2(x))
+        return self.fuse(concat([a, b], axis=1))
+
+
+class PPYOLOEBackbone(nn.Layer):
+    def __init__(self, width_mult=0.5, depth_mult=0.33):
+        super().__init__()
+        w = lambda c: max(8, int(c * width_mult))
+        d = lambda n: max(1, round(n * depth_mult))
+        self.stem = nn.Sequential(ConvBNAct(3, w(32), 3, stride=2),
+                                  ConvBNAct(w(32), w(64), 3, stride=2))
+        self.stage1 = CSPResStage(w(64), w(128), d(3))
+        self.stage2 = CSPResStage(w(128), w(256), d(6))
+        self.stage3 = CSPResStage(w(256), w(512), d(3))
+        self.out_channels = [w(128), w(256), w(512)]
+
+    def forward(self, x):
+        x = self.stem(x)
+        c3 = self.stage1(x)
+        c4 = self.stage2(c3)
+        c5 = self.stage3(c4)
+        return c3, c4, c5
+
+
+class PPYOLOEHead(nn.Layer):
+    def __init__(self, in_channels, num_classes=80, num_anchors=1):
+        super().__init__()
+        self.heads = nn.LayerList([
+            nn.Conv2D(c, num_anchors * (5 + num_classes), 1) for c in in_channels])
+
+    def forward(self, feats):
+        return [h(f) for h, f in zip(self.heads, feats)]
+
+
+class PPYOLOE(nn.Layer):
+    def __init__(self, num_classes=80, width_mult=0.5, depth_mult=0.33):
+        super().__init__()
+        self.backbone = PPYOLOEBackbone(width_mult, depth_mult)
+        self.head = PPYOLOEHead(self.backbone.out_channels, num_classes)
+
+    def forward(self, x):
+        return self.head(self.backbone(x))
+
+
+def ppyoloe_s(**kw):
+    return PPYOLOE(width_mult=0.5, depth_mult=0.33, **kw)
+
+
+def ppyoloe_m(**kw):
+    return PPYOLOE(width_mult=0.75, depth_mult=0.67, **kw)
+
+
+def ppyoloe_l(**kw):
+    return PPYOLOE(width_mult=1.0, depth_mult=1.0, **kw)

@@ -1,1 +1,6 @@
-"""paddle.vision.models parity — re-exported from paddle_tpu.models."""
+"""paddle.vision.models parity — re-exports from paddle_tpu.models."""
+from ...models import (  # noqa: F401
+    LeNet, MobileNetV1, MobileNetV2, ResNet, VGG, mobilenet_v1, mobilenet_v2,
+    resnet18, resnet34, resnet50, resnet101, resnet152, vgg11, vgg13, vgg16, vgg19,
+    wide_resnet50_2, wide_resnet101_2,
+)
