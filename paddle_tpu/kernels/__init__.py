@@ -1,0 +1,2 @@
+"""Pallas TPU kernels (the hand-written hot ops; XLA handles the rest)."""
+from .flash_attention import flash_attention, flash_attention_arrays  # noqa: F401

@@ -1,0 +1,52 @@
+"""Flash-attention kernel tests (Pallas interpret mode on CPU)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels.flash_attention import (
+    _reference_bhsd, flash_attention, flash_attention_arrays,
+)
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_reference(causal):
+    b, s, h, d = 1, 256, 2, 64
+    q, k, v = _r(b, s, h, d), _r(b, s, h, d), _r(b, s, h, d)
+    out = flash_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                          paddle.to_tensor(v), causal=causal, block_q=128, block_k=128)
+    qb = jnp.swapaxes(jnp.asarray(q), 1, 2).reshape(b * h, s, d)
+    kb = jnp.swapaxes(jnp.asarray(k), 1, 2).reshape(b * h, s, d)
+    vb = jnp.swapaxes(jnp.asarray(v), 1, 2).reshape(b * h, s, d)
+    ref = np.asarray(_reference_bhsd(qb, kb, vb, causal))
+    ref = ref.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_grad_matches_reference_grad():
+    b, s, h, d = 1, 128, 2, 32
+    q, k, v = _r(b, s, h, d), _r(b, s, h, d), _r(b, s, h, d)
+    qt = paddle.to_tensor(q, stop_gradient=False)
+    out = flash_attention(qt, paddle.to_tensor(k), paddle.to_tensor(v), causal=True,
+                          block_q=128, block_k=128)
+    out.sum().backward()
+    g_flash = qt.gradient()
+
+    import jax
+    qb = jnp.swapaxes(jnp.asarray(q), 1, 2).reshape(b * h, s, d)
+    kb = jnp.swapaxes(jnp.asarray(k), 1, 2).reshape(b * h, s, d)
+    vb = jnp.swapaxes(jnp.asarray(v), 1, 2).reshape(b * h, s, d)
+    g_ref = jax.grad(lambda a: _reference_bhsd(a, kb, vb, True).sum())(qb)
+    g_ref = np.asarray(g_ref).reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(g_flash, g_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ragged_seq_falls_back():
+    b, s, h, d = 1, 100, 2, 32  # not a block multiple
+    out = flash_attention_arrays(jnp.asarray(_r(b, s, h, d)), jnp.asarray(_r(b, s, h, d)),
+                                 jnp.asarray(_r(b, s, h, d)), causal=False)
+    assert out.shape == (b, s, h, d)
