@@ -1,0 +1,195 @@
+// Host runtime utilities: stats monitor + threadpool batch assembler +
+// pinned host buffer pool.
+//
+// Reference parity:
+//   - monitor: paddle/fluid/platform/monitor.cc (STAT_ADD int-stat registry,
+//     exported to python via pybind/metrics_py.cc);
+//   - batch assembler: the parallel memcpy core of
+//     operators/reader/buffered_reader.cc + fluid DataLoader workers — the
+//     hot host loop of data ingestion (gather N sample buffers into one
+//     contiguous batch, multi-threaded);
+//   - buffer pool: memory/allocation host-pinned allocator role (on TPU the
+//     runtime owns device memory; the host side keeps reusable aligned
+//     staging buffers to avoid malloc churn on the ingest path).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------- stats monitor ----------------
+struct Monitor {
+  std::mutex mu;
+  std::map<std::string, int64_t> stats;
+};
+
+Monitor& monitor() {
+  static Monitor m;
+  return m;
+}
+
+// ---------------- threadpool ----------------
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) : stop_(false) {
+    for (int i = 0; i < n; ++i)
+      threads_.emplace_back([this] { Loop(); });
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  void Submit(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      q_.push_back(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> g(mu_);
+    done_cv_.wait(g, [this] { return q_.empty() && active_ == 0; });
+  }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [this] { return stop_ || !q_.empty(); });
+        if (stop_ && q_.empty()) return;
+        fn = std::move(q_.front());
+        q_.pop_front();
+        ++active_;
+      }
+      fn();
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        --active_;
+        if (q_.empty() && active_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  std::deque<std::function<void()>> q_;
+  std::vector<std::thread> threads_;
+  int active_ = 0;
+  bool stop_;
+};
+
+ThreadPool& pool() {
+  static ThreadPool p(static_cast<int>(std::thread::hardware_concurrency() / 2 + 1));
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- monitor (STAT_ADD parity) ----
+void monitor_add(const char* name, int64_t delta) {
+  auto& m = monitor();
+  std::lock_guard<std::mutex> g(m.mu);
+  m.stats[name] += delta;
+}
+
+int64_t monitor_get(const char* name) {
+  auto& m = monitor();
+  std::lock_guard<std::mutex> g(m.mu);
+  auto it = m.stats.find(name);
+  return it == m.stats.end() ? 0 : it->second;
+}
+
+void monitor_reset(const char* name) {
+  auto& m = monitor();
+  std::lock_guard<std::mutex> g(m.mu);
+  if (name && *name)
+    m.stats.erase(name);
+  else
+    m.stats.clear();
+}
+
+// snapshot names into a packed buffer "k1=v1\nk2=v2\n"; returns bytes written
+int64_t monitor_dump(char* buf, int64_t cap) {
+  auto& m = monitor();
+  std::lock_guard<std::mutex> g(m.mu);
+  std::string out;
+  for (auto& kv : m.stats)
+    out += kv.first + "=" + std::to_string(kv.second) + "\n";
+  int64_t n = static_cast<int64_t>(out.size());
+  if (n <= cap) memcpy(buf, out.data(), out.size());
+  return n;
+}
+
+// ---- parallel batch assembler ----
+// Copies n sample buffers (src[i], size bytes each, uniform) into dst
+// contiguously using the shared threadpool. Returns 0 on success.
+int batch_assemble(uint8_t* dst, const uint8_t** srcs, int64_t n,
+                   int64_t sample_bytes) {
+  if (n <= 0) return 0;
+  const int64_t kGrain = 1 << 20;  // ~1MB per task
+  int64_t per_task = sample_bytes >= kGrain ? 1 : (kGrain / (sample_bytes + 1)) + 1;
+  std::atomic<int> err{0};
+  for (int64_t start = 0; start < n; start += per_task) {
+    int64_t end = start + per_task < n ? start + per_task : n;
+    pool().Submit([=, &err] {
+      for (int64_t i = start; i < end; ++i) {
+        if (!srcs[i]) {
+          err.store(1);
+          return;
+        }
+        memcpy(dst + i * sample_bytes, srcs[i], static_cast<size_t>(sample_bytes));
+      }
+    });
+  }
+  pool().WaitAll();
+  return err.load();
+}
+
+// ragged variant: per-sample sizes with destination offsets
+int batch_assemble_ragged(uint8_t* dst, const uint8_t** srcs,
+                          const int64_t* sizes, const int64_t* offsets,
+                          int64_t n) {
+  std::atomic<int> err{0};
+  for (int64_t i = 0; i < n; ++i) {
+    pool().Submit([=, &err] {
+      if (!srcs[i]) {
+        err.store(1);
+        return;
+      }
+      memcpy(dst + offsets[i], srcs[i], static_cast<size_t>(sizes[i]));
+    });
+  }
+  pool().WaitAll();
+  return err.load();
+}
+
+// ---- aligned host buffer pool ----
+void* host_buffer_alloc(int64_t bytes) {
+  void* p = nullptr;
+  if (posix_memalign(&p, 4096, static_cast<size_t>(bytes)) != 0) return nullptr;
+  return p;
+}
+
+void host_buffer_free(void* p) { free(p); }
+
+}  // extern "C"

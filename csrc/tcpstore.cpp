@@ -1,0 +1,309 @@
+// TCPStore — rendezvous key-value store for multi-host bring-up.
+//
+// Reference parity: paddle/fluid/distributed/store/tcp_store.{h,cc} +
+// tcp_utils.cc (master socket accepting SET/GET/ADD/WAIT ops used to
+// exchange bootstrap ids). The TPU build uses it to exchange the
+// jax.distributed coordinator address and for barrier() across hosts when
+// no cluster scheduler provides a store.
+//
+// Protocol (little-endian):
+//   u8 op {0=SET,1=GET,2=ADD,3=WAIT,4=PING}
+//   u32 key_len, key bytes
+//   SET: u32 val_len, val bytes            -> reply u8 1
+//   GET: -> reply u32 val_len (0xFFFFFFFF if missing), val bytes
+//   ADD: i64 delta                         -> reply i64 new_value
+//   WAIT:                                  -> reply u8 1 once key exists
+//   PING:                                  -> reply u8 1
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> data;
+  std::map<std::string, int64_t> counters;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class Server {
+ public:
+  Server() : stop_(false), listen_fd_(-1), port_(0) {}
+
+  int Start(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return -1;
+    if (::listen(listen_fd_, 128) < 0) return -1;
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return port_;
+  }
+
+  void Stop() {
+    stop_ = true;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+  ~Server() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stop_) {
+      uint8_t op;
+      if (!read_full(fd, &op, 1)) break;
+      uint32_t klen;
+      if (op != 4 && !read_full(fd, &klen, 4)) break;
+      std::string key;
+      if (op != 4) {
+        key.resize(klen);
+        if (!read_full(fd, key.data(), klen)) break;
+      }
+      if (op == 0) {  // SET
+        uint32_t vlen;
+        if (!read_full(fd, &vlen, 4)) break;
+        std::vector<uint8_t> val(vlen);
+        if (!read_full(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> g(store_.mu);
+          store_.data[key] = std::move(val);
+        }
+        store_.cv.notify_all();
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else if (op == 1) {  // GET
+        std::vector<uint8_t> val;
+        bool found = false;
+        {
+          std::lock_guard<std::mutex> g(store_.mu);
+          auto it = store_.data.find(key);
+          if (it != store_.data.end()) {
+            val = it->second;
+            found = true;
+          }
+        }
+        uint32_t vlen = found ? static_cast<uint32_t>(val.size()) : 0xFFFFFFFFu;
+        if (!write_full(fd, &vlen, 4)) break;
+        if (found && !write_full(fd, val.data(), val.size())) break;
+      } else if (op == 2) {  // ADD
+        int64_t delta;
+        if (!read_full(fd, &delta, 8)) break;
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> g(store_.mu);
+          now = (store_.counters[key] += delta);
+        }
+        store_.cv.notify_all();
+        if (!write_full(fd, &now, 8)) break;
+      } else if (op == 3) {  // WAIT (blocks until key exists as data or counter)
+        std::unique_lock<std::mutex> g(store_.mu);
+        store_.cv.wait(g, [&] {
+          return stop_ || store_.data.count(key) || store_.counters.count(key);
+        });
+        g.unlock();
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else if (op == 4) {  // PING
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  Store store_;
+  std::atomic<bool> stop_;
+  int listen_fd_;
+  int port_;
+  std::thread accept_thread_;
+  std::vector<std::thread> workers_;
+};
+
+class Client {
+ public:
+  int Connect(const char* host, int port, int timeout_ms) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -1;
+    // retry-connect within the timeout (server may come up later)
+    int waited = 0;
+    while (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      ::close(fd_);
+      if (waited >= timeout_ms) return -1;
+      ::usleep(100 * 1000);
+      waited += 100;
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    }
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return 0;
+  }
+
+  int Set(const char* key, const uint8_t* val, uint32_t vlen) {
+    uint8_t op = 0;
+    uint32_t klen = static_cast<uint32_t>(strlen(key));
+    if (!write_full(fd_, &op, 1) || !write_full(fd_, &klen, 4) ||
+        !write_full(fd_, key, klen) || !write_full(fd_, &vlen, 4) ||
+        !write_full(fd_, val, vlen))
+      return -1;
+    uint8_t ok;
+    return read_full(fd_, &ok, 1) ? 0 : -1;
+  }
+
+  // returns value length, -1 missing, -2 error; copies into buf (cap bytes)
+  int64_t Get(const char* key, uint8_t* buf, uint32_t cap) {
+    uint8_t op = 1;
+    uint32_t klen = static_cast<uint32_t>(strlen(key));
+    if (!write_full(fd_, &op, 1) || !write_full(fd_, &klen, 4) ||
+        !write_full(fd_, key, klen))
+      return -2;
+    uint32_t vlen;
+    if (!read_full(fd_, &vlen, 4)) return -2;
+    if (vlen == 0xFFFFFFFFu) return -1;
+    std::vector<uint8_t> val(vlen);
+    if (!read_full(fd_, val.data(), vlen)) return -2;
+    if (vlen <= cap) memcpy(buf, val.data(), vlen);
+    return static_cast<int64_t>(vlen);
+  }
+
+  int64_t Add(const char* key, int64_t delta) {
+    uint8_t op = 2;
+    uint32_t klen = static_cast<uint32_t>(strlen(key));
+    if (!write_full(fd_, &op, 1) || !write_full(fd_, &klen, 4) ||
+        !write_full(fd_, key, klen) || !write_full(fd_, &delta, 8))
+      return INT64_MIN;
+    int64_t now;
+    return read_full(fd_, &now, 8) ? now : INT64_MIN;
+  }
+
+  int Wait(const char* key) {
+    uint8_t op = 3;
+    uint32_t klen = static_cast<uint32_t>(strlen(key));
+    if (!write_full(fd_, &op, 1) || !write_full(fd_, &klen, 4) ||
+        !write_full(fd_, key, klen))
+      return -1;
+    uint8_t ok;
+    return read_full(fd_, &ok, 1) ? 0 : -1;
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcpstore_server_start(int port, int* out_port) {
+  auto* s = new Server();
+  int p = s->Start(port);
+  if (p < 0) {
+    delete s;
+    return nullptr;
+  }
+  if (out_port) *out_port = p;
+  return s;
+}
+
+void tcpstore_server_stop(void* server) {
+  delete static_cast<Server*>(server);
+}
+
+void* tcpstore_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new Client();
+  if (c->Connect(host, port, timeout_ms) != 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void tcpstore_client_free(void* client) { delete static_cast<Client*>(client); }
+
+int tcpstore_set(void* client, const char* key, const uint8_t* val, uint32_t len) {
+  return static_cast<Client*>(client)->Set(key, val, len);
+}
+
+int64_t tcpstore_get(void* client, const char* key, uint8_t* buf, uint32_t cap) {
+  return static_cast<Client*>(client)->Get(key, buf, cap);
+}
+
+int64_t tcpstore_add(void* client, const char* key, int64_t delta) {
+  return static_cast<Client*>(client)->Add(key, delta);
+}
+
+int tcpstore_wait(void* client, const char* key) {
+  return static_cast<Client*>(client)->Wait(key);
+}
+
+}  // extern "C"

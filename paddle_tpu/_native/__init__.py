@@ -1,0 +1,242 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Built from `csrc/` on first import (g++ -O2 -shared), cached under
+`_native/build/`. Components:
+  - TCPStore server/client (rendezvous KV; reference tcp_store.cc parity)
+  - stats monitor (platform/monitor.cc STAT_ADD parity)
+  - threadpool batch assembler + aligned host buffers (buffered_reader /
+    DataLoader-worker hot loop)
+
+Everything has a pure-python fallback, so the package works even where the
+toolchain is unavailable; `available()` reports which path is active.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.normpath(os.path.join(_HERE, "..", "..", "csrc"))
+_BUILD = os.path.join(_HERE, "build")
+_LIB_PATH = os.path.join(_BUILD, "libpaddle_tpu_native.so")
+
+_lib = None
+_lock = threading.Lock()
+
+
+def _sources():
+    return [os.path.join(_CSRC, f) for f in ("tcpstore.cpp", "runtime.cpp")]
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    mt = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > mt for s in _sources() if os.path.exists(s))
+
+
+def _build() -> bool:
+    try:
+        os.makedirs(_BUILD, exist_ok=True)
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               "-o", _LIB_PATH] + _sources()
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            import warnings
+            warnings.warn(f"native build failed, using python fallback:\n{r.stderr[:500]}")
+            return False
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _needs_build() and not _build():
+            _lib = False
+            return _lib
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _lib = False
+            return _lib
+        # signatures
+        lib.tcpstore_server_start.restype = ctypes.c_void_p
+        lib.tcpstore_server_start.argtypes = [ctypes.c_int,
+                                              ctypes.POINTER(ctypes.c_int)]
+        lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_client_connect.restype = ctypes.c_void_p
+        lib.tcpstore_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                                ctypes.c_int]
+        lib.tcpstore_client_free.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_set.restype = ctypes.c_int
+        lib.tcpstore_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_uint32]
+        lib.tcpstore_get.restype = ctypes.c_int64
+        lib.tcpstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_uint32]
+        lib.tcpstore_add.restype = ctypes.c_int64
+        lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.tcpstore_wait.restype = ctypes.c_int
+        lib.tcpstore_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.monitor_add.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.monitor_get.restype = ctypes.c_int64
+        lib.monitor_get.argtypes = [ctypes.c_char_p]
+        lib.monitor_reset.argtypes = [ctypes.c_char_p]
+        lib.monitor_dump.restype = ctypes.c_int64
+        lib.monitor_dump.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.batch_assemble.restype = ctypes.c_int
+        lib.batch_assemble.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_void_p),
+                                       ctypes.c_int64, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+# ---------------- TCPStore ----------------
+class TCPStore:
+    """paddle.distributed.TCPStore parity (is_master spawns the server)."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1,
+                 timeout=30):
+        lib = _load()
+        self._lib = lib if lib else None
+        self._server = None
+        self._py = None
+        self.host = host
+        if self._lib:
+            if is_master:
+                out_port = ctypes.c_int(0)
+                self._server = self._lib.tcpstore_server_start(port,
+                                                               ctypes.byref(out_port))
+                if not self._server:
+                    raise RuntimeError(f"TCPStore: cannot bind port {port}")
+                port = out_port.value
+            self.port = port
+            self._client = self._lib.tcpstore_client_connect(
+                host.encode(), port, int(timeout * 1000))
+            if not self._client:
+                raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+        else:  # pure-python fallback (single-process only)
+            self._py = {}
+            self.port = port
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._py is not None:
+            self._py[key] = data
+            return
+        if self._lib.tcpstore_set(self._client, key.encode(), data, len(data)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        if self._py is not None:
+            return self._py[key]
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.tcpstore_get(self._client, key.encode(), buf, 1 << 20)
+        if n == -1:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int) -> int:
+        if self._py is not None:
+            self._py[key] = str(int(self._py.get(key, b"0")) + amount).encode()
+            return int(self._py[key])
+        v = self._lib.tcpstore_add(self._client, key.encode(), amount)
+        if v == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return v
+
+    def wait(self, keys) -> None:
+        keys = [keys] if isinstance(keys, str) else keys
+        if self._py is not None:
+            return
+        for k in keys:
+            if self._lib.tcpstore_wait(self._client, k.encode()) != 0:
+                raise RuntimeError("TCPStore.wait failed")
+
+    def __del__(self):
+        try:
+            if self._lib and getattr(self, "_client", None):
+                self._lib.tcpstore_client_free(self._client)
+            if self._lib and self._server:
+                self._lib.tcpstore_server_stop(self._server)
+        except Exception:
+            pass
+
+
+# ---------------- monitor ----------------
+def stat_add(name: str, delta: int = 1):
+    lib = _load()
+    if lib:
+        lib.monitor_add(name.encode(), delta)
+    else:
+        _PY_STATS[name] = _PY_STATS.get(name, 0) + delta
+
+
+def stat_get(name: str) -> int:
+    lib = _load()
+    if lib:
+        return lib.monitor_get(name.encode())
+    return _PY_STATS.get(name, 0)
+
+
+def stat_reset(name: str = ""):
+    lib = _load()
+    if lib:
+        lib.monitor_reset(name.encode())
+    elif name:
+        _PY_STATS.pop(name, None)
+    else:
+        _PY_STATS.clear()
+
+
+def stat_dump() -> dict:
+    lib = _load()
+    if lib:
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = lib.monitor_dump(buf, 1 << 20)
+        out = {}
+        for line in buf.raw[:n].decode().splitlines():
+            if "=" in line:
+                k, v = line.rsplit("=", 1)
+                out[k] = int(v)
+        return out
+    return dict(_PY_STATS)
+
+
+_PY_STATS: dict = {}
+
+
+# ---------------- batch assembler ----------------
+def batch_assemble(dst, samples) -> bool:
+    """Parallel-copy uniform numpy samples into the preallocated dst array.
+    Returns False (caller should fall back) when native is unavailable or
+    layouts are not contiguous."""
+    import numpy as np
+    lib = _load()
+    if not lib:
+        return False
+    if not dst.flags["C_CONTIGUOUS"]:
+        return False
+    n = len(samples)
+    sample_bytes = samples[0].nbytes
+    ptrs = (ctypes.c_void_p * n)()
+    for i, s in enumerate(samples):
+        if not (isinstance(s, np.ndarray) and s.flags["C_CONTIGUOUS"]
+                and s.nbytes == sample_bytes):
+            return False
+        ptrs[i] = s.ctypes.data
+    rc = lib.batch_assemble(dst.ctypes.data, ptrs, n, sample_bytes)
+    return rc == 0
