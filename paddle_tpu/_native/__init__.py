@@ -30,11 +30,27 @@ def _sources():
     return [os.path.join(_CSRC, f) for f in ("tcpstore.cpp", "runtime.cpp")]
 
 
+def _src_hash() -> str:
+    import hashlib
+    h = hashlib.sha256()
+    for s in _sources():
+        if os.path.exists(s):
+            with open(s, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+_HASH_PATH = os.path.join(_BUILD, "libpaddle_tpu_native.srchash")
+
+
 def _needs_build() -> bool:
-    if not os.path.exists(_LIB_PATH):
+    # The build dir is never committed (gitignored): the .so always comes
+    # from compiling csrc/ on this machine. A recorded source hash — not
+    # mtimes, which checkout resets — decides staleness.
+    if not os.path.exists(_LIB_PATH) or not os.path.exists(_HASH_PATH):
         return True
-    mt = os.path.getmtime(_LIB_PATH)
-    return any(os.path.getmtime(s) > mt for s in _sources() if os.path.exists(s))
+    with open(_HASH_PATH) as f:
+        return f.read().strip() != _src_hash()
 
 
 def _build() -> bool:
@@ -47,6 +63,8 @@ def _build() -> bool:
             import warnings
             warnings.warn(f"native build failed, using python fallback:\n{r.stderr[:500]}")
             return False
+        with open(_HASH_PATH, "w") as f:
+            f.write(_src_hash())
         return True
     except Exception:
         return False

@@ -2,19 +2,37 @@
 
 Reference parity: `python/paddle/amp/grad_scaler.py:26` wrapping AmpScaler
 (`fluid/dygraph/amp/loss_scaler.py`): scale loss, unscale grads, skip step on
-non-finite grads, grow/shrink the scale (check_finite_and_unscale +
-update_loss_scaling ops). bf16 on TPU usually runs with use_dynamic_loss_
-scaling=False; fp16 parity keeps the full machinery.
+non-finite grads, grow/shrink the scale. The reference fuses the finiteness
+scan into one kernel (`operators/amp/check_finite_and_unscale_op.cu`); here
+the same fusion is a single jitted reduction over all grads — one device
+program, one host sync per unscale, instead of a per-parameter D2H loop.
+
+Per-optimizer state (reference OptimizerState, grad_scaler.py:192-207)
+guarantees grads are unscaled exactly once even in the
+`scaler.unscale_(opt) -> clip -> scaler.step(opt)` pattern.
 """
 from __future__ import annotations
 
-import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
 
+@jax.jit
+def _fused_unscale(grads, inv):
+    """Scale every grad by inv and AND-reduce finiteness in one XLA program."""
+    scaled = [g * inv.astype(g.dtype) for g in grads]
+    finite = jnp.asarray(True)
+    for g in scaled:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return scaled, jnp.logical_not(finite)
+
+
 class GradScaler:
+    # per-optimizer lifecycle (reference OptimizerState)
+    _READY, _UNSCALED, _STEPPED = 0, 1, 2
+
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
                  decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
                  use_dynamic_loss_scaling=True):
@@ -27,6 +45,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._opt_states: dict = {}
 
     def is_enable(self):
         return self._enable
@@ -45,33 +64,51 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        inv = 1.0 / self._scale
-        found = False
-        for p in (optimizer._parameter_list or []):
-            if p.grad is None:
-                continue
-            g = p.grad._value if isinstance(p.grad, Tensor) else p.grad
-            g = g * inv
-            if not bool(jnp.all(jnp.isfinite(g))):
-                found = True
-            p.grad = g
-        self._found_inf = found
+        state = self._opt_states.get(id(optimizer), self._READY)
+        if state == self._UNSCALED:
+            raise RuntimeError("unscale_() has already been called on this "
+                               "optimizer since the last update().")
+        if state == self._STEPPED:
+            raise RuntimeError("unscale_() is being called after step().")
+        params = [p for p in (optimizer._parameter_list or [])
+                  if p.grad is not None]
+        if params:
+            grads = [p.grad._value if isinstance(p.grad, Tensor) else p.grad
+                     for p in params]
+            inv = jnp.float32(1.0 / self._scale)
+            scaled, found = _fused_unscale(grads, inv)
+            self._found_inf = bool(found) or self._found_inf  # one host sync
+            for p, g in zip(params, scaled):
+                p.grad = g
+        self._opt_states[id(optimizer)] = self._UNSCALED
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        state = self._opt_states.get(id(optimizer), self._READY)
+        if state == self._STEPPED:
+            raise RuntimeError("step() has already been called on this "
+                               "optimizer since the last update().")
+        if state != self._UNSCALED:
+            self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        self._opt_states[id(optimizer)] = self._STEPPED
+        # Auto-advance the scale only once every optimizer seen this round
+        # has stepped — a second optimizer still in UNSCALED state must keep
+        # its marker (and the shared found_inf) until its own step().
+        if all(v == self._STEPPED for v in self._opt_states.values()):
+            self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
 
     def update(self):
+        self._opt_states.clear()
         if not (self._enable and self._dynamic):
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1

@@ -15,6 +15,7 @@ through the stored VJP closures. Gradients accumulate on leaf tensors'
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
@@ -24,22 +25,35 @@ import jax.numpy as jnp
 class _State(threading.local):
     def __init__(self):
         self.enabled = True
-        self.tape: List["Node"] = []
+        self.seq = 0
+        # Live-node registry for introspection only (tape_size). Weak refs:
+        # node lifetime is keyed to output-tensor reachability, so side
+        # branches (metrics, logging) are GC'd when their tensors die instead
+        # of accumulating forever on a global list.
+        self.live: "weakref.WeakSet[Node]" = weakref.WeakSet()
 
 
 _STATE = _State()
 
 
 class Node:
-    """One traced op: inputs, outputs, and the VJP closure linking them."""
+    """One traced op: inputs, outputs, and the VJP closure linking them.
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "name")
+    Nodes are NOT held by any global structure (only weakly, for stats);
+    the graph is reachable solely through output tensors' `_node` refs and
+    `node.inputs -> tensor -> _node` chains. `seq` preserves creation order
+    so backward can process in reverse-creation order without a tape list.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "seq", "__weakref__")
 
     def __init__(self, vjp_fn, inputs, outputs, name):
         self.vjp_fn = vjp_fn
         self.inputs = inputs      # list[Tensor] (diff inputs, positional)
         self.outputs = outputs    # list[Tensor] (diff outputs, positional)
         self.name = name
+        _STATE.seq += 1
+        self.seq = _STATE.seq
 
 
 def is_grad_enabled() -> bool:
@@ -107,8 +121,23 @@ def record_node(vjp_fn, diff_inputs, out_tensors, name):
     for t in out_tensors:
         t._node = node
         t.stop_gradient = False
-    _STATE.tape.append(node)
+    _STATE.live.add(node)
     return node
+
+
+def _collect(roots):
+    """Walk ancestor nodes from root nodes; return them sorted newest-first."""
+    needed = {}
+    stack = [n for n in roots if n is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in needed:
+            continue
+        needed[id(node)] = node
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in needed:
+                stack.append(t._node)
+    return sorted(needed.values(), key=lambda n: -n.seq)
 
 
 def _accumulate(store: dict, tensor, value):
@@ -119,7 +148,6 @@ def _accumulate(store: dict, tensor, value):
 
 def backward(root, grad=None, retain_graph: bool = False):
     """Run the tape backward from `root` (paddle.Tensor.backward parity)."""
-    tape = _STATE.tape
     if root._node is None:
         if not root.stop_gradient:
             g = jnp.ones_like(root._value) if grad is None else grad
@@ -136,23 +164,11 @@ def backward(root, grad=None, retain_graph: bool = False):
     elif hasattr(grad, "_value"):
         grad = grad._value
 
-    # 1. mark ancestor nodes of root (so unrelated graphs on the tape survive)
-    needed = set()
-    stack = [root._node]
-    while stack:
-        node = stack.pop()
-        if id(node) in needed:
-            continue
-        needed.add(id(node))
-        for t in node.inputs:
-            if t._node is not None and id(t._node) not in needed:
-                stack.append(t._node)
+    ordered = _collect([root._node])
 
     cot: dict = {id(root): grad}
     with no_grad():
-        for node in reversed(tape):
-            if id(node) not in needed:
-                continue
+        for node in ordered:
             out_cots = []
             any_live = False
             for t in node.outputs:
@@ -179,14 +195,12 @@ def backward(root, grad=None, retain_graph: bool = False):
                     _accumulate(cot, t, c)
 
     if not retain_graph:
-        kept = [n for n in tape if id(n) not in needed]
-        _STATE.tape = kept
-        for n in tape:
-            if id(n) in needed:
-                for t in n.outputs:
-                    t._node = None
-                n.vjp_fn = None
-                n.inputs = n.outputs = ()
+        for n in ordered:
+            for t in n.outputs:
+                t._node = None
+            n.vjp_fn = None
+            n.inputs = n.outputs = ()
+            _STATE.live.discard(n)
 
 
 def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
@@ -197,18 +211,7 @@ def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph
         raise NotImplementedError("double grad: use paddle_tpu.autograd.functional (jax-based)")
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    tape = _STATE.tape
-
-    needed = set()
-    stack = [o._node for o in outs if o._node is not None]
-    while stack:
-        node = stack.pop()
-        if id(node) in needed:
-            continue
-        needed.add(id(node))
-        for t in node.inputs:
-            if t._node is not None:
-                stack.append(t._node)
+    ordered = _collect([o._node for o in outs])
 
     cot: dict = {}
     for i, o in enumerate(outs):
@@ -219,12 +222,9 @@ def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph
             g = jnp.ones_like(o._value)
         _accumulate(cot, o, g)
 
-    target_ids = {id(t): i for i, t in enumerate(ins)}
     results = [None] * len(ins)
     with no_grad():
-        for node in reversed(tape):
-            if id(node) not in needed:
-                continue
+        for node in ordered:
             out_cots, any_live = [], False
             for t in node.outputs:
                 c = cot.get(id(t))
@@ -248,8 +248,14 @@ def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph
 
 
 def clear_tape():
-    _STATE.tape = []
+    """Break every live node's links so the whole recorded graph is freed."""
+    for n in list(_STATE.live):
+        for t in n.outputs:
+            t._node = None
+        n.vjp_fn = None
+        n.inputs = n.outputs = ()
+    _STATE.live = weakref.WeakSet()
 
 
 def tape_size() -> int:
-    return len(_STATE.tape)
+    return len(_STATE.live)

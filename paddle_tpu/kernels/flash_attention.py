@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention (forward kernel + recompute backward).
+"""Pallas TPU flash-attention (forward + backward kernels).
 
 Reference parity: the reference's fused attention
 (`operators/fused/fused_attention_op.cu`, `fmha_ref.h`) is an UNFUSED-softmax
@@ -6,10 +6,17 @@ FMHA; this kernel is the TPU-native upgrade: online-softmax tiling keeps the
 S×S score matrix out of HBM entirely (O(S) memory), q/k/v tiles stream
 HBM→VMEM and hit the MXU per block.
 
-Grid: (batch*heads, q_blocks); inner fori_loop over k blocks with f32
-running (max, sumexp, acc) carries. Causal masking prunes whole k-blocks via
-the loop trip count. Backward recomputes through the XLA reference path
-(flash-bwd kernel planned next round).
+Forward grid: (batch*heads, q_blocks); inner fori_loop over k blocks with
+f32 running (max, sumexp, acc) carries; also emits per-row logsumexp.
+Causal masking prunes whole k-blocks via the loop trip count.
+
+Backward: two kernels, both recomputing p = exp(s - lse) inside the kernel
+from the saved logsumexp (no S×S materialization, f32 accumulators):
+  - dq kernel, grid (BH, q_blocks): loops k blocks, dq += ds @ K.
+  - dk/dv kernel, grid (BH, k_blocks): loops q blocks (causal: starting at
+    the first unmasked q block), dv += pᵀ @ dO, dk += dsᵀ @ Q.
+where ds = p * (dO·Vᵀ − delta), delta = rowsum(dO ∘ O) precomputed in XLA
+(semantics oracle: `fmha_ref.h` softmax-grad algebra).
 """
 from __future__ import annotations
 
@@ -25,7 +32,8 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_len):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
+               seq_len):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)          # [Bq, D]
     block_q = q.shape[0]
@@ -60,11 +68,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_len):
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     a0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, kmax, body, (m0, l0, a0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lsafe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / lsafe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(lsafe))[:, 0]
 
 
 def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
-    """q/k/v: [BH, S, D] -> out [BH, S, D]."""
+    """q/k/v: [BH, S, D] -> (out [BH, S, D], lse [BH, S] f32)."""
     bh, s, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -74,16 +84,142 @@ def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
     grid = (bh, s // block_q)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, s), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=(pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, block_q), lambda b, i: (b, i))),
         interpret=interpret,
     )(q, k, v)
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                      *, scale, causal, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)             # [Bq, D]
+    do = do_ref[0].astype(jnp.float32)           # [Bq, D]
+    lse = lse_ref[0][:, None]                    # [Bq, 1]
+    delta = delta_ref[0][:, None]                # [Bq, 1]
+    block_q = q.shape[0]
+    n_kb = seq_len // block_k
+    if causal:
+        kmax = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, n_kb)
+    else:
+        kmax = n_kb
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        p = jnp.exp(s - lse)                                        # [Bq, Bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    dq_ref[0] = jax.lax.fori_loop(0, kmax, body, dq0).astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, *, scale, causal, block_q, seq_len):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)             # [Bk, D]
+    v = v_ref[0].astype(jnp.float32)             # [Bk, D]
+    block_k = k.shape[0]
+    n_qb = seq_len // block_q
+    # causal: q blocks strictly before this k block see nothing of it
+    qmin = (ki * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        p = jnp.exp(s - lse)                                        # [Bq, Bk]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    d = k.shape[1]
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qmin, n_qb, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
+                    interpret):
+    """Backward: returns (dq, dk, dv), each [BH, S, D]."""
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    full = lambda b, i: (b, 0, 0)  # noqa: E731
+    full1 = lambda b, i: (b, 0)    # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_len=s),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_len=s),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, s), full1),
+            pl.BlockSpec((1, s), full1),
+        ],
+        out_specs=(pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _reference_bhsd(q, k, v, causal):
@@ -100,20 +236,21 @@ def _reference_bhsd(q, k, v, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_core(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd_bhsd(q, k, v, causal=causal, block_q=block_q,
-                           block_k=block_k, interpret=interpret)
+    out, _ = _flash_fwd_bhsd(q, k, v, causal=causal, block_q=block_q,
+                             block_k=block_k, interpret=interpret)
+    return out
 
 
 def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_fwd_bhsd(q, k, v, causal=causal, block_q=block_q,
-                          block_k=block_k, interpret=interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_bhsd(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference_bhsd(a, b, c, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd_bhsd(q, k, v, o, lse, g, causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -128,15 +265,17 @@ def flash_attention_arrays(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
     def to_bhsd(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
 
-    # pad seq to a block multiple (masked out by softmax via -inf scores)
     bq = min(block_q, max(128, 1 << (s - 1).bit_length()) if s < block_q else block_q)
-    pad = (-s) % min(bq, block_k if s >= block_k else s)
+    bq = min(bq, s)
+    bk = min(block_k, s)
     qb, kb_, vb = to_bhsd(q), to_bhsd(k), to_bhsd(v)
-    if pad:
-        # fall back to reference for ragged lengths (rare; pad-free path planned)
+    # The kernel grid is s//bq q-blocks x s//bk k-blocks: seq must divide by
+    # BOTH chosen blocks or tail rows/keys would be silently dropped. Ragged
+    # lengths fall back to the fused XLA reference.
+    if s % bq or s % bk:
         out = _reference_bhsd(qb, kb_, vb, causal)
     else:
-        out = _flash_core(qb, kb_, vb, causal, bq, min(block_k, s), interpret)
+        out = _flash_core(qb, kb_, vb, causal, bq, bk, interpret)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
 
 

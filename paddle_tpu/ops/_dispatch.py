@@ -11,12 +11,30 @@ from __future__ import annotations
 
 from typing import Callable, Sequence
 
+import jax
 import jax.numpy as jnp
 
+_TracerTypes = (jax.core.Tracer,)
+
 from ..core import autograd
+from ..core import flags as _flags
 from ..core.tensor import Tensor
 
 __all__ = ["run_op", "unary_op", "binary_op", "to_arr", "ensure_tensor", "inplace_from"]
+
+
+def _check_nan_inf(name: str, outs) -> None:
+    """FLAGS_check_nan_inf parity (`operator.cc:1171` ->
+    `details/nan_inf_utils_detail.cc:314`): scan op outputs, abort on bad
+    values. Debug-only path — it host-syncs every op, exactly like the
+    reference's device-wide scan."""
+    seq = outs if isinstance(outs, tuple) else (outs,)
+    for i, o in enumerate(seq):
+        if isinstance(o, jnp.ndarray) and jnp.issubdtype(o.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"Operator {name} output {i} contains NaN/Inf "
+                    "(FLAGS_check_nan_inf=True)")
 
 
 def to_arr(x):
@@ -37,6 +55,9 @@ def run_op(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):
     static attrs). Returns Tensor or tuple[Tensor].
     """
     outs, vjp = autograd.apply_op(fn, tensors, name=name)
+    if _flags.flag("check_nan_inf") and not isinstance(
+            outs[0] if isinstance(outs, tuple) else outs, _TracerTypes):
+        _check_nan_inf(name, outs)
     if isinstance(outs, tuple):
         wrapped = tuple(Tensor(o) for o in outs)
         if vjp is not None:

@@ -165,11 +165,26 @@ class Optimizer:
     def init_state(self, params):
         return [self._create_slots(p) for p in params]
 
-    def functional_update(self, values, grads, slots, lr, t, params_meta=None):
-        params = self._parameter_list or []
+    def functional_update(self, values, grads, slots, lr, t, params_meta=None,
+                          grad_clip="default"):
+        """Pure update over value arrays.
+
+        `params_meta` supplies the parameter OBJECTS the values belong to, so
+        per-param coefficients (weight decay, need_clip, lr scale) align with
+        them — required whenever `values` is not the optimizer's full
+        `_parameter_list` (e.g. one pipeline stage's slice). `grad_clip=None`
+        disables in-update clipping for callers that pre-clip globally.
+        """
+        params = list(params_meta) if params_meta is not None \
+            else (self._parameter_list or [])
+        if params and len(params) != len(values):
+            raise ValueError(
+                f"functional_update: {len(values)} values but {len(params)} "
+                "params — pass params_meta matching the values")
         wds = tuple(self._param_wd(p) for p in params) if params else (self._weight_decay,) * len(values)
         need_clip = tuple(getattr(p, "need_clip", True) for p in params) or (True,) * len(values)
-        fn = self._make_update(self._grad_clip, wds, need_clip,
+        clip = self._grad_clip if grad_clip == "default" else grad_clip
+        fn = self._make_update(clip, wds, need_clip,
                                tuple(p.optimize_attr.get("learning_rate", 1.0) for p in params)
                                or (1.0,) * len(values))
         return fn(values, grads, slots, lr, t)

@@ -1,4 +1,4 @@
-"""Pipeline-parallel engine — single-controller microbatch pipelining.
+"""Pipeline-parallel engine — single-controller 1F1B microbatch pipelining.
 
 Reference parity: `fleet/meta_parallel/pipeline_parallel.py:30,152`
 (PipelineParallel.train_batch, 1F1B `_forward_step:229`) + p2p via
@@ -9,14 +9,23 @@ a per-stage sub-`Mesh` (axes dp×mp inside the stage — the reference's
 hybrid 4-D grid with the pp axis peeled off). Stage programs are pjit'ed on
 their submesh; microbatch activations move stage→stage as device_put between
 differently-placed arrays (ICI device-to-device DMA — the `send_v2/recv_v2`
-replacement). The single controller enqueues work asynchronously, so stage
-s can compute microbatch m while stage s+1 computes m-1: the 1F1B overlap
-emerges from XLA's async dispatch rather than per-rank schedules.
+replacement).
+
+Schedule: real 1F1B. Each stage follows the classic per-rank sequence —
+warmup = min(n_micro, num_stages - stage - 1) forwards, then alternating
+forward/backward in steady state, then a backward drain
+(reference `pipeline_parallel.py:152`'s startup/steady/cooldown loops). The
+single controller merges the per-stage sequences with a dependency-driven
+worklist, so stage s's next op is enqueued the moment its input activation
+(forward) or output-gradient (backward) exists; XLA's async dispatch runs
+enqueued work on different stage meshes concurrently. In-flight saved
+activations per stage are bounded by its warmup depth + 1 <= num_stages
+(`last_peak_inflight` exposes the measured peak), unlike GPipe's n_micro.
 
 Backward is rematerialized: each stage's backward recomputes its forward
 from the saved stage INPUT (recompute-in-backward — the reference's
 RecomputeOptimizer fused into the schedule), so activation memory is
-O(microbatches × boundary) instead of O(all intermediates).
+O(stages × boundary) instead of O(all intermediates).
 """
 from __future__ import annotations
 
@@ -46,7 +55,7 @@ class PipelineParallel:
         self._stage_meshes = self._make_stage_meshes()
         self._fwd_fns: List = [None] * self.num_stages
         self._bwd_fns: List = [None] * self.num_stages
-        self._upd_fns: List = [None] * self.num_stages
+        self._upd_fns: dict = {}
         self._stage_state = []
         for s, mod in enumerate(self.stages):
             trainable, frozen = split_state(mod)
@@ -141,55 +150,94 @@ class PipelineParallel:
 
     # ---- the schedule ----
     def forward_backward_pipeline(self, data, labels):
-        """GPipe-with-remat schedule; returns (mean_loss, stage_grads)."""
+        """1F1B schedule with remat backward; returns (mean_loss, stage_grads)."""
         if not self._placed:
             self._place_stage_params()
-        n_micro = self.accumulate_steps
-        micro_x = jnp.split(data, n_micro, axis=0)
-        micro_y = [jnp.split(l, n_micro, axis=0) for l in labels]
+        S, M = self.num_stages, self.accumulate_steps
+        micro_x = jnp.split(data, M, axis=0)
+        micro_y = [jnp.split(l, M, axis=0) for l in labels]
 
-        stage_params = []
-        stage_buffers = []
+        stage_params, stage_buffers = [], []
         for s, mod in enumerate(self.stages):
             trainable, frozen = split_state(mod)
             pnames, bnames = self._stage_state[s]
             stage_params.append([trainable[n]._value for n in pnames])
             stage_buffers.append([frozen[n]._value for n in bnames])
 
-        # forward: stream each microbatch through the stage chain (async dispatch
-        # lets stage s work on micro m while stage s+1 handles m-1)
-        keys = [[rnd.default_generator().next_key() for _ in range(self.num_stages)]
-                for _ in range(n_micro)]
-        boundary_inputs = [[None] * self.num_stages for _ in range(n_micro)]
-        outs = [None] * n_micro
-        for m in range(n_micro):
-            x = micro_x[m]
-            for s in range(self.num_stages):
-                mesh = self._stage_meshes[s]
-                x = jax.device_put(x, NamedSharding(mesh, P("dp")))  # ICI p2p hop
-                boundary_inputs[m][s] = x
-                x = self._stage_fwd(s)(stage_params[s], stage_buffers[s], x, keys[m][s])
-            outs[m] = x
+        keys = [[rnd.default_generator().next_key() for _ in range(S)]
+                for _ in range(M)]
 
-        # loss + backward per microbatch, reverse stage order
-        grads = [None] * self.num_stages
-        losses = []
-        for m in range(n_micro):
-            lab = [y[m] for y in micro_y]
-            loss, g = self._loss_grad(outs[m], lab)
-            losses.append(loss)
-            for s in reversed(range(self.num_stages)):
+        # Per-stage 1F1B op sequence (reference pipeline_parallel.py:152):
+        # warmup forwards, steady-state F/B pairs, backward drain.
+        seqs = []
+        for s in range(S):
+            warm = min(M, S - s - 1)
+            seq = ["F"] * warm
+            for _ in range(M - warm):
+                seq += ["F", "B"]
+            seq += ["B"] * warm
+            seqs.append(seq)
+
+        ptr = [0] * S          # position in each stage's sequence
+        fcnt = [0] * S         # next microbatch to forward, per stage
+        bcnt = [0] * S         # next microbatch to backward, per stage
+        acts = [dict() for _ in range(S)]   # acts[s][m]: input ready for fwd
+        gin = [dict() for _ in range(S)]    # gin[s][m]: out-grad ready for bwd
+        saved = [dict() for _ in range(S)]  # boundary inputs awaiting backward
+        grads = [None] * S
+        losses = [None] * M
+        for m in range(M):
+            acts[0][m] = micro_x[m]
+        peak = 0
+        remaining = 2 * S * M
+
+        while remaining:
+            progressed = False
+            for s in range(S):
+                if ptr[s] >= len(seqs[s]):
+                    continue
                 mesh = self._stage_meshes[s]
-                g = jax.device_put(g, NamedSharding(mesh, P("dp")))
-                gp, g = self._stage_bwd(s)(stage_params[s], stage_buffers[s],
-                                           boundary_inputs[m][s], g, keys[m][s])
-                if grads[s] is None:
-                    grads[s] = gp
+                if seqs[s][ptr[s]] == "F":
+                    m = fcnt[s]
+                    if m not in acts[s]:
+                        continue  # upstream activation not produced yet
+                    x = jax.device_put(acts[s].pop(m),
+                                       NamedSharding(mesh, P("dp")))  # ICI hop
+                    saved[s][m] = x
+                    out = self._stage_fwd(s)(stage_params[s], stage_buffers[s],
+                                             x, keys[m][s])
+                    if s == S - 1:
+                        lab = [y[m] for y in micro_y]
+                        loss, g = self._loss_grad(out, lab)
+                        losses[m] = loss
+                        gin[s][m] = g
+                    else:
+                        acts[s + 1][m] = out
+                    fcnt[s] += 1
                 else:
-                    grads[s] = [a + b for a, b in zip(grads[s], gp)]
-        scale = 1.0 / n_micro
+                    m = bcnt[s]
+                    if m not in gin[s]:
+                        continue  # downstream gradient not produced yet
+                    g = jax.device_put(gin[s].pop(m),
+                                       NamedSharding(mesh, P("dp")))
+                    gp, gx = self._stage_bwd(s)(stage_params[s], stage_buffers[s],
+                                                saved[s].pop(m), g, keys[m][s])
+                    grads[s] = gp if grads[s] is None else \
+                        [a + b for a, b in zip(grads[s], gp)]
+                    if s > 0:
+                        gin[s - 1][m] = gx
+                    bcnt[s] += 1
+                ptr[s] += 1
+                remaining -= 1
+                progressed = True
+                peak = max(peak, max(len(d) for d in saved))
+            if not progressed:
+                raise RuntimeError("pipeline schedule deadlock (bug)")
+
+        self.last_peak_inflight = peak  # <= num_stages by construction
+        scale = 1.0 / M
         grads = [[g * scale for g in gs] for gs in grads]
-        mean_loss = sum(jnp.mean(l) for l in losses) / n_micro
+        mean_loss = sum(jnp.mean(l) for l in losses) / M
         return mean_loss, grads
 
     def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
@@ -210,21 +258,55 @@ class PipelineParallel:
                     self._opt_slots.append(optimizer.init_state(pts))
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
             t = jnp.asarray(optimizer._step_count + 1, jnp.float32)
+
+            stage_ptensors = []
             for s, mod in enumerate(self.stages):
                 trainable, _ = split_state(mod)
-                pnames = self._stage_state[s][0]
-                vals = [trainable[n]._value for n in pnames]
-                if self._upd_fns[s] is None:
+                stage_ptensors.append([trainable[n]
+                                       for n in self._stage_state[s][0]])
+
+            # ClipGradByGlobalNorm must see the norm over ALL stages' params,
+            # not per-stage: pre-scale grads by the global factor here and
+            # disable in-update clipping below.
+            from ..nn.clip import ClipGradByGlobalNorm
+            clip = getattr(optimizer, "_grad_clip", None)
+            clip_arg = "default"
+            if isinstance(clip, ClipGradByGlobalNorm):
+                clip_arg = None
+                sq = 0.0
+                for s in range(self.num_stages):
+                    parts = [jnp.sum(g.astype(jnp.float32) ** 2)
+                             for p, g in zip(stage_ptensors[s], grads[s])
+                             if getattr(p, "need_clip", True)]
+                    if parts:  # one reduce + one host sync per STAGE
+                        sq += float(sum(parts))
+                gn = sq ** 0.5
+                factor = clip.clip_norm / max(gn, clip.clip_norm)
+                if factor < 1.0:
+                    for s in range(self.num_stages):
+                        grads[s] = [g * jnp.asarray(factor, g.dtype)
+                                    if getattr(p, "need_clip", True) else g
+                                    for p, g in zip(stage_ptensors[s], grads[s])]
+
+            for s in range(self.num_stages):
+                pts = stage_ptensors[s]
+                vals = [p._value for p in pts]
+                # clip_arg is part of the cache key: grad_clip set/changed
+                # after the first step must not reuse a stale closure.
+                fkey = (s, None if clip_arg is None else type(clip).__name__)
+                if fkey not in self._upd_fns:
                     opt = optimizer
 
-                    def upd(values, gs, slots, lr_, t_):
-                        return opt.functional_update(values, gs, slots, lr_, t_)
+                    def upd(values, gs, slots, lr_, t_, _pts=pts, _clip=clip_arg):
+                        return opt.functional_update(values, gs, slots, lr_, t_,
+                                                     params_meta=_pts,
+                                                     grad_clip=_clip)
 
-                    self._upd_fns[s] = jax.jit(upd, donate_argnums=(0, 2))
-                new_vals, self._opt_slots[s] = self._upd_fns[s](
+                    self._upd_fns[fkey] = jax.jit(upd, donate_argnums=(0, 2))
+                new_vals, self._opt_slots[s] = self._upd_fns[fkey](
                     vals, grads[s], self._opt_slots[s], lr, t)
-                for n, v in zip(pnames, new_vals):
-                    trainable[n]._value = v
+                for p, v in zip(pts, new_vals):
+                    p._value = v
             optimizer._step_count += 1
         return Tensor(loss)
 

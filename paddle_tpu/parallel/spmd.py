@@ -140,8 +140,8 @@ class SPMDTrainStep:
                     return loss._value if isinstance(loss, Tensor) else loss
 
                 loss, grads = jax.value_and_grad(fwd)(params)
-                new_params, new_slots = optimizer.functional_update(params, grads,
-                                                                    slots, lr, t)
+                new_params, new_slots = optimizer.functional_update(
+                    params, grads, slots, lr, t, params_meta=ptensors)
                 return new_params, new_slots, loss
             finally:
                 rnd.pop_trace_key()

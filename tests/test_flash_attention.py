@@ -50,3 +50,36 @@ def test_ragged_seq_falls_back():
     out = flash_attention_arrays(jnp.asarray(_r(b, s, h, d)), jnp.asarray(_r(b, s, h, d)),
                                  jnp.asarray(_r(b, s, h, d)), causal=False)
     assert out.shape == (b, s, h, d)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block_q,block_k", [(128, 128), (256, 128), (128, 256)])
+def test_bwd_kernel_all_grads_match_reference(causal, block_q, block_k):
+    # dq/dk/dv from the Pallas backward kernels vs XLA reference VJP,
+    # including unequal block sizes (regression: tail-block fallback check).
+    import jax
+    bh, s, d = 2, 256, 32
+    q, k, v = (jnp.asarray(_r(bh, s, d)) for _ in range(3))
+    g = jnp.asarray(_r(bh, s, d))
+    from paddle_tpu.kernels.flash_attention import _flash_core
+
+    def f(a, b_, c):
+        return (_flash_core(a, b_, c, causal, block_q, block_k, True) * g).sum()
+
+    dq, dk, dv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(
+        lambda a, b_, c: (_reference_bhsd(a, b_, c, causal) * g).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=2e-4, atol=2e-4)
+
+
+def test_unequal_blocks_ragged_for_one_falls_back():
+    # seq divisible by block_q but not block_k must NOT take the kernel path
+    b, s, h, d = 1, 384, 1, 32   # 384 % 128 == 0, 384 % 256 != 0
+    out = flash_attention_arrays(jnp.asarray(_r(b, s, h, d)),
+                                 jnp.asarray(_r(b, s, h, d)),
+                                 jnp.asarray(_r(b, s, h, d)),
+                                 causal=True, block_q=128, block_k=256)
+    assert out.shape == (b, s, h, d)

@@ -143,6 +143,32 @@ class TestAmp:
         loss = paddle.to_tensor(np.asarray(2.0, "float32"))
         assert float(scaler.scale(loss)) == 8.0
 
+    def test_grad_scaler_unscale_clip_step_unscales_once(self):
+        # the supported unscale_ -> clip -> step pattern must divide grads by
+        # the loss scale exactly once (reference OptimizerState guard).
+        p = paddle.Parameter(np.ones(2, dtype="float32"))
+        p.grad = paddle.to_tensor(np.array([8.0, 8.0], "float32"))._value
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       use_dynamic_loss_scaling=False)
+        scaler.unscale_(opt)
+        np.testing.assert_allclose(np.asarray(p.grad), [2.0, 2.0])
+        scaler.step(opt)  # must NOT unscale again
+        np.testing.assert_allclose(p.numpy(), [-1.0, -1.0])
+        # next iteration: state reset by update(), unscale_ is legal again
+        p.grad = paddle.to_tensor(np.array([4.0, 4.0], "float32"))._value
+        scaler.unscale_(opt)
+        np.testing.assert_allclose(np.asarray(p.grad), [1.0, 1.0])
+
+    def test_check_nan_inf_flag(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+            with pytest.raises(FloatingPointError, match="NaN/Inf"):
+                paddle.log(x * 0.0 - 1.0)  # log(-1) -> nan
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
 
 class TestSaveLoad:
     def test_state_dict_roundtrip(self, tmp_path):

@@ -208,6 +208,36 @@ class TestPipelineParallel:
         sizes = [hi - lo for lo, hi in pl.segments]
         assert sum(sizes) == 7 and max(sizes) - min(sizes) <= 1
 
+    def test_1f1b_inflight_bounded_by_stages(self):
+        # 1F1B property: saved activations per stage <= num_stages even with
+        # many more microbatches (GPipe would hold all 8).
+        engine, pl = self._make_pipeline(pp=2, dp=1)
+        engine.accumulate_steps = 8
+        opt = paddle.optimizer.SGD(parameters=pl.parameters(), learning_rate=0.1)
+        x = paddle.to_tensor(_r(16, 8))
+        y = paddle.to_tensor(np.random.randint(0, 2, (16,)))
+        engine.train_batch([x, y], opt)
+        assert engine.last_peak_inflight <= engine.num_stages, \
+            engine.last_peak_inflight
+
+    def test_1f1b_matches_single_micro_with_global_clip(self):
+        # Same data, same init: 4-microbatch 1F1B with ClipGradByGlobalNorm
+        # must produce the same updated params as a single-microbatch step
+        # (clip norm computed across ALL stages, grads averaged over micros).
+        x = _r(8, 8)
+        yv = np.random.randint(0, 2, (8,))
+        results = []
+        for n_micro in (1, 4):
+            engine, pl = self._make_pipeline(pp=2, dp=1)
+            engine.accumulate_steps = n_micro
+            opt = paddle.optimizer.SGD(
+                parameters=pl.parameters(), learning_rate=0.5,
+                grad_clip=nn.ClipGradByGlobalNorm(0.05))
+            engine.train_batch([paddle.to_tensor(x), paddle.to_tensor(yv)], opt)
+            results.append([np.asarray(p._value) for p in pl.parameters()])
+        for a, b in zip(*results):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
 
 class TestVocabParallelAndCE:
     def test_vocab_embedding_matches_dense(self):
