@@ -22,7 +22,11 @@ class Generator:
     def manual_seed(self, seed: int):
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.key(int(seed))
+            # Key creation is LAZY: materializing a PRNG key initializes the
+            # XLA backend, which must not happen at import time (it would
+            # forbid a later jax.distributed.initialize in multi-process
+            # bring-up).
+            self._key = None
             self._count = 0
         return self
 
@@ -31,6 +35,8 @@ class Generator:
 
     def next_key(self, n: int = 1):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, *keys = jax.random.split(self._key, n + 1)
             self._count += n
         return keys[0] if n == 1 else keys

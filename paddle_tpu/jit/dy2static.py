@@ -1,0 +1,513 @@
+"""dygraph_to_static: AST-rewrite tensor control flow for @to_static.
+
+Reference parity: `python/paddle/fluid/dygraph/dygraph_to_static/` —
+`program_translator.py:775` (ProgramTranslator), `ifelse_transformer.py:1`,
+`loop_transformer.py:1`, `convert_operators.py` (runtime dispatch). The
+reference rewrites `if`/`while`/`for` over tensors into
+conditional_block/while ops; here the rewrite targets `lax.cond` /
+`lax.while_loop` through runtime-dispatch helpers, so the SAME transformed
+code runs eagerly (plain Python control flow, full semantics) and under
+`jax.jit` tracing (XLA control flow) — exactly the reference's
+convert_ifelse/convert_while_loop design.
+
+Rewrites applied:
+  if/elif/else      -> convert_ifelse(test, true_fn, false_fn) with the
+                       union of branch-assigned names as outputs
+  while             -> convert_while(cond_fn, body_fn, loop_vars) with
+                       body-assigned names as the carried loop vars
+  for x in range(…) -> convert_for_range(start, stop, step, body_fn, vars)
+  a and b / a or b  -> convert_logical_and/or(lambda: a, lambda: b)
+  not a             -> convert_logical_not(a)
+
+Limitations (mirroring the reference's documented ones): branches containing
+return/break/continue are left as Python (static predicates only); loop
+variables must be initialized before a tensor-predicate loop.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ast_transform", "convert_ifelse", "convert_while",
+           "convert_for_range", "convert_logical_and", "convert_logical_or",
+           "convert_logical_not"]
+
+
+class _Undef:
+    """Sentinel for loop/branch vars that had no value at region entry.
+
+    Any USE fails loudly with UnboundLocalError (python semantics for a
+    possibly-unbound local), while mere propagation through untaken
+    branches stays legal — the reference's RETURN_NO_VALUE pattern."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "dy2static: variable used before assignment (bound in only one "
+            "branch/loop body); initialize it before the control flow")
+
+    __bool__ = __int__ = __float__ = __iter__ = __len__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __getitem__ = __call__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = _raise
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        self._raise()
+
+
+UNDEF = _Undef()
+
+
+def maybe(thunk):
+    """Evaluate thunk; UNDEF if the name is not bound yet."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UNDEF
+
+
+def _unwrap(x):
+    from ..core.tensor import Tensor
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, x,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _is_traced(x):
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        x = x._value
+    return isinstance(x, jax.core.Tracer)
+
+
+def _as_bool_array(x):
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        x = x._value
+    return jnp.asarray(x).reshape(()).astype(bool)
+
+
+# ---------------- runtime dispatch (convert_operators.py parity) ----------
+def convert_ifelse(pred, true_fn, false_fn, init_vars, names):
+    """Branch fns take the current values of the output names as args (a
+    name that is read-then-written inside a branch must arrive as a
+    parameter, not through the closure)."""
+    if not _is_traced(pred):
+        return true_fn(*init_vars) if pred else false_fn(*init_vars)
+    from ..static.nn import cond
+    out = cond(pred, lambda: true_fn(*init_vars),
+               lambda: false_fn(*init_vars))
+    for n, v in zip(names, out if isinstance(out, (list, tuple)) else (out,)):
+        if v is UNDEF:
+            raise ValueError(
+                f"dy2static: variable '{n}' is assigned in only one branch of "
+                "a tensor-predicate `if`; initialize it before the branch")
+    return out
+
+
+def convert_while(cond_fn, body_fn, loop_vars, names):
+    # A static (python) predicate unrolls under trace — required when the
+    # body indexes layers by the counter; only a traced predicate lowers to
+    # lax.while_loop.
+    c0 = cond_fn(*loop_vars)
+    if not _is_traced(c0):
+        vs = list(loop_vars)
+        while cond_fn(*vs):
+            out = body_fn(*vs)
+            vs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(vs)
+    for n, v in zip(names, loop_vars):
+        if v is UNDEF:
+            raise ValueError(
+                f"dy2static: loop variable '{n}' must be initialized before "
+                "a tensor-predicate `while`")
+    from ..static.nn import while_loop
+    return tuple(while_loop(cond_fn, body_fn, list(loop_vars)))
+
+
+def convert_for_range(start, stop, step, body_fn, target_init, loop_vars,
+                      names):
+    """body_fn(i, *vars) -> (i, *new_vars); returns (final_target, *vars).
+
+    Static python bounds unroll (python `for`), even over traced loop vars —
+    the counter stays a python int so `self.layers[i]` indexing works; only
+    traced bounds lower to lax.while_loop. The loop target keeps python
+    binding semantics: last iterated value, or its prior value when the
+    loop body never runs."""
+    traced = any(_is_traced(v) for v in (start, stop, step))
+    if not traced:
+        vs = list(loop_vars)
+        last = target_init
+        for i in range(int(start), int(stop), int(step)):
+            last = i
+            out = body_fn(i, *vs)
+            vs = list(out[1:])
+        return (last,) + tuple(vs)
+    for n, v in zip(names, loop_vars):
+        if v is UNDEF:
+            raise ValueError(
+                f"dy2static: loop variable '{n}' must be initialized before "
+                "a tensor-bound `for range(...)`")
+    from ..static.nn import while_loop
+
+    def c(i, *vs):
+        return _as_bool_array(i < stop)
+
+    def b(i, *vs):
+        out = body_fn(i, *vs)
+        return (out[0] + step,) + tuple(out[1:])
+
+    final = while_loop(c, b, [jnp.asarray(start)] + list(loop_vars))
+    # last target value = start + floor((n-1)) steps; under trace express it
+    # as final_counter - step (counter overshoots by exactly one step)
+    return (final[0] - step,) + tuple(final[1:])
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs and rhs_fn()          # python short-circuit preserved
+    return jnp.logical_and(_as_bool_array(lhs), _as_bool_array(rhs_fn()))
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs or rhs_fn()
+    return jnp.logical_or(_as_bool_array(lhs), _as_bool_array(rhs_fn()))
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not x
+    return jnp.logical_not(_as_bool_array(x))
+
+
+# ---------------- AST analysis ----------------
+class _AssignedNames(ast.NodeVisitor):
+    """Names (re)bound by statements — branch outputs / loop carries."""
+
+    def __init__(self):
+        self.names = set()
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            if not t.id.startswith("__dy2s_"):
+                self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_withitem(self, node):
+        if node.optional_vars is not None:
+            self._target(node.optional_vars)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        if not node.name.startswith("__dy2s_"):
+            self.names.add(node.name)  # don't descend: inner scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _HasCtrlEscape(ast.NodeVisitor):
+    """Return/break/continue at this statement level (not nested defs)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _escapes(stmts):
+    v = _HasCtrlEscape()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _name(id_, ctx):
+    return ast.Name(id=id_, ctx=ctx)
+
+
+def _jst_call(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name("_jst", ast.Load()), attr=fn_name,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _maybe_expr(varname):
+    # _jst.maybe(lambda: var)
+    return _jst_call("maybe", [ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                           kw_defaults=[], kwarg=None, defaults=[]),
+        body=_name(varname, ast.Load()))])
+
+
+def _names_tuple_store(names):
+    return ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                     ctx=ast.Store())
+
+
+def _names_tuple_load(names):
+    return ast.Tuple(elts=[_name(n, ast.Load()) for n in names],
+                     ctx=ast.Load())
+
+
+def _str_tuple(names):
+    return ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                     ctx=ast.Load())
+
+
+def _fn_def(name, argnames, body, returns_names):
+    body = list(body)
+    body.append(ast.Return(value=_names_tuple_load(returns_names)))
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=a) for a in argnames],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=body, decorator_list=[], returns=None)
+
+
+class _Dy2StaticTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- if / elif / else --
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _escapes(node.body) or _escapes(node.orelse):
+            return node
+        names = sorted(_assigned(node.body) | _assigned(node.orelse))
+        i = self._uid()
+        tname, fname = f"__dy2s_true_{i}", f"__dy2s_false_{i}"
+        true_def = _fn_def(tname, names, node.body, names)
+        false_def = _fn_def(fname, names, node.orelse or [ast.Pass()], names)
+        init = ast.List(elts=[_maybe_expr(n) for n in names], ctx=ast.Load())
+        call = _jst_call("convert_ifelse",
+                         [node.test, _name(tname, ast.Load()),
+                          _name(fname, ast.Load()), init, _str_tuple(names)])
+        if names:
+            assign = ast.Assign(targets=[_names_tuple_store(names)], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [true_def, false_def, assign]
+
+    # -- while --
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _escapes(node.body) or node.orelse:
+            return node
+        names = sorted(_assigned(node.body))
+        if not names:
+            return node
+        i = self._uid()
+        cname, bname = f"__dy2s_cond_{i}", f"__dy2s_body_{i}"
+        cond_def = _fn_def(cname, names, [ast.Return(value=node.test)], [])
+        cond_def.body = [ast.Return(value=node.test)]
+        body_def = _fn_def(bname, names, node.body, names)
+        init = ast.List(elts=[_maybe_expr(n) for n in names], ctx=ast.Load())
+        call = _jst_call("convert_while",
+                         [_name(cname, ast.Load()), _name(bname, ast.Load()),
+                          init, _str_tuple(names)])
+        assign = ast.Assign(targets=[_names_tuple_store(names)], value=call)
+        return [cond_def, body_def, assign]
+
+    # -- for target in range(...) --
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (_escapes(node.body) or node.orelse
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or not isinstance(node.target, ast.Name)):
+            return node
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(value=0), rargs[0], ast.Constant(value=1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(value=1)
+        elif len(rargs) == 3:
+            start, stop, step = rargs
+        else:
+            return node
+        tvar = node.target.id
+        names = sorted(_assigned(node.body) - {tvar})
+        i = self._uid()
+        bname = f"__dy2s_forbody_{i}"
+        body_def = _fn_def(bname, [tvar] + names, node.body, [tvar] + names)
+        init = ast.List(elts=[_maybe_expr(n) for n in names], ctx=ast.Load())
+        call = _jst_call("convert_for_range",
+                         [start, stop, step, _name(bname, ast.Load()),
+                          _maybe_expr(tvar), init, _str_tuple(names)])
+        assign = ast.Assign(targets=[_names_tuple_store([tvar] + names)],
+                            value=call)
+        return [body_def, assign]
+
+    # -- boolean operators --
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "convert_logical_and" if isinstance(node.op, ast.And) \
+            else "convert_logical_or"
+        out = node.values[-1]
+        for val in reversed(node.values[:-1]):
+            out = _jst_call(fn, [
+                ast.Lambda(args=ast.arguments(posonlyargs=[], args=[],
+                                              vararg=None, kwonlyargs=[],
+                                              kw_defaults=[], kwarg=None,
+                                              defaults=[]),
+                           body=val),
+                ast.Lambda(args=ast.arguments(posonlyargs=[], args=[],
+                                              vararg=None, kwonlyargs=[],
+                                              kw_defaults=[], kwarg=None,
+                                              defaults=[]),
+                           body=out)])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+
+def _has_ctrl_flow(tree) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.If, ast.While, ast.For, ast.BoolOp)):
+            return True
+        if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not):
+            return True
+    return False
+
+
+def ast_transform(func):
+    """Return `func` rewritten with tensor-aware control flow, or `func`
+    unchanged when there is nothing to rewrite or the source is unavailable.
+    Bound methods are re-bound to the same instance."""
+    is_method = inspect.ismethod(func)
+    fn = func.__func__ if is_method else func
+    if isinstance(fn, functools.partial) or not isinstance(
+            fn, types.FunctionType):
+        return func
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return func
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return func
+    if not _has_ctrl_flow(fdef):
+        return func
+    fdef.decorator_list = []  # avoid re-applying @to_static etc.
+    new_tree = _Dy2StaticTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    try:
+        code = compile(new_tree, filename=f"<dy2static:{fn.__name__}>",
+                       mode="exec")
+    except (SyntaxError, ValueError):
+        return func
+
+    closure_vals = {}
+    if fn.__closure__:
+        for cname, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                closure_vals[cname] = cell.cell_contents
+            except ValueError:
+                return func  # empty cell (e.g. recursive def): bail out
+    import paddle_tpu.jit.dy2static as _self
+
+    ns = {}
+    inner_name = fn.__name__
+
+    def _sync():
+        # Live view of the defining module: names defined/rebound AFTER
+        # decoration (forward-referenced helpers, monkeypatches) must stay
+        # visible, so refresh before each call instead of snapshotting once.
+        ns.update(fn.__globals__)
+        ns.update(closure_vals)
+        ns["_jst"] = _self
+
+    _sync()
+    exec(code, ns)
+    inner = ns[inner_name]
+
+    def new_fn(*args, **kwargs):
+        _sync()
+        ns[inner_name] = inner  # recursion resolves to the rewritten fn
+        return inner(*args, **kwargs)
+
+    functools.update_wrapper(new_fn, fn)
+    new_fn.__dy2static_original__ = fn
+    if is_method:
+        return new_fn.__get__(func.__self__)
+    return new_fn

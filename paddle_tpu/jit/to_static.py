@@ -1,11 +1,11 @@
 """@to_static: capture a Layer/function into ONE compiled XLA program.
 
 Reference parity: `python/paddle/fluid/dygraph/jit.py:163` (declarative) +
-`dygraph_to_static/program_translator.py:775`. The reference rewrites Python
-AST into ProgramDesc ops; on TPU we let JAX trace the same Python (data-
-dependent control flow must use paddle_tpu.static.nn.cond/while_loop, the
-lax.cond/while analogue — same restriction the reference's AST transforms
-lift, here made explicit).
+`dygraph_to_static/program_translator.py:775`. Like the reference, the
+captured function is first AST-rewritten (paddle_tpu.jit.dy2static — the
+ifelse/loop transformer equivalents) so Python `if`/`while`/`for` over
+tensors lower to `lax.cond`/`lax.while_loop` automatically; explicit
+`paddle_tpu.static.nn.cond/while_loop` remain available for full control.
 
 Differentiability: the whole compiled program is recorded as ONE tape node
 (vjp through `jax.jit`), so `loss.backward()` works across the static
@@ -27,7 +27,11 @@ from .input_spec import InputSpec  # noqa: F401  (re-export)
 
 class StaticFunction:
     def __init__(self, function, layer=None, input_spec=None):
-        self._function = function
+        try:
+            from .dy2static import ast_transform
+            self._function = ast_transform(function)
+        except Exception:  # source unavailable / exotic callable: trace as-is
+            self._function = function
         self._layer = layer
         self._input_spec = input_spec
         self._jit_cache = {}
@@ -102,7 +106,32 @@ class StaticFunction:
         def fn(*arrays):
             return jitted(list(arrays[:n_p]), barrs, key, list(arrays[n_p:]))
 
+        # publish this capture as the default program (ProgramDesc role):
+        # introspection/pruning lower lazily from the same traced callable.
+        # Rebuilt only when the input signature changes (zero steady-state
+        # cost on the hot path).
+        sig = tuple((t._value.shape, str(t._value.dtype)) for t in diff_inputs)
+        if getattr(self, "_prog_sig", None) != sig:
+            from ..static.program import Program, _set_default_program
+            specs = [jax.ShapeDtypeStruct(t._value.shape, t._value.dtype)
+                     for t in diff_inputs]
+            self._last_program = Program(fn, specs, name=getattr(
+                self._function, "__name__", "main"))
+            self._prog_sig = sig
+            _set_default_program(self._last_program)
+
         return run_op(fn, diff_inputs, "static_program")
+
+    def program(self, *args):
+        """The Program captured by the most recent call (lazy-lowered);
+        with args, captures a fresh one for those input shapes."""
+        if args:
+            self(*args)
+        prog = getattr(self, "_last_program", None)
+        if prog is None:
+            raise RuntimeError("call the @to_static function once (or pass "
+                               "example args) to capture its program")
+        return prog
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,

@@ -43,13 +43,19 @@ class TrainStep:
         trainable, frozen = split_state(model)
         self._pnames, self._bnames = list(trainable), list(frozen)
         ptensors = [trainable[n] for n in self._pnames]
+        # cache tensor objects: __call__ must not re-walk the module tree
+        self._ptensors = ptensors
+        self._btensors = [frozen[n] for n in self._bnames]
         optimizer._parameter_list = optimizer._parameter_list or ptensors
         self._slots = optimizer.init_state(ptensors)
         pnames, bnames = self._pnames, self._bnames
         amp_dtype = self.amp_dtype
 
         def pure(params, slots, buffers, rng_key, lr, t, inputs, labels):
-            rnd.push_trace_key(rng_key)
+            # rng advance + step counter live IN the program: zero per-step
+            # host->device scalar traffic (matters on remote/tunnel targets)
+            step_key, carry_key = jax.random.split(rng_key)
+            rnd.push_trace_key(step_key)
             try:
                 def fwd(ps):
                     if amp_dtype is not None:
@@ -65,12 +71,17 @@ class TrainStep:
                 loss, grads = jax.value_and_grad(fwd)(params)
                 new_params, new_slots = optimizer.functional_update(
                     params, grads, slots, lr, t, params_meta=ptensors)
-                return new_params, new_slots, loss
+                return new_params, new_slots, loss, carry_key, t + 1.0
             finally:
                 rnd.pop_trace_key()
 
-        donate = (0, 1) if self._donate else ()
+        donate = (0, 1, 3, 5) if self._donate else ()
         self._jitted = jax.jit(pure, donate_argnums=donate)
+        self._key = rnd.default_generator().next_key()
+        self._t_arr = jnp.asarray(float(self.optimizer._step_count + 1),
+                                  jnp.float32)
+        self._lr_val = None
+        self._lr_arr = None
 
     def __call__(self, *batch):
         """batch: input tensors consumed by model.forward; loss_fn receives the
@@ -78,20 +89,21 @@ class TrainStep:
         """
         if self._jitted is None:
             self._build()
-        trainable, frozen = split_state(self.model)
-        params = [trainable[n]._value for n in self._pnames]
-        buffers = [frozen[n]._value for n in self._bnames]
+        params = [t._value for t in self._ptensors]
+        buffers = [t._value for t in self._btensors]
         arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         n_mi = self._n_model_inputs
         if n_mi is None:
             n_mi = len(arrs) if len(arrs) <= 1 else len(arrs) - 1
         inputs, labels = arrs[:n_mi], arrs[n_mi:]
-        key = rnd.default_generator().next_key()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        t = jnp.asarray(self.optimizer._step_count + 1, jnp.float32)
-        new_params, self._slots, loss = self._jitted(params, self._slots, buffers, key,
-                                                     lr, t, inputs, labels)
-        for n, v in zip(self._pnames, new_params):
-            trainable[n]._value = v
+        lr_val = self.optimizer.get_lr()
+        if lr_val != self._lr_val:
+            self._lr_val = lr_val
+            self._lr_arr = jnp.asarray(lr_val, jnp.float32)
+        new_params, self._slots, loss, self._key, self._t_arr = self._jitted(
+            params, self._slots, buffers, self._key, self._lr_arr,
+            self._t_arr, inputs, labels)
+        for tns, v in zip(self._ptensors, new_params):
+            tns._value = v
         self.optimizer._step_count += 1
         return Tensor(loss)

@@ -28,8 +28,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# measured on v5e (fwd+bwd, causal, bh12 d64): 512-blocks beat 256 by ~26%
+# at seq 8192 (34.9 vs 27.7 steps/s; fused-XLA reference 14.9)
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
@@ -70,7 +72,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
     m, l, acc = jax.lax.fori_loop(0, kmax, body, (m0, l0, a0))
     lsafe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / lsafe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(lsafe))[:, 0]
+    # lse carried as [BH, 1, S] so the (sublane, lane) dims of every block
+    # are (1, block_q) with sublane == full array dim (Mosaic tiling rule)
+    lse_ref[0, 0] = (m + jnp.log(lsafe))[:, 0]
 
 
 def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
@@ -85,7 +89,7 @@ def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
     return pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, s), jnp.float32)),
+                   jax.ShapeDtypeStruct((bh, 1, s), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -93,7 +97,7 @@ def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
         ],
         out_specs=(pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, block_q), lambda b, i: (b, i))),
+                   pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))),
         interpret=interpret,
     )(q, k, v)
 
@@ -103,8 +107,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)             # [Bq, D]
     do = do_ref[0].astype(jnp.float32)           # [Bq, D]
-    lse = lse_ref[0][:, None]                    # [Bq, 1]
-    delta = delta_ref[0][:, None]                # [Bq, 1]
+    lse = lse_ref[0, 0][:, None]                 # [Bq, 1]
+    delta = delta_ref[0, 0][:, None]             # [Bq, 1]
     block_q = q.shape[0]
     n_kb = seq_len // block_k
     if causal:
@@ -147,8 +151,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -179,10 +183,11 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     scale = 1.0 / math.sqrt(d)
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # lse arrives as [BH, 1, S]; delta built to match
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]
 
     full = lambda b, i: (b, 0, 0)  # noqa: E731
-    full1 = lambda b, i: (b, 0)    # noqa: E731
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
@@ -194,8 +199,8 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
             pl.BlockSpec((1, s, d), full),
             pl.BlockSpec((1, s, d), full),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         interpret=interpret,
@@ -212,8 +217,8 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, s, d), full),
-            pl.BlockSpec((1, s), full1),
-            pl.BlockSpec((1, s), full1),
+            pl.BlockSpec((1, 1, s), full),
+            pl.BlockSpec((1, 1, s), full),
         ],
         out_specs=(pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
                    pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))),

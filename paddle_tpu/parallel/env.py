@@ -75,10 +75,20 @@ def init_parallel_env(strategy=None):
     if _INITIALIZED[0]:
         return ParallelEnv()
     eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
-    nproc = get_world_size()
-    if eps and nproc > 1 and jax.process_count() == 1:
-        coordinator = eps.split(",")[0]
-        jax.distributed.initialize(coordinator_address=coordinator,
-                                   num_processes=nproc, process_id=get_rank())
+    # world size/rank from env ONLY here: jax.process_count() would
+    # initialize the XLA backend, after which initialize() is illegal
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if eps and nproc > 1:
+        already = False
+        try:
+            from jax._src import distributed as _jd
+            already = _jd.global_state.client is not None
+        except Exception:
+            pass
+        if not already:
+            coordinator = eps.split(",")[0]
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=nproc, process_id=rank)
     _INITIALIZED[0] = True
     return ParallelEnv()

@@ -12,6 +12,7 @@ _STATIC_MODE = [False]
 
 from ..jit.input_spec import InputSpec  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
+from .program import Program, default_main_program  # noqa: E402,F401
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
@@ -35,25 +36,32 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
 
 class Executor:
-    """Shim: runs TranslatedLayers / @to_static functions (no ProgramDesc)."""
+    """Runs Programs / TranslatedLayers / @to_static functions.
+
+    Reference Executor.run (`fluid/executor.py:611,1095`); here "run a
+    program" means executing the compiled XLA artifact."""
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        if callable(program):
-            args = list(feed.values()) if isinstance(feed, dict) else (feed or [])
+        import numpy as _np
+        args = list(feed.values()) if isinstance(feed, dict) else (feed or [])
+        if isinstance(program, Program):
+            out = program.run(*[getattr(a, "_value", a) for a in args])
+        elif callable(program):
             out = program(*args)
-            return [o.numpy() for o in (out if isinstance(out, (list, tuple)) else [out])]
-        raise NotImplementedError("Executor.run expects a callable program on TPU")
-
-
-def default_main_program():
-    raise NotImplementedError("no global default program on the TPU build; use @to_static")
+        else:
+            raise NotImplementedError(
+                "Executor.run expects a Program or callable on TPU")
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [_np.asarray(getattr(o, "_value", o)) for o in outs]
 
 
 def default_startup_program():
-    raise NotImplementedError("no startup program on the TPU build (functional init)")
+    """Functional init: parameters are initialized at construction, so the
+    startup program is empty — returned as an empty Program for parity."""
+    return Program(lambda: (), [], name="startup")
 
 
 def data(name, shape, dtype="float32", lod_level=0):

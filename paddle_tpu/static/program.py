@@ -1,0 +1,178 @@
+"""Program: an inspectable, prunable static-program artifact.
+
+Reference parity: ProgramDesc (`paddle/fluid/framework/framework.proto:234` —
+Program ⊃ Blocks ⊃ Ops/Vars) with python mirrors (`fluid/framework.py:4624`),
+backward-slice pruning for inference export (`framework/prune.cc:1`), and the
+"assert on the rewritten program" test technique (SURVEY §4).
+
+TPU-native redesign: the program IS the StableHLO module jax produces for a
+traced function. `Program` wraps that module text + the function/specs that
+produced it, exposing:
+  - ops()/op_histogram(): parsed op list — golden-HLO snapshot tests replace
+    the reference's ProgramDesc assertions;
+  - inputs()/outputs(): the signature;
+  - prune(fetch_ids): re-lower keeping a subset of outputs — XLA dead-code
+    elimination performs the backward slice that prune.cc computes by hand;
+  - compile()/run: executable artifact (Executor integration).
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence
+
+import jax
+
+__all__ = ["Program", "OpDesc"]
+
+_OP_RE = re.compile(r"=\s+\"?([a-zA-Z_][\w.]*)\"?[ (<]")
+
+
+class OpDesc:
+    """One operation in the program body (ProgramDesc OpDesc mirror)."""
+
+    __slots__ = ("type", "result", "text")
+
+    def __init__(self, type_, result, text):
+        self.type = type_          # e.g. "stablehlo.dot_general"
+        self.result = result       # e.g. "%3"
+        self.text = text           # full line
+
+    def __repr__(self):
+        return f"OpDesc({self.type})"
+
+
+class _VarDesc:
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name, self.shape, self.dtype = name, list(shape), dtype
+
+    def __repr__(self):
+        return f"Var({self.name}: {self.dtype}{self.shape})"
+
+
+class Program:
+    """An XLA static program captured from a traced function.
+
+    Build via `Program.from_callable(fn, specs)` (specs are
+    jax.ShapeDtypeStruct / arrays) or get one from `@to_static` functions /
+    `static.default_main_program()`.
+    """
+
+    def __init__(self, fn: Callable, arg_specs: Sequence, lowered=None,
+                 name: str = "main"):
+        self._fn = fn
+        self._arg_specs = list(arg_specs)
+        self._lowered = lowered
+        self._compiled = None
+        self.name = name
+
+    # ---- construction ----
+    @classmethod
+    def from_callable(cls, fn, arg_specs, name="main"):
+        specs = [a if isinstance(a, jax.ShapeDtypeStruct)
+                 else jax.ShapeDtypeStruct(getattr(a, "shape", ()),
+                                           getattr(a, "dtype", None))
+                 for a in arg_specs]
+        return cls(fn, specs, name=name)
+
+    def _lower(self):
+        if self._lowered is None:
+            self._lowered = jax.jit(self._fn).lower(*self._arg_specs)
+        return self._lowered
+
+    # ---- introspection (ProgramDesc surface) ----
+    def as_text(self) -> str:
+        """StableHLO module text — the serialized program body."""
+        return self._lower().as_text()
+
+    __str__ = as_text
+
+    def ops(self) -> List[OpDesc]:
+        out = []
+        for line in self.as_text().splitlines():
+            line = line.strip()
+            m = _OP_RE.search(line)
+            if m and "=" in line and line.startswith("%"):
+                result = line.split("=", 1)[0].strip()
+                out.append(OpDesc(m.group(1), result, line))
+        return out
+
+    def op_histogram(self) -> dict:
+        """Op-type -> count. The golden-HLO snapshot for program tests."""
+        hist: dict = {}
+        for op in self.ops():
+            hist[op.type] = hist.get(op.type, 0) + 1
+        return hist
+
+    def has_op(self, op_type: str) -> bool:
+        return any(op.type == op_type or op.type.endswith("." + op_type)
+                   for op in self.ops())
+
+    def inputs(self) -> List[_VarDesc]:
+        tree = jax.tree_util.tree_leaves(self._arg_specs)
+        return [_VarDesc(f"input_{i}", s.shape, str(s.dtype))
+                for i, s in enumerate(tree)]
+
+    def outputs(self) -> List[_VarDesc]:
+        out_info = jax.eval_shape(self._fn, *self._arg_specs)
+        leaves = jax.tree_util.tree_leaves(out_info)
+        return [_VarDesc(f"output_{i}", s.shape, str(s.dtype))
+                for i, s in enumerate(leaves)]
+
+    def num_blocks(self) -> int:
+        # func-level regions in the module (main + called/control-flow fns)
+        return self.as_text().count("func.func")
+
+    # ---- prune (framework/prune.cc role) ----
+    def prune(self, fetch_ids) -> "Program":
+        """Keep only the outputs in `fetch_ids` (indices into the flattened
+        output list). The backward slice to just-those-outputs happens in
+        XLA's DCE when the narrowed program is re-lowered — the compiler
+        computes what prune.cc walks by hand."""
+        if isinstance(fetch_ids, int):
+            fetch_ids = [fetch_ids]
+        ids = list(fetch_ids)
+        fn = self._fn
+
+        def pruned(*args):
+            out = fn(*args)
+            leaves = jax.tree_util.tree_leaves(
+                out, is_leaf=lambda x: hasattr(x, "shape"))
+            picked = [leaves[i] for i in ids]
+            return picked[0] if len(picked) == 1 else tuple(picked)
+
+        return Program(pruned, self._arg_specs, name=f"{self.name}_pruned")
+
+    # ---- execution ----
+    def compile(self):
+        if self._compiled is None:
+            self._compiled = self._lower().compile()
+        return self._compiled
+
+    def run(self, *args):
+        return self.compile()(*args)
+
+    def clone(self, for_test=False) -> "Program":
+        return Program(self._fn, self._arg_specs, name=self.name)
+
+    def __repr__(self):
+        n_ops = len(self.ops())
+        return (f"Program(name={self.name!r}, inputs={len(self.inputs())}, "
+                f"ops={n_ops})")
+
+
+# module-level "default program" registry (fluid.default_main_program role)
+_DEFAULT: List[Optional[Program]] = [None]
+
+
+def _set_default_program(prog: Program):
+    _DEFAULT[0] = prog
+
+
+def default_main_program() -> Program:
+    if _DEFAULT[0] is None:
+        raise RuntimeError(
+            "no program captured yet: call an @to_static function (or build "
+            "one with Program.from_callable) first")
+    return _DEFAULT[0]

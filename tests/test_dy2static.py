@@ -1,0 +1,223 @@
+"""dy2static AST transform tests: reference-style @to_static code with plain
+Python control flow over tensors must compile and run (program_translator/
+ifelse_transformer/loop_transformer parity)."""
+import numpy as np
+import pytest
+import textwrap
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import ast_transform
+
+
+def _r(*shape):
+    return np.random.rand(*shape).astype("float32")
+
+
+class TestIfElse:
+    def test_tensor_if_under_to_static(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        xp = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        np.testing.assert_allclose(f(xp).numpy(), [2.0, 4.0])
+        xn = paddle.to_tensor(np.array([-1.0, -2.0], "float32"))
+        np.testing.assert_allclose(f(xn).numpy(), [-2.0, -3.0])
+
+    def test_elif_chain(self):
+        @to_static
+        def f(x):
+            s = x.sum()
+            if s > 10:
+                out = x * 0
+            elif s > 0:
+                out = x * 2
+            else:
+                out = x * -1
+            return out
+
+        x = paddle.to_tensor(np.array([1.0, 1.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [2.0, 2.0])
+        x = paddle.to_tensor(np.array([-3.0, 1.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [3.0, -1.0])
+
+    def test_python_pred_keeps_python_semantics(self):
+        calls = []
+
+        def g(x, flag):
+            if flag:
+                calls.append("t")
+                return x + 1
+            calls.append("f")
+            return x - 1
+
+        h = ast_transform(g)
+        x = paddle.to_tensor(_r(2))
+        np.testing.assert_allclose(h(x, True).numpy(), x.numpy() + 1, rtol=1e-6)
+        assert calls == ["t"]  # short-circuit: false branch never ran
+
+    def test_bool_ops_on_tensors(self):
+        @to_static
+        def f(x):
+            if (x.sum() > 0) and (x.max() < 10):
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [2.0, 3.0])
+
+
+class TestLoops:
+    def test_tensor_while(self):
+        @to_static
+        def f(x):
+            i = paddle.to_tensor(np.asarray(0, "int32"))
+            s = x * 0
+            while i < 5:
+                s = s + x
+                i = i + 1
+            return s
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [5.0, 10.0])
+
+    def test_for_range_static_bound(self):
+        @to_static
+        def f(x):
+            acc = x * 0
+            for i in range(3):
+                acc = acc + x * (i + 1)
+            return acc
+
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [6.0])
+
+    def test_for_range_tensor_bound(self):
+        def g(x, n):
+            acc = x * 0
+            for i in range(n):
+                acc = acc + x
+            return acc
+
+        h = ast_transform(g)
+        x = paddle.to_tensor(np.array([2.0], "float32"))
+        n = paddle.to_tensor(np.asarray(4, "int32"))
+        # eager: tensor bound, convert_for_range runs lax path only under jit;
+        # eager concrete tensors take python path via int()
+        import jax.numpy as jnp
+        out = h(x, 4)
+        np.testing.assert_allclose(out.numpy(), [8.0])
+
+    def test_uninitialized_loop_var_raises_under_trace(self):
+        @to_static
+        def f(x):
+            i = paddle.to_tensor(np.asarray(0, "int32"))
+            while i < 3:
+                tmp = x * 2  # never initialized before the loop
+                i = i + 1
+            return x
+
+        with pytest.raises(Exception, match="initialized|tmp"):
+            f(paddle.to_tensor(_r(2)))
+
+
+class TestSemantics:
+    def test_forward_referenced_helper_visible(self):
+        # helper defined AFTER the transform must resolve (live globals)
+        ns = {}
+        exec(textwrap.dedent("""
+            def f(x, flag):
+                if flag:
+                    y = helper(x)
+                else:
+                    y = x
+                return y
+        """), ns)
+        h = ast_transform(ns["f"])
+        ns["helper"] = lambda v: v + 10  # defined after transform
+        assert h(5, True) == 15
+        assert h(5, False) == 5
+
+    def test_for_target_bound_after_loop(self):
+        def g(x):
+            for i in range(3):
+                x = x + i
+            return x * i  # python leaves i == 2 bound
+
+        h = ast_transform(g)
+        assert h(5) == g.__wrapped__(5) if hasattr(g, "__wrapped__") else True
+        assert h(5) == 16
+
+    def test_undef_fails_loudly_on_use(self):
+        def f(x, flag):
+            if flag:
+                y = x + 1
+            return y
+
+        h = ast_transform(f)
+        assert h(1, True) == 2
+        with pytest.raises(UnboundLocalError):
+            _ = h(1, False) + 1  # y unbound: first USE must raise
+
+
+class TestEndToEnd:
+    def test_reference_shaped_model(self):
+        """Loop over layers + data-dependent branch, trained end-to-end —
+        the reference dy2static acceptance shape (program_translator.py)."""
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fcs = nn.LayerList([nn.Linear(8, 8) for _ in range(3)])
+                self.head = nn.Linear(8, 2)
+
+            def forward(self, x):
+                for i in range(3):
+                    x = paddle.tanh(self.fcs[i](x))
+                if x.mean() > 0:
+                    x = x * 2
+                else:
+                    x = x * 0.5
+                return self.head(x)
+
+        paddle.seed(0)
+        net = to_static(Net())
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=1e-2)
+        ce = nn.CrossEntropyLoss()
+        x = paddle.to_tensor(_r(16, 8))
+        y = paddle.to_tensor(np.random.randint(0, 2, (16,)))
+        losses = []
+        for _ in range(8):
+            loss = ce(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_grad_flows_through_cond(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 3
+            else:
+                y = x * 5
+            return y.sum()
+
+        x = paddle.to_tensor(np.array([1.0, 1.0], "float32"),
+                             stop_gradient=False)
+        f(x).backward()
+        np.testing.assert_allclose(x.gradient(), [3.0, 3.0])
+        xn = paddle.to_tensor(np.array([-1.0, -1.0], "float32"),
+                              stop_gradient=False)
+        f(xn).backward()
+        np.testing.assert_allclose(xn.gradient(), [5.0, 5.0])
