@@ -23,6 +23,8 @@ from .core.flags import set_flags, get_flags  # noqa: F401
 from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
 from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
 from .core.autograd import grad_fn as _grad_fn
+from .core import enforce  # noqa: F401  (typed errors: paddle.enforce.errors)
+from .core.enforce import errors  # noqa: F401
 
 from . import ops  # noqa: F401  (binds Tensor methods)
 from .ops import *  # noqa: F401,F403
@@ -57,7 +59,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
          only_inputs=True, allow_unused=False, no_grad_vars=None):
     """paddle.grad parity (python/paddle/fluid/dygraph/base.py grad)."""
     gs = _grad_fn(outputs, inputs, grad_outputs, retain_graph, create_graph, allow_unused)
-    return [None if g is None else Tensor(g) for g in gs]
+    # create_graph returns tape-linked Tensors; rewrapping would drop the node
+    return [None if g is None else (g if isinstance(g, Tensor) else Tensor(g))
+            for g in gs]
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

@@ -45,13 +45,15 @@ class Node:
     so backward can process in reverse-creation order without a tape list.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "seq", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "seq", "fn",
+                 "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, outputs, name):
+    def __init__(self, vjp_fn, inputs, outputs, name, fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs      # list[Tensor] (diff inputs, positional)
         self.outputs = outputs    # list[Tensor] (diff outputs, positional)
         self.name = name
+        self.fn = fn              # primal fn — kept for double grad (remat)
         _STATE.seq += 1
         self.seq = _STATE.seq
 
@@ -116,8 +118,8 @@ def apply_op(
     return outs, vjp_fn
 
 
-def record_node(vjp_fn, diff_inputs, out_tensors, name):
-    node = Node(vjp_fn, list(diff_inputs), list(out_tensors), name)
+def record_node(vjp_fn, diff_inputs, out_tensors, name, fn=None):
+    node = Node(vjp_fn, list(diff_inputs), list(out_tensors), name, fn=fn)
     for t in out_tensors:
         t._node = node
         t.stop_gradient = False
@@ -205,13 +207,17 @@ def backward(root, grad=None, retain_graph: bool = False):
 
 def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
             allow_unused=False):
-    """paddle.grad parity (partial_grad_engine.cc): grads of outputs w.r.t. inputs
-    without touching .grad. Single-level (create_graph unsupported round 1)."""
-    if create_graph:
-        raise NotImplementedError("double grad: use paddle_tpu.autograd.functional (jax-based)")
+    """paddle.grad parity (partial_grad_engine.cc): grads of outputs w.r.t.
+    inputs without touching .grad. With create_graph=True the backward pass
+    itself is RECORDED on the tape (each node's VJP replayed through its
+    saved primal fn via jax.vjp — rematerialized), so the returned grads are
+    differentiable again (double/higher-order grad)."""
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     ordered = _collect([o._node for o in outs])
+    if create_graph:
+        return _grad_create_graph(outs, ins, grad_outputs, allow_unused,
+                                  ordered)
 
     cot: dict = {}
     for i, o in enumerate(outs):
@@ -244,6 +250,64 @@ def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph
         if c is None and not allow_unused:
             raise RuntimeError(f"input {i} unused in graph (allow_unused=False)")
         results[i] = c
+    return results
+
+
+def _grad_create_graph(outs, ins, grad_outputs, allow_unused, ordered):
+    """Differentiable backward: cotangents are Tensors, every VJP step is a
+    recorded op (remat through node.fn)."""
+    from .tensor import Tensor
+    from ..ops._dispatch import run_op
+
+    cot: dict = {}  # id(tensor) -> Tensor cotangent
+
+    def _acc(t, c):
+        prev = cot.get(id(t))
+        cot[id(t)] = c if prev is None else prev + c
+
+    for i, o in enumerate(outs):
+        if grad_outputs is not None and grad_outputs[i] is not None:
+            g = grad_outputs[i]
+            g = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        else:
+            g = Tensor(jnp.ones_like(o._value))
+        _acc(o, g)
+
+    for node in ordered:
+        out_cots, any_live = [], False
+        for t in node.outputs:
+            c = cot.get(id(t))
+            if c is None:
+                c = Tensor(jnp.zeros_like(t._value))
+            else:
+                any_live = True
+            out_cots.append(c)
+        if not any_live:
+            continue
+        if node.fn is None:
+            raise NotImplementedError(
+                f"double grad through '{node.name}': no primal fn recorded "
+                "(PyLayer/custom node) — wrap it in a differentiable op")
+        n_in, n_out, fn = len(node.inputs), len(node.outputs), node.fn
+
+        def vjp_replay(*arrs, _fn=fn, _n=n_in, _nout=n_out):
+            primals, cots = arrs[:_n], arrs[_n:]
+            _, vjp = jax.vjp(_fn, *primals)
+            res = vjp(tuple(cots) if _nout > 1 else cots[0])
+            return tuple(res) if len(res) > 1 else res[0]
+
+        in_cots = run_op(vjp_replay, list(node.inputs) + out_cots,
+                         node.name + "_grad")
+        in_cots = in_cots if isinstance(in_cots, tuple) else (in_cots,)
+        for t, c in zip(node.inputs, in_cots):
+            _acc(t, c)
+
+    results = []
+    for i, t in enumerate(ins):
+        c = cot.get(id(t))
+        if c is None and not allow_unused:
+            raise RuntimeError(f"input {i} unused in graph (allow_unused=False)")
+        results.append(c)
     return results
 
 

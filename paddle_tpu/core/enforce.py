@@ -1,0 +1,130 @@
+"""Typed error system + enforce helpers.
+
+Reference parity: `paddle/fluid/platform/enforce.h:1` (PADDLE_ENFORCE_*
+macros) and `platform::errors::*` typed errors, surfaced to python as the
+matching builtin exception types (the reference's pybind error translation
+maps InvalidArgument->ValueError, NotFound->..., etc.), so user code that
+catches builtins keeps working while `type(e).__name__` carries the typed
+classification and the message carries the [Hint] block.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def _fmt(summary: str, hint: Optional[str]) -> str:
+    msg = summary
+    if hint:
+        msg += f"\n  [Hint] {hint}"
+    return msg
+
+
+class InvalidArgumentError(ValueError):
+    pass
+
+
+class NotFoundError(FileNotFoundError):
+    pass
+
+
+class OutOfRangeError(IndexError):
+    pass
+
+
+class AlreadyExistsError(ValueError):
+    pass
+
+
+class ResourceExhaustedError(MemoryError):
+    pass
+
+
+class PreconditionNotMetError(RuntimeError):
+    pass
+
+
+class PermissionDeniedError(PermissionError):
+    pass
+
+
+class ExecutionTimeoutError(TimeoutError):
+    pass
+
+
+class UnimplementedError(NotImplementedError):
+    pass
+
+
+class UnavailableError(RuntimeError):
+    pass
+
+
+class FatalError(RuntimeError):
+    pass
+
+
+class ExternalError(RuntimeError):
+    pass
+
+
+# errors namespace (platform::errors::InvalidArgument(...) style factories)
+class errors:
+    InvalidArgument = InvalidArgumentError
+    NotFound = NotFoundError
+    OutOfRange = OutOfRangeError
+    AlreadyExists = AlreadyExistsError
+    ResourceExhausted = ResourceExhaustedError
+    PreconditionNotMet = PreconditionNotMetError
+    PermissionDenied = PermissionDeniedError
+    ExecutionTimeout = ExecutionTimeoutError
+    Unimplemented = UnimplementedError
+    Unavailable = UnavailableError
+    Fatal = FatalError
+    External = ExternalError
+
+
+def enforce(cond: Any, err: Exception | str, hint: str = ""):
+    """PADDLE_ENFORCE: raise when cond is falsy."""
+    if not cond:
+        if isinstance(err, str):
+            err = PreconditionNotMetError(_fmt(err, hint))
+        raise err
+    return cond
+
+
+def enforce_not_none(val, what: str = "value", hint: str = ""):
+    if val is None:
+        raise NotFoundError(_fmt(f"{what} should not be None.", hint))
+    return val
+
+
+def _cmp(a, b, op, opname, hint):
+    ok = op(a, b)
+    if not ok:
+        raise InvalidArgumentError(_fmt(
+            f"Expected {a!r} {opname} {b!r}, but received "
+            f"{a!r}:{type(a).__name__} vs {b!r}:{type(b).__name__}.", hint))
+
+
+def enforce_eq(a, b, hint: str = ""):
+    _cmp(a, b, lambda x, y: x == y, "==", hint)
+
+
+def enforce_ne(a, b, hint: str = ""):
+    _cmp(a, b, lambda x, y: x != y, "!=", hint)
+
+
+def enforce_gt(a, b, hint: str = ""):
+    _cmp(a, b, lambda x, y: x > y, ">", hint)
+
+
+def enforce_ge(a, b, hint: str = ""):
+    _cmp(a, b, lambda x, y: x >= y, ">=", hint)
+
+
+def enforce_lt(a, b, hint: str = ""):
+    _cmp(a, b, lambda x, y: x < y, "<", hint)
+
+
+def enforce_le(a, b, hint: str = ""):
+    _cmp(a, b, lambda x, y: x <= y, "<=", hint)

@@ -91,10 +91,16 @@ class Predictor:
         self._output_names = []
         if isinstance(config_or_layer, Config):
             cfg = config_or_layer
+            import os
+            from ..core.enforce import NotFoundError
             from ..jit.save_load import load as jload
             path = cfg.model_path
             if path.endswith(".pdmodel"):
                 path = path[:-len(".pdmodel")]
+            if not os.path.exists(path + ".pdmodel"):
+                raise NotFoundError(
+                    f"Cannot open model file {path}.pdmodel\n"
+                    "  [Hint] save the model with paddle_tpu.jit.save first.")
             self._translated = jload(path)
             n_in = len(self._translated._meta["input_specs"])
             self._input_names = [f"input_{i}" for i in range(n_in)]

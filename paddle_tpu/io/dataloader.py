@@ -144,7 +144,27 @@ class _IterableIter:
         return self
 
 
+def _wrap_numpy(obj):
+    """Parent-side: numpy batch structure -> Tensors (the single H2D hop)."""
+    if isinstance(obj, np.ndarray):
+        return Tensor(jnp.asarray(obj))
+    if isinstance(obj, tuple):
+        return tuple(_wrap_numpy(o) for o in obj)
+    if isinstance(obj, list):
+        return [_wrap_numpy(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _wrap_numpy(v) for k, v in obj.items()}
+    return obj
+
+
 class DataLoader:
+    """`num_workers>0` uses real worker PROCESSES with shared-memory numpy
+    transport (`paddle_tpu.io.worker`, dataloader_iter.py parity); pass
+    `use_shared_memory=False` to ship batches by pickling, or
+    `use_buffer_reader=False` to force the in-process thread prefetcher.
+    A custom `collate_fn` runs in the worker and must return numpy (never
+    device arrays); the parent performs the H2D transfer."""
+
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
@@ -154,8 +174,13 @@ class DataLoader:
         self.batch_size = batch_size
         self.drop_last = drop_last
         self.collate_fn = collate_fn or default_collate_fn
+        self.worker_collate_fn = collate_fn  # None -> worker numpy collate
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
         if not self._iterable:
             self.batch_sampler = batch_sampler or BatchSampler(
@@ -163,10 +188,16 @@ class DataLoader:
         else:
             self.batch_sampler = None
 
+    def _post_collate(self, np_batch):
+        return _wrap_numpy(np_batch)
+
     def __iter__(self):
         if self._iterable:
             return _IterableIter(self)
         if self.num_workers > 0:
+            if self.use_buffer_reader:
+                from .worker import MultiprocessIter
+                return MultiprocessIter(self)
             return _PrefetchIter(self)
         return _SimpleIter(self)
 

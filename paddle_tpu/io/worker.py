@@ -1,0 +1,240 @@
+"""Multiprocess DataLoader workers with shared-memory numpy transport.
+
+Reference parity: `python/paddle/fluid/dataloader/dataloader_iter.py:1` +
+`worker.py:1` (worker processes, shared-memory tensor transport,
+out-of-order results re-sequenced) and `operators/reader/buffered_reader.cc`
+(double buffering).
+
+TPU-first constraints: workers NEVER touch jax — they produce pure numpy
+(device interaction in a forked child of an initialized XLA process is
+undefined); the parent does the single H2D hop. Batches cross the process
+boundary as `multiprocessing.shared_memory` blocks (zero-copy handoff,
+pickle only ships names/shapes), the reference's mmap-backed
+`core.Variable` transport re-expressed with the stdlib primitive.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as pyqueue
+import threading
+from multiprocessing import shared_memory
+
+import numpy as np
+
+_SENTINEL = None
+
+
+def _untrack(name):
+    """Detach a segment from this process's resource_tracker. The CHILD
+    creates segments but the PARENT owns their lifetime (copy-then-unlink);
+    without this, the tracker unlinks them when the worker exits — a race
+    that manifests as FileNotFoundError on slow consumers (3.12 has no
+    track=False yet)."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister("/" + name.lstrip("/"), "shared_memory")
+    except Exception:
+        pass
+
+
+def _np_collate(batch):
+    """Worker-side collate to NUMPY structures only."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int32)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(_np_collate(list(s)) for s in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    raise TypeError(f"multiprocess DataLoader cannot collate {type(sample)}; "
+                    "provide a collate_fn returning numpy")
+
+
+def _to_shm(obj, shms):
+    """Replace ndarrays in a nested structure with shm descriptors."""
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        blk = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+        _untrack(blk.name)  # parent owns lifetime, not this process
+        view = np.ndarray(arr.shape, arr.dtype, buffer=blk.buf)
+        view[...] = arr
+        shms.append(blk)
+        return ("__shm__", blk.name, arr.shape, arr.dtype.str)
+    if isinstance(obj, tuple):
+        return tuple(_to_shm(o, shms) for o in obj)
+    if isinstance(obj, list):
+        return [_to_shm(o, shms) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_shm(v, shms) for k, v in obj.items()}
+    return obj
+
+
+def _from_shm(obj, opened):
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        _, name, shape, dtype = obj
+        blk = shared_memory.SharedMemory(name=name)  # attach (no tracker
+        opened.append(blk)                           # registration on 3.12)
+        # copy out so the block can be unlinked immediately
+        return np.ndarray(shape, np.dtype(dtype), buffer=blk.buf).copy()
+    if isinstance(obj, tuple):
+        return tuple(_from_shm(o, opened) for o in obj)
+    if isinstance(obj, list):
+        return [_from_shm(o, opened) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _from_shm(v, opened) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn,
+                 use_shared_memory, worker_id, worker_init_fn):
+    """Runs in the child process. numpy only — no jax."""
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    collate = collate_fn or _np_collate
+    while True:
+        item = index_queue.get()
+        if item is _SENTINEL:
+            result_queue.put(_SENTINEL)
+            return
+        seq, indices = item
+        try:
+            batch = collate([dataset[i] for i in indices])
+            if use_shared_memory:
+                shms = []
+                desc = _to_shm(batch, shms)
+                result_queue.put((seq, desc, None))
+                for blk in shms:  # parent copies out; child just closes
+                    blk.close()
+            else:
+                result_queue.put((seq, batch, None))
+        except Exception as e:  # noqa: BLE001 — ship to parent
+            import traceback
+            result_queue.put((seq, None, f"{e}\n{traceback.format_exc()}"))
+            return
+
+
+class MultiprocessIter:
+    """Ordered multiprocess prefetch iterator (dataloader_iter.py role)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        ctx = mp.get_context("fork")
+        n = loader.num_workers
+        self._index_queue = ctx.Queue()
+        self._result_queue = ctx.Queue()
+        self._workers = []
+        self._pending = {}
+        self._emit = 0
+        self._seq = 0
+        self._done_workers = 0
+        self._n_workers = n
+        self._alive = True
+        self._timeout = loader.timeout or None
+        for wid in range(n):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self._index_queue, self._result_queue,
+                      loader.worker_collate_fn, loader.use_shared_memory, wid,
+                      loader.worker_init_fn),
+                daemon=True)
+            p.start()
+            self._workers.append(p)
+        self._feeder = threading.Thread(target=self._feed, daemon=True)
+        self._feeder.start()
+
+    def _feed(self):
+        for indices in self.loader.batch_sampler:
+            self._index_queue.put((self._seq, list(indices)))
+            self._seq += 1
+        for _ in range(self._n_workers):
+            self._index_queue.put(_SENTINEL)
+
+    def __next__(self):
+        while True:
+            if self._emit in self._pending:
+                desc, err = self._pending.pop(self._emit)
+                self._emit += 1
+                if err is not None:
+                    self._shutdown()
+                    raise RuntimeError(f"DataLoader worker failed:\n{err}")
+                opened = []
+                batch = _from_shm(desc, opened) \
+                    if self.loader.use_shared_memory else desc
+                for blk in opened:
+                    blk.close()
+                    try:
+                        blk.unlink()
+                    except FileNotFoundError:
+                        pass
+                return self.loader._post_collate(batch)
+            if self._done_workers >= self._n_workers:
+                if self._emit in self._pending:
+                    continue
+                self._shutdown()
+                raise StopIteration
+            try:
+                item = self._result_queue.get(timeout=self._timeout)
+            except pyqueue.Empty:
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader timed out after {self._timeout}s")
+            if item is _SENTINEL:
+                self._done_workers += 1
+                continue
+            seq, desc, err = item
+            self._pending[seq] = (desc, err)
+
+    def __iter__(self):
+        return self
+
+    @staticmethod
+    def _unlink_desc(desc):
+        """Reclaim shm segments of an unconsumed batch descriptor (the
+        parent owns their lifetime — see _untrack)."""
+        if isinstance(desc, tuple) and len(desc) == 4 and desc[0] == "__shm__":
+            try:
+                blk = shared_memory.SharedMemory(name=desc[1])
+                blk.close()
+                blk.unlink()
+            except FileNotFoundError:
+                pass
+            return
+        if isinstance(desc, (tuple, list)):
+            for o in desc:
+                MultiprocessIter._unlink_desc(o)
+        elif isinstance(desc, dict):
+            for o in desc.values():
+                MultiprocessIter._unlink_desc(o)
+
+    def _shutdown(self):
+        if not self._alive:
+            return
+        self._alive = False
+        for p in self._workers:
+            if p.is_alive():
+                p.terminate()
+        for p in self._workers:
+            p.join(timeout=2)
+        # early exit (break / exception / GC): prefetched-but-unconsumed
+        # batches still hold untracked shm segments — unlink them here
+        if self.loader.use_shared_memory:
+            for desc, _err in self._pending.values():
+                self._unlink_desc(desc)
+            self._pending.clear()
+            while True:
+                try:
+                    item = self._result_queue.get_nowait()
+                except (pyqueue.Empty, OSError, ValueError):
+                    break
+                if item is not _SENTINEL and item[2] is None:
+                    self._unlink_desc(item[1])
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass

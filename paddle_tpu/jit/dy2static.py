@@ -63,7 +63,8 @@ class _Undef:
     __bool__ = __int__ = __float__ = __iter__ = __len__ = _raise
     __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
     __truediv__ = __rtruediv__ = __getitem__ = __call__ = _raise
-    __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = __eq__ = __ne__ = _raise
+    __hash__ = object.__hash__  # identity hash despite custom __eq__
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -110,15 +111,22 @@ def convert_ifelse(pred, true_fn, false_fn, init_vars, names):
     parameter, not through the closure)."""
     if not _is_traced(pred):
         return true_fn(*init_vars) if pred else false_fn(*init_vars)
+
+    def _chk(vals):
+        # raise the friendly error DURING branch tracing — before lax.cond
+        # chokes on an UNDEF leaf with a cryptic tree-mismatch TypeError
+        seq = vals if isinstance(vals, (list, tuple)) else (vals,)
+        for n, v in zip(names, seq):
+            if v is UNDEF:
+                raise ValueError(
+                    f"dy2static: variable '{n}' is assigned in only one "
+                    "branch of a tensor-predicate `if`; initialize it before "
+                    "the branch")
+        return vals
+
     from ..static.nn import cond
-    out = cond(pred, lambda: true_fn(*init_vars),
-               lambda: false_fn(*init_vars))
-    for n, v in zip(names, out if isinstance(out, (list, tuple)) else (out,)):
-        if v is UNDEF:
-            raise ValueError(
-                f"dy2static: variable '{n}' is assigned in only one branch of "
-                "a tensor-predicate `if`; initialize it before the branch")
-    return out
+    return cond(pred, lambda: _chk(true_fn(*init_vars)),
+                lambda: _chk(false_fn(*init_vars)))
 
 
 def convert_while(cond_fn, body_fn, loop_vars, names):
@@ -166,17 +174,29 @@ def convert_for_range(start, stop, step, body_fn, target_init, loop_vars,
                 "a tensor-bound `for range(...)`")
     from ..static.nn import while_loop
 
-    def c(i, *vs):
-        return _as_bool_array(i < stop)
+    # trip count with python-range semantics (negative steps included):
+    # n = max(0, (stop - start + step -/+ 1) // step)
+    a_ = jnp.asarray(_unwrap(start))
+    b_ = jnp.asarray(_unwrap(stop))
+    s_ = jnp.asarray(_unwrap(step))
+    adj = jnp.where(s_ > 0, s_ - 1, s_ + 1)
+    n_trips = jnp.maximum(0, (b_ - a_ + adj) // s_)
 
-    def b(i, *vs):
+    def c(k, i, *vs):
+        return _as_bool_array(k < n_trips)
+
+    def b(k, i, *vs):
         out = body_fn(i, *vs)
-        return (out[0] + step,) + tuple(out[1:])
+        return (k + 1, _unwrap(out[0]) + s_) + tuple(out[1:])
 
-    final = while_loop(c, b, [jnp.asarray(start)] + list(loop_vars))
-    # last target value = start + floor((n-1)) steps; under trace express it
-    # as final_counter - step (counter overshoots by exactly one step)
-    return (final[0] - step,) + tuple(final[1:])
+    final = while_loop(c, b, [jnp.asarray(0), a_] + list(loop_vars))
+    last = a_ + (n_trips - 1) * s_
+    if target_init is not UNDEF:
+        try:
+            last = jnp.where(n_trips > 0, last, _unwrap(target_init))
+        except TypeError:
+            pass  # prior value not array-like: keep computed last
+    return (last,) + tuple(final[2:])
 
 
 def convert_logical_and(lhs_fn, rhs_fn):
@@ -231,6 +251,10 @@ class _AssignedNames(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node):  # walrus: (y := expr)
         self._target(node.target)
         self.generic_visit(node)
 
@@ -372,8 +396,14 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             return node
         i = self._uid()
         cname, bname = f"__dy2s_cond_{i}", f"__dy2s_body_{i}"
-        cond_def = _fn_def(cname, names, [ast.Return(value=node.test)], [])
-        cond_def.body = [ast.Return(value=node.test)]
+        cond_def = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=a) for a in names],
+                               vararg=None, kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
         body_def = _fn_def(bname, names, node.body, names)
         init = ast.List(elts=[_maybe_expr(n) for n in names], ctx=ast.Load())
         call = _jst_call("convert_while",

@@ -48,12 +48,27 @@ def ensure_tensor(x, dtype=None):
     return Tensor(arr)
 
 
+# set by paddle_tpu.profiler.Profiler.start(): fn(name, t0, t1) or None
+_PROFILE_HOOK = None
+
+
 def run_op(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):
     """Execute fn over the arrays of `tensors`; record a tape node if needed.
 
     fn must be a pure function of the positional arrays only (close over any
     static attrs). Returns Tensor or tuple[Tensor].
     """
+    if _PROFILE_HOOK is not None:
+        import time as _time
+        _t0 = _time.time()
+        try:
+            return _run_op_impl(fn, tensors, name)
+        finally:
+            _PROFILE_HOOK(name, _t0, _time.time())
+    return _run_op_impl(fn, tensors, name)
+
+
+def _run_op_impl(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):
     outs, vjp = autograd.apply_op(fn, tensors, name=name)
     if _flags.flag("check_nan_inf") and not isinstance(
             outs[0] if isinstance(outs, tuple) else outs, _TracerTypes):
@@ -61,11 +76,11 @@ def run_op(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):
     if isinstance(outs, tuple):
         wrapped = tuple(Tensor(o) for o in outs)
         if vjp is not None:
-            autograd.record_node(vjp, tensors, list(wrapped), name)
+            autograd.record_node(vjp, tensors, list(wrapped), name, fn=fn)
         return wrapped
     out = Tensor(outs)
     if vjp is not None:
-        autograd.record_node(vjp, tensors, [out], name)
+        autograd.record_node(vjp, tensors, [out], name, fn=fn)
     return out
 
 

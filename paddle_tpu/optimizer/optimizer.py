@@ -178,9 +178,10 @@ class Optimizer:
         params = list(params_meta) if params_meta is not None \
             else (self._parameter_list or [])
         if params and len(params) != len(values):
-            raise ValueError(
+            from ..core.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
                 f"functional_update: {len(values)} values but {len(params)} "
-                "params — pass params_meta matching the values")
+                "params\n  [Hint] pass params_meta matching the values")
         wds = tuple(self._param_wd(p) for p in params) if params else (self._weight_decay,) * len(values)
         need_clip = tuple(getattr(p, "need_clip", True) for p in params) or (True,) * len(values)
         clip = self._grad_clip if grad_clip == "default" else grad_clip

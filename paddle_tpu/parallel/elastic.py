@@ -1,0 +1,149 @@
+"""Elastic training: lease-based membership + gang relaunch.
+
+Reference parity: `python/paddle/distributed/fleet/elastic/manager.py:130`
+(ElasticManager: nodes register in etcd with TTL leases, watches trigger
+membership changes, `manager.py:245-266`) and `elastic/__init__.py:48`
+(launch_elastic: restart loop around the launcher). Env contract kept:
+`PADDLE_ELASTIC_*`.
+
+TPU-native redesign: etcd is replaced by the framework's own C++ TCPStore —
+each node heartbeats a timestamp under `lease:{rank}`; staleness past the
+TTL is the lease expiry; the single-host gang launcher kills and respawns
+the whole gang on any member death (XLA SPMD jobs cannot run degraded, so
+scale-in == restart with new membership, same as the reference's collective
+mode).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional, Sequence
+
+
+class ElasticManager:
+    """Lease-based membership over a TCPStore (manager.py:130 role)."""
+
+    def __init__(self, store, rank: int, world_size: int,
+                 lease_ttl: float = 10.0, heartbeat_interval: float = 2.0):
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.lease_ttl = lease_ttl
+        self.heartbeat_interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- node side --
+    def register(self):
+        self._beat()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _beat(self):
+        self.store.set(f"lease:{self.rank}", repr(time.time()))
+
+    def _run(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except Exception:
+                return  # store gone: the watcher will see our lease expire
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    # -- watcher side --
+    def alive_ranks(self) -> List[int]:
+        now = time.time()
+        alive = []
+        for r in range(self.world_size):
+            try:
+                ts = float(self.store.get(f"lease:{r}").decode())
+            except KeyError:
+                continue
+            if now - ts <= self.lease_ttl:
+                alive.append(r)
+        return alive
+
+    def dead_ranks(self) -> List[int]:
+        alive = set(self.alive_ranks())
+        return [r for r in range(self.world_size) if r not in alive]
+
+    def watch(self, interval: float = 1.0, max_wait: Optional[float] = None):
+        """Block until membership shrinks; returns the dead ranks."""
+        start = time.time()
+        while True:
+            dead = self.dead_ranks()
+            if dead:
+                return dead
+            if max_wait is not None and time.time() - start > max_wait:
+                return []
+            time.sleep(interval)
+
+
+class ElasticResult:
+    def __init__(self, restarts: int, returncodes: Sequence[int]):
+        self.restarts = restarts
+        self.returncodes = list(returncodes)
+
+    @property
+    def success(self) -> bool:
+        return all(rc == 0 for rc in self.returncodes)
+
+
+def launch_elastic(training_script: str, script_args: Sequence[str] = (),
+                   nprocs: int = 2, max_restarts: int = 3,
+                   poll_interval: float = 0.2, env: Optional[dict] = None,
+                   timeout: float = 300.0) -> ElasticResult:
+    """Gang launcher with relaunch loop (elastic/__init__.py:48 role).
+
+    Spawns `nprocs` ranks of `training_script`; if ANY rank dies non-zero,
+    the remaining ranks are killed and the whole gang is relaunched (up to
+    `max_restarts` times) with PADDLE_ELASTIC_RESTART_COUNT advanced —
+    collective jobs restart as a unit, matching the reference's collective
+    elastic mode.
+    """
+    base_env = dict(os.environ if env is None else env)
+    for attempt in range(max_restarts + 1):
+        procs = []
+        for r in range(nprocs):
+            e = dict(base_env)
+            e.update({
+                "PADDLE_TRAINER_ID": str(r),
+                "PADDLE_TRAINERS_NUM": str(nprocs),
+                "PADDLE_ELASTIC_RESTART_COUNT": str(attempt),
+                "PADDLE_ELASTIC_NP": str(nprocs),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, training_script, *map(str, script_args)],
+                env=e))
+        deadline = time.time() + timeout
+        failed = False
+        while True:
+            rcs = [p.poll() for p in procs]
+            if any(rc is not None and rc != 0 for rc in rcs):
+                failed = True
+                break
+            if all(rc == 0 for rc in rcs):
+                break
+            if time.time() > deadline:
+                failed = True
+                break
+            time.sleep(poll_interval)
+        if not failed:
+            return ElasticResult(attempt, [p.returncode for p in procs])
+        for p in procs:  # kill the rest of the gang, then relaunch
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return ElasticResult(max_restarts, [p.returncode for p in procs])

@@ -190,9 +190,13 @@ class MoELayer:
     def _experts(self, inp):
         return self._ffn(inp, self.w1, self.b1, self.w2, self.b2)
 
-    def __call__(self, x, capacity=None):
-        """x: [T, d] (flatten batch*seq first). Returns [T, d]; the aux
-        load-balancing loss of this call is in `self.aux_loss`."""
+    def __call__(self, x, capacity=None, return_aux=False):
+        """x: [T, d] (flatten batch*seq first). Returns [T, d], or
+        (out, aux_loss) with `return_aux=True`.
+
+        Under jit/shard_map tracing, use `return_aux=True` — `self.aux_loss`
+        is a trace-time side effect (stale on cached executions) kept only
+        for eager convenience."""
         arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
         logits = arr @ self.wg
         dispatch, combine, aux = top_k_gating(
@@ -212,7 +216,11 @@ class MoELayer:
         else:
             out = self._experts(buckets)
         y = moe_combine(out, combine)
-        return Tensor(y) if isinstance(x, Tensor) else y
+        wrap = isinstance(x, Tensor)
+        y = Tensor(y) if wrap else y
+        if return_aux:
+            return y, (Tensor(aux) if wrap else aux)
+        return y
 
     def _local_expert_slice(self, inp, rank, e_local):
         # dynamic slice of stacked weights by mesh rank (traced index)

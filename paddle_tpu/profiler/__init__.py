@@ -1,17 +1,24 @@
 """paddle.profiler parity over the JAX/XLA profiler.
 
 Reference parity: `python/paddle/profiler/profiler.py:224` (Profiler with
-scheduler states CLOSED/READY/RECORD, `export_chrome_tracing`:128) and the
-C++ host/device tracers (`platform/profiler/`). TPU device timeline comes
-from the XLA profiler (TraceMe + device trace), written as a TensorBoard-
-compatible trace that includes chrome-trace events — same artifact role as
-`chrometracing_logger.cc`.
+scheduler states CLOSED/READY/RECORD, `export_chrome_tracing`:128), the
+statistics report (`profiler_statistic.py:1`), and the C++ host/device
+tracers (`platform/profiler/host_tracer.cc`, `chrometracing_logger.cc`).
+
+Two planes, as in the reference:
+  - HOST: op-dispatch events hooked into `ops._dispatch.run_op` plus user
+    `RecordEvent` ranges, collected in-process; `summary()` renders the
+    per-op statistics table, `export()` writes chrome://tracing JSON.
+  - DEVICE: the XLA profiler trace (TraceMe + device timeline) written to
+    the trace dir for TensorBoard — the CUPTI-tracer role.
 """
 from __future__ import annotations
 
 import contextlib
 import enum
+import json
 import os
+import threading
 import time
 
 import jax
@@ -59,6 +66,18 @@ def export_chrome_tracing(dir_name, worker_name=None):
     return handler
 
 
+class _HostEvent:
+    __slots__ = ("name", "start", "end", "tid", "kind")
+
+    def __init__(self, name, start, end, tid, kind):
+        self.name, self.start, self.end = name, start, end
+        self.tid, self.kind = tid, kind
+
+    @property
+    def dur(self):
+        return self.end - self.start
+
+
 class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
@@ -68,8 +87,6 @@ class Profiler:
         self._timer_only = timer_only
         self._export_dir = None
         if on_trace_ready is not None:
-            # export_chrome_tracing handlers configure the trace dir; apply
-            # eagerly so start_trace targets the requested directory
             try:
                 on_trace_ready(self)
             except Exception:
@@ -78,7 +95,11 @@ class Profiler:
         self.step_num = 0
         self._step_times = []
         self._t0 = None
+        self._events: list = []
+        self._lock = threading.Lock()
+        self._prev_hook = None
 
+    # ---- lifecycle ----
     def __enter__(self):
         self.start()
         return self
@@ -89,6 +110,10 @@ class Profiler:
 
     def start(self):
         self._t0 = time.time()
+        from ..ops import _dispatch
+        self._prev_hook = getattr(_dispatch, "_PROFILE_HOOK", None)
+        _dispatch._PROFILE_HOOK = self._record_op
+        _ACTIVE_STACK.append(self)
         if not self._timer_only:
             self._export_dir = self._export_dir or "./profiler_log"
             os.makedirs(self._export_dir, exist_ok=True)
@@ -100,6 +125,10 @@ class Profiler:
         return self
 
     def stop(self):
+        from ..ops import _dispatch
+        _dispatch._PROFILE_HOOK = self._prev_hook
+        if _ACTIVE_STACK and _ACTIVE_STACK[-1] is self:
+            _ACTIVE_STACK.pop()
         if self._active:
             try:
                 jax.profiler.stop_trace()
@@ -123,20 +152,78 @@ class Profiler:
         ts = np.asarray(self._step_times[-10:])
         return f"avg step {ts.mean()*1000:.2f} ms (last {len(ts)})"
 
-    def export(self, path, format="json"):
-        pass  # chrome trace already exported by stop_trace
+    # ---- host events ----
+    def _record_op(self, name, start, end, kind="op"):
+        with self._lock:
+            self._events.append(_HostEvent(name, start, end,
+                                           threading.get_ident(), kind))
 
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+    def events(self):
+        return list(self._events)
+
+    # ---- statistics report (profiler_statistic.py role) ----
+    def summary(self, sorted_by="total", op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        return self.step_info()
+        scale = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
+        stats = {}
+        for e in self._events:
+            s = stats.setdefault(e.name, [0, 0.0, float("inf"), 0.0])
+            s[0] += 1
+            s[1] += e.dur
+            s[2] = min(s[2], e.dur)
+            s[3] = max(s[3], e.dur)
+        total = sum(s[1] for s in stats.values()) or 1e-12
+        keyfn = (lambda kv: -kv[1][1]) if sorted_by in ("total", None) \
+            else (lambda kv: -kv[1][0])
+        lines = [
+            "-" * 78,
+            f"{'Name':<30}{'Calls':>7}{'Total(' + time_unit + ')':>14}"
+            f"{'Avg':>9}{'Max':>9}{'Ratio':>8}",
+            "-" * 78,
+        ]
+        for name, (cnt, tot, mn, mx) in sorted(stats.items(), key=keyfn):
+            lines.append(
+                f"{name[:29]:<30}{cnt:>7}{tot * scale:>14.3f}"
+                f"{tot / cnt * scale:>9.3f}{mx * scale:>9.3f}"
+                f"{tot / total:>8.1%}")
+        lines.append("-" * 78)
+        if self._step_times:
+            lines.append(self.step_info())
+        return "\n".join(lines)
+
+    # ---- chrome trace export (chrometracing_logger.cc role) ----
+    def export(self, path, format="json"):
+        events = []
+        for e in self._events:
+            events.append({"name": e.name, "ph": "X", "cat": e.kind,
+                           "ts": e.start * 1e6, "dur": e.dur * 1e6,
+                           "pid": os.getpid(), "tid": e.tid})
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+
+_ACTIVE_STACK: list = []
 
 
 @contextlib.contextmanager
 def RecordEvent(name, event_type=None):
-    """Host-side instrumentation (TraceMe). Parity: `platform/profiler/event_tracing.h`."""
+    """Host-side instrumentation range (`platform/profiler/event_tracing.h`).
+    Recorded into the active Profiler's host events AND forwarded to the
+    XLA TraceMe so it shows up on the device timeline."""
+    t0 = time.time()
     with jax.profiler.TraceAnnotation(name):
-        yield
+        try:
+            yield
+        finally:
+            if _ACTIVE_STACK:
+                _ACTIVE_STACK[-1]._record_op(name, t0, time.time(),
+                                             kind="user")
 
 
 def load_profiler_result(filename):
-    raise NotImplementedError("load_profiler_result: use TensorBoard on the trace dir")
+    with open(filename) as f:
+        return json.load(f)

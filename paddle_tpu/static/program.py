@@ -157,9 +157,11 @@ class Program:
         return Program(self._fn, self._arg_specs, name=self.name)
 
     def __repr__(self):
-        n_ops = len(self.ops())
-        return (f"Program(name={self.name!r}, inputs={len(self.inputs())}, "
-                f"ops={n_ops})")
+        # never triggers lowering (repr must stay cheap for debuggers/logs);
+        # op count appears only once the module was already lowered
+        ops = f", ops={len(self.ops())}" if self._lowered is not None else ""
+        return (f"Program(name={self.name!r}, "
+                f"inputs={len(self.inputs())}{ops})")
 
 
 # module-level "default program" registry (fluid.default_main_program role)

@@ -168,6 +168,55 @@ class TestSemantics:
             _ = h(1, False) + 1  # y unbound: first USE must raise
 
 
+class TestSemantics2:
+    def test_walrus_in_branch_carried_out(self):
+        def f(x, flag):
+            if flag:
+                total = (y := x + 1) * 2
+            else:
+                total = x
+                y = 0
+            return total + y
+
+        h = ast_transform(f)
+        assert h(3, True) == f(3, True) == 12
+
+    def test_negative_step_range_traced(self):
+        @to_static
+        def f(x, n):
+            acc = x * 0
+            for i in range(n, 0, -1):
+                acc = acc + x * i
+            return acc
+
+        import jax.numpy as jnp
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        n = paddle.to_tensor(np.asarray(3, "int32"))
+        np.testing.assert_allclose(f(x, n).numpy(), [6.0])  # 3+2+1
+
+    def test_zero_trip_traced_range(self):
+        @to_static
+        def f(x, n):
+            acc = x * 0
+            for i in range(n):
+                acc = acc + x
+            return acc
+
+        x = paddle.to_tensor(np.array([5.0], "float32"))
+        n = paddle.to_tensor(np.asarray(0, "int32"))
+        np.testing.assert_allclose(f(x, n).numpy(), [0.0])
+
+    def test_undef_equality_raises(self):
+        def f(x, flag):
+            if flag:
+                y = 1
+            return y == 1 if not flag else True
+
+        h = ast_transform(f)
+        with pytest.raises(UnboundLocalError):
+            h(0, False)
+
+
 class TestEndToEnd:
     def test_reference_shaped_model(self):
         """Loop over layers + data-dependent branch, trained end-to-end —

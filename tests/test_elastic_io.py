@@ -1,0 +1,109 @@
+"""Elastic manager + multiprocess DataLoader tests.
+
+Reference techniques: kill-a-worker relaunch (fleet/elastic), worker
+processes + shared-memory transport (dataloader_iter.py)."""
+import os
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.parallel.elastic import ElasticManager, launch_elastic
+
+
+class RangeDs(Dataset):
+    def __init__(self, n=32, d=4):
+        self.x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], np.int32(i)
+
+
+class TestMultiprocessDataLoader:
+    @pytest.mark.parametrize("use_shm", [True, False])
+    def test_ordered_and_complete(self, use_shm):
+        ds = RangeDs(32, 4)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
+                        use_shared_memory=use_shm, timeout=60)
+        seen = []
+        for xb, ib in dl:
+            assert xb.shape == [4, 4]
+            seen.extend(np.asarray(ib._value).tolist())
+        assert seen == list(range(32))  # sampler order preserved
+
+    def test_values_roundtrip_shared_memory(self):
+        ds = RangeDs(16, 8)
+        dl = DataLoader(ds, batch_size=8, num_workers=2, timeout=60)
+        batches = list(dl)
+        got = np.concatenate([np.asarray(b[0]._value) for b in batches])
+        np.testing.assert_allclose(got, ds.x)
+
+    def test_early_break_reclaims_shm(self):
+        ds = RangeDs(64, 4)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, timeout=60)
+        it = iter(dl)
+        next(it)
+        import time
+        time.sleep(0.5)  # let workers prefetch ahead
+        it._shutdown()
+        # all prefetched-but-unconsumed segments must be gone
+        assert not it._pending
+        import glob
+        # no stale paddle-origin segments should keep accumulating; a strict
+        # zero check is racy system-wide, so assert the iterator's own state
+        assert it._alive is False
+
+    def test_worker_exception_propagates(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom-5")
+                return np.zeros(2, np.float32)
+
+        dl = DataLoader(Bad(), batch_size=2, num_workers=2, timeout=60)
+        with pytest.raises(RuntimeError, match="boom-5"):
+            list(dl)
+
+
+class TestElastic:
+    def test_lease_membership(self):
+        from paddle_tpu._native import TCPStore
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        m0 = ElasticManager(store, rank=0, world_size=2, lease_ttl=2.0,
+                            heartbeat_interval=0.2).register()
+        m1 = ElasticManager(store, rank=1, world_size=2, lease_ttl=2.0,
+                            heartbeat_interval=0.2).register()
+        watcher = ElasticManager(store, rank=-1, world_size=2, lease_ttl=2.0)
+        assert sorted(watcher.alive_ranks()) == [0, 1]
+        m1.stop()  # simulate node death: heartbeats cease
+        dead = watcher.watch(interval=0.3, max_wait=8.0)
+        assert dead == [1]
+        m0.stop()
+
+    def test_gang_relaunch_on_failure(self, tmp_path):
+        # rank 1 crashes on the first attempt only; the gang must be killed
+        # and relaunched as a unit, succeeding on attempt 1
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            attempt = int(os.environ["PADDLE_ELASTIC_RESTART_COUNT"])
+            if rank == 1 and attempt == 0:
+                sys.exit(17)  # die -> whole gang relaunches
+            time.sleep(0.3)
+            sys.exit(0)
+        """))
+        res = launch_elastic(str(script), nprocs=2, max_restarts=2,
+                             timeout=60)
+        assert res.success
+        assert res.restarts == 1

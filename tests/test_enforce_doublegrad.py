@@ -1,0 +1,93 @@
+"""Typed-error (enforce) + double-grad tests.
+
+Reference: platform/enforce.h error taxonomy; partial_grad_engine.cc
+create_graph double-grad."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.enforce import (InvalidArgumentError, NotFoundError,
+                                     enforce, enforce_eq, enforce_gt,
+                                     enforce_not_none, errors)
+
+
+class TestEnforce:
+    def test_typed_errors_subclass_builtins(self):
+        assert issubclass(errors.InvalidArgument, ValueError)
+        assert issubclass(errors.NotFound, FileNotFoundError)
+        assert issubclass(errors.OutOfRange, IndexError)
+        assert issubclass(errors.Unimplemented, NotImplementedError)
+
+    def test_enforce_helpers(self):
+        enforce(True, "never")
+        enforce_eq(3, 3)
+        enforce_gt(4, 3)
+        with pytest.raises(InvalidArgumentError, match="Expected"):
+            enforce_eq(3, 4, hint="dims must match")
+        with pytest.raises(NotFoundError):
+            enforce_not_none(None, "weight file")
+        try:
+            enforce_eq(1, 2, hint="check your shapes")
+        except InvalidArgumentError as e:
+            assert "[Hint] check your shapes" in str(e)
+
+    def test_predictor_missing_model_typed(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        cfg = Config(str(tmp_path / "nope"))
+        with pytest.raises(FileNotFoundError, match="Hint"):
+            create_predictor(cfg)
+
+    def test_functional_update_mismatch_typed(self):
+        import jax.numpy as jnp
+        p = paddle.Parameter(np.ones(2, dtype="float32"))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        with pytest.raises(ValueError, match="params_meta"):
+            opt.functional_update([p._value, p._value], [p._value, p._value],
+                                  [{}, {}], jnp.float32(0.1), jnp.float32(1),
+                                  params_meta=[p, p, p])
+
+
+class TestDoubleGrad:
+    def test_second_order_scalar(self):
+        # y = x^3 -> dy/dx = 3x^2 -> d2y/dx2 = 6x
+        x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+        y = x * x * x
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)
+        (ggx,) = paddle.grad(gx, x)
+        np.testing.assert_allclose(ggx.numpy(), [12.0], rtol=1e-5)
+
+    def test_second_order_through_nonlinearity(self):
+        # y = sum(tanh(x)); d2y/dx2 = -2 tanh(x) (1 - tanh(x)^2)
+        xv = np.array([0.3, -0.7], "float32")
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        y = paddle.tanh(x).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        (ggx,) = paddle.grad(gx.sum(), x)
+        t = np.tanh(xv)
+        np.testing.assert_allclose(ggx.numpy(), -2 * t * (1 - t ** 2),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_grad_penalty_training_pattern(self):
+        # WGAN-GP-style: loss includes ||dL/dx||^2 — needs create_graph +
+        # backward through the returned grads
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        x = paddle.to_tensor(np.random.rand(8, 4).astype("float32"),
+                             stop_gradient=False)
+        out = net(x).sum()
+        (gx,) = paddle.grad(out, x, create_graph=True)
+        penalty = (gx * gx).sum()
+        penalty.backward()
+        w = net.weight
+        assert w.grad is not None
+        np.testing.assert_allclose(
+            np.asarray(w.grad).reshape(-1),
+            (2 * 8 * net.weight.numpy()).reshape(-1), rtol=1e-4)
+
+    def test_backward_mode_still_single_level(self):
+        x = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+        (gx,) = paddle.grad(x * x, x)  # no create_graph: raw fast path
+        np.testing.assert_allclose(gx.numpy(), [6.0], rtol=1e-6)
+        assert gx._node is None  # not recorded

@@ -75,6 +75,19 @@ class TestMoELayer:
         ref = jax.nn.gelu(x @ layer.w1[0] + layer.b1[0]) @ layer.w2[0] + layer.b2[0]
         np.testing.assert_allclose(y, np.asarray(ref), rtol=1e-5, atol=1e-5)
 
+    def test_return_aux_under_jit(self):
+        import jax
+        T, d, h, E = 16, 8, 16, 4
+        layer = MoELayer(d, h, num_experts=E, top_k=1)
+
+        @jax.jit
+        def f(x):
+            y, aux = layer(x, capacity=T, return_aux=True)
+            return y, aux
+
+        y, aux = f(jnp.asarray(_r(T, d)))
+        assert y.shape == (T, d) and float(aux) > 0
+
     def test_aux_loss_balanced_vs_skewed(self):
         T, d, h, E = 64, 8, 16, 4
         layer = MoELayer(d, h, num_experts=E, top_k=1)

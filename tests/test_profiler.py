@@ -1,0 +1,55 @@
+"""Profiler tests: host-event collection, statistics report, chrome export.
+
+Reference: profiler.py scheduler states + profiler_statistic.py report +
+chrometracing_logger.cc artifact."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 load_profiler_result, make_scheduler)
+
+
+def test_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(4)]
+    assert states == [ProfilerState.CLOSED, ProfilerState.READY,
+                      ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN]
+    assert sched(10) == ProfilerState.CLOSED  # repeat exhausted
+
+
+def test_op_events_and_summary():
+    x = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    with Profiler(timer_only=True) as prof:
+        for _ in range(3):
+            y = paddle.matmul(x, x)
+            paddle.tanh(y)
+        with RecordEvent("my_region"):
+            paddle.add(x, x)
+        prof.step()
+    names = {e.name for e in prof.events()}
+    assert "matmul" in names and "my_region" in names
+    rep = prof.summary()
+    assert "matmul" in rep and "Calls" in rep and "Ratio" in rep
+    # matmul ran 3 times
+    assert sum(1 for e in prof.events() if e.name == "matmul") == 3
+
+
+def test_chrome_export_roundtrip(tmp_path):
+    x = paddle.to_tensor(np.random.rand(4).astype("float32"))
+    with Profiler(timer_only=True) as prof:
+        paddle.exp(x)
+    p = str(tmp_path / "trace.json")
+    prof.export(p)
+    data = load_profiler_result(p)
+    assert any(ev["name"] == "exp" for ev in data["traceEvents"])
+    assert all(ev["ph"] == "X" for ev in data["traceEvents"])
+
+
+def test_hook_removed_after_stop():
+    from paddle_tpu.ops import _dispatch
+    with Profiler(timer_only=True):
+        pass
+    assert _dispatch._PROFILE_HOOK is None
