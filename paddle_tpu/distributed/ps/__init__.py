@@ -1,0 +1,97 @@
+"""Parameter-server tier (CTR/recommendation workload class).
+
+Reference parity: the python runtime facade `distributed/ps/the_one_ps.py`
+over the C++ PS (`ps/service/brpc_ps_server.cc`, tables in `ps/table/`),
+plus `distributed_lookup_table_op` (`operators/pscore/`) as the trainer-side
+sparse pull/push op.
+
+TPU-native split: sparse embedding tables live on CPU PS hosts; the trainer
+pulls just the batch's rows, runs the DENSE model on the chip, and pushes
+sparse grads back asynchronously through the Communicator — identical
+dataflow to the reference's DownpourWorker (SURVEY §3.5), with the dense
+hot path jitted on TPU.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from .table import DenseTable, SparseTable  # noqa: F401
+from .service import Communicator, PsClient, PsServer  # noqa: F401
+
+
+class PsContext:
+    """the_one_ps-style runtime facade driven by TRAINING_ROLE env."""
+
+    def __init__(self):
+        self.role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self.server_endpoints = [e for e in eps.split(",") if e]
+        self.server: Optional[PsServer] = None
+        self.client: Optional[PsClient] = None
+        self.communicator: Optional[Communicator] = None
+
+    def is_server(self):
+        return self.role == "PSERVER"
+
+    def init_server(self, host="127.0.0.1", port=0) -> PsServer:
+        self.server = PsServer(host, port)
+        return self.server
+
+    def run_server(self, block=False):
+        return self.server.run(block=block)
+
+    def init_worker(self) -> PsClient:
+        self.client = PsClient(self.server_endpoints)
+        self.communicator = Communicator(self.client)
+        return self.client
+
+    def stop_worker(self):
+        if self.communicator is not None:
+            self.communicator.stop()
+        if self.client is not None:
+            self.client.close()
+
+
+class DistributedEmbedding:
+    """Sparse embedding backed by a PS sparse table
+    (`distributed_lookup_table_op` role).
+
+    forward: pull rows for the batch ids (host RPC) -> device tensor;
+    backward: the tape node pushes row grads to the PS via the async
+    Communicator (the DownpourWorker push_gradients path)."""
+
+    def __init__(self, client: PsClient, table: str, dim: int,
+                 communicator: Optional[Communicator] = None):
+        self.client = client
+        self.table = table
+        self.dim = dim
+        self.communicator = communicator
+        client.register_sparse_dim(table, dim)
+
+    def __call__(self, ids):
+        import jax.numpy as jnp
+        from ...core.tensor import Tensor
+        from ...core import autograd
+
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        flat = ids_np.reshape(-1)
+        rows = self.client.pull_sparse(self.table, flat)  # [N, dim] host
+        out = Tensor(jnp.asarray(rows.reshape(*ids_np.shape, self.dim)))
+
+        if autograd.is_grad_enabled():
+            client, table, comm = self.client, self.table, self.communicator
+
+            def vjp(g):
+                g_np = np.asarray(g, np.float32).reshape(len(flat), self.dim)
+                if comm is not None:
+                    comm.push_sparse_async(table, flat, g_np)
+                else:
+                    client.push_sparse(table, flat, g_np)
+                return ()  # no upstream grads: ids are integers
+
+            autograd.record_node(vjp, [], [out], "distributed_lookup_table")
+        return out

@@ -1,0 +1,292 @@
+"""PS RPC plane: threaded socket server + sharded client + async Communicator.
+
+Reference parity: `ps/service/brpc_ps_client.h` / `brpc_ps_server.cc`
+(pull/push dense+sparse RPCs), `ps/service/communicator/communicator.cc:1`
+(async grad send batching), proto `sendrecv.proto`.
+
+Redesign: brpc is replaced by a length-prefixed binary protocol over raw
+sockets (the C++ TCPStore's wire style) — header `cmd table n_ids dim` +
+raw little-endian buffers, no pickle on the hot path. Sparse tables shard
+across servers by `id % n_servers`; dense tables live on server 0.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .table import DenseTable, SparseTable
+
+_HDR = struct.Struct("<B16sqq")  # cmd, table name (padded), n, dim
+CMD_PULL_SPARSE = 1
+CMD_PUSH_SPARSE = 2
+CMD_PULL_DENSE = 3
+CMD_PUSH_DENSE = 4
+CMD_STOP = 5
+CMD_BARRIER = 6
+_OK = b"\x01"
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("ps: peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _tname(name: str) -> bytes:
+    return name.encode()[:16].ljust(16, b"\0")
+
+
+class PsServer:
+    """One parameter-server process/thread (brpc_ps_server role)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._tables: Dict[str, object] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._barrier_count = 0
+        self._barrier_lock = threading.Lock()
+
+    def add_sparse_table(self, name, dim, **kw):
+        self._tables[name] = SparseTable(dim, **kw)
+        return self._tables[name]
+
+    def add_dense_table(self, name, shape, **kw):
+        self._tables[name] = DenseTable(shape, **kw)
+        return self._tables[name]
+
+    def table(self, name):
+        return self._tables[name]
+
+    def run(self, block=False):
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        if block:
+            self._thread.join()
+        return self
+
+    def _serve(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                hdr = _recv_exact(conn, _HDR.size)
+                cmd, name, n, dim = _HDR.unpack(hdr)
+                name = name.rstrip(b"\0").decode()
+                if cmd == CMD_STOP:
+                    conn.sendall(_OK)
+                    self._stop.set()
+                    return
+                if cmd == CMD_BARRIER:
+                    with self._barrier_lock:
+                        self._barrier_count += 1
+                    conn.sendall(_OK)
+                    continue
+                tbl = self._tables[name]
+                if cmd == CMD_PULL_SPARSE:
+                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+                    rows = tbl.pull(ids)
+                    conn.sendall(rows.astype(np.float32).tobytes())
+                elif cmd == CMD_PUSH_SPARSE:
+                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+                    grads = np.frombuffer(
+                        _recv_exact(conn, 4 * n * dim), np.float32
+                    ).reshape(n, dim)
+                    tbl.push(ids, grads)
+                    conn.sendall(_OK)
+                elif cmd == CMD_PULL_DENSE:
+                    w = tbl.pull().astype(np.float32)
+                    conn.sendall(struct.pack("<q", w.size) + w.tobytes())
+                elif cmd == CMD_PUSH_DENSE:
+                    g = np.frombuffer(_recv_exact(conn, 4 * n), np.float32)
+                    tbl.push(g.reshape(tbl.w.shape))
+                    conn.sendall(_OK)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class PsClient:
+    """Sharded client (brpc_ps_client role): sparse ids route to server
+    `id % n_servers`; dense tables live on server 0."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = list(endpoints)
+        self._socks: List[Optional[socket.socket]] = [None] * len(endpoints)
+        self._locks = [threading.Lock() for _ in endpoints]
+        self._dims: Dict[str, int] = {}  # table -> row dim (accessor config)
+
+    def _sock(self, i):
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=30)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    # -- sparse --
+    def pull_sparse(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        dim = self._dims[table]
+        n_srv = len(self.endpoints)
+        out = np.empty((len(ids), dim), np.float32)
+        for s in range(n_srv):
+            sel = np.where(ids % n_srv == s)[0]
+            if len(sel) == 0:
+                continue
+            sub = ids[sel]
+            with self._locks[s]:
+                sk = self._sock(s)
+                sk.sendall(_HDR.pack(CMD_PULL_SPARSE, _tname(table),
+                                     len(sub), 0) + sub.tobytes())
+                rows = np.frombuffer(
+                    _recv_exact(sk, 4 * len(sub) * dim), np.float32
+                ).reshape(len(sub), dim)
+            out[sel] = rows
+        return out
+
+    def register_sparse_dim(self, table: str, dim: int):
+        """Client-side table metadata (the reference ships this in the
+        TableAccessor config)."""
+        self._dims[table] = dim
+
+    def push_sparse(self, table: str, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        n_srv = len(self.endpoints)
+        for s in range(n_srv):
+            sel = np.where(ids % n_srv == s)[0]
+            if len(sel) == 0:
+                continue
+            sub, g = ids[sel], grads[sel]
+            with self._locks[s]:
+                sk = self._sock(s)
+                sk.sendall(_HDR.pack(CMD_PUSH_SPARSE, _tname(table),
+                                     len(sub), g.shape[1])
+                           + sub.tobytes() + g.tobytes())
+                _recv_exact(sk, 1)
+
+    # -- dense --
+    def pull_dense(self, table: str) -> np.ndarray:
+        with self._locks[0]:
+            sk = self._sock(0)
+            sk.sendall(_HDR.pack(CMD_PULL_DENSE, _tname(table), 0, 0))
+            (size,) = struct.unpack("<q", _recv_exact(sk, 8))
+            return np.frombuffer(_recv_exact(sk, 4 * size), np.float32).copy()
+
+    def push_dense(self, table: str, grad):
+        g = np.asarray(grad, np.float32).reshape(-1)
+        with self._locks[0]:
+            sk = self._sock(0)
+            sk.sendall(_HDR.pack(CMD_PUSH_DENSE, _tname(table), g.size, 0)
+                       + g.tobytes())
+            _recv_exact(sk, 1)
+
+    def barrier(self):
+        for s in range(len(self.endpoints)):
+            with self._locks[s]:
+                sk = self._sock(s)
+                sk.sendall(_HDR.pack(CMD_BARRIER, _tname(""), 0, 0))
+                _recv_exact(sk, 1)
+
+    def stop_server(self):
+        for s in range(len(self.endpoints)):
+            try:
+                with self._locks[s]:
+                    sk = self._sock(s)
+                    sk.sendall(_HDR.pack(CMD_STOP, _tname(""), 0, 0))
+                    _recv_exact(sk, 1)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+class Communicator:
+    """Async grad sender (communicator.cc role): push_sparse calls are
+    queued and flushed by a background thread, overlapping server updates
+    with the trainer's next step; `flush()`/`barrier()` give the sync
+    points the reference exposes."""
+
+    def __init__(self, client: PsClient, max_queue=64):
+        self.client = client
+        import queue as q
+        self._q = q.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            self._idle.clear()
+            kind, table, a, b = item
+            try:
+                if kind == "sparse":
+                    self.client.push_sparse(table, a, b)
+                else:
+                    self.client.push_dense(table, a)
+            finally:
+                if self._q.empty():
+                    self._idle.set()
+
+    def push_sparse_async(self, table, ids, grads):
+        self._q.put(("sparse", table, np.asarray(ids), np.asarray(grads)))
+
+    def push_dense_async(self, table, grad):
+        self._q.put(("dense", table, np.asarray(grad), None))
+
+    def flush(self, timeout=30.0):
+        t0 = time.time()
+        while not (self._q.empty() and self._idle.is_set()):
+            if time.time() - t0 > timeout:
+                raise TimeoutError("Communicator flush timed out")
+            time.sleep(0.005)
+
+    def stop(self):
+        self.flush()
+        self._q.put(None)
+        self._thread.join(timeout=5)

@@ -1,0 +1,137 @@
+"""Parameter-server tables: dense + sparse (hash) with server-side optimizers.
+
+Reference parity: `paddle/fluid/distributed/ps/table/common_dense_table.cc:1`
+and `common_sparse_table.cc:1` (dense blocks / id->row hash tables with
+per-row optimizer state, lazy row creation, save/load).
+
+TPU-native framing: tables are HOST-side (numpy) — the sparse embedding
+tier stays on CPU hosts exactly as in the reference; only pulled rows ever
+reach the chip. The update rules run vectorized numpy (the server's C++
+math role).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class _SGDRule:
+    def __init__(self, lr=0.01):
+        self.lr = lr
+
+    def slots(self, dim):
+        return {}
+
+    def apply(self, w, g, slots):
+        w -= self.lr * g
+        return w
+
+
+class _AdagradRule:
+    def __init__(self, lr=0.01, eps=1e-8):
+        self.lr = lr
+        self.eps = eps
+
+    def slots(self, dim):
+        return {"g2": np.zeros(dim, np.float32)}
+
+    def apply(self, w, g, slots):
+        slots["g2"] += g * g
+        w -= self.lr * g / (np.sqrt(slots["g2"]) + self.eps)
+        return w
+
+
+_RULES = {"sgd": _SGDRule, "adagrad": _AdagradRule}
+
+
+class DenseTable:
+    """Fixed-shape dense parameter block (common_dense_table role)."""
+
+    def __init__(self, shape, optimizer="sgd", lr=0.01, initializer=None):
+        self._lock = threading.Lock()
+        rng = np.random.default_rng(0)
+        if initializer == "zeros" or initializer is None:
+            self.w = np.zeros(shape, np.float32)
+        else:
+            self.w = rng.normal(0, 0.01, shape).astype(np.float32)
+        self._rule = _RULES[optimizer](lr=lr)
+        self._slots = self._rule.slots(self.w.shape)
+
+    def pull(self):
+        with self._lock:
+            return self.w.copy()
+
+    def push(self, grad):
+        with self._lock:
+            self._rule.apply(self.w, np.asarray(grad, np.float32), self._slots)
+
+    def set(self, value):
+        with self._lock:
+            self.w[...] = value
+
+    def state(self):
+        return {"w": self.w, "slots": self._slots}
+
+
+class SparseTable:
+    """id -> embedding-row hash table with lazy row init and per-row
+    optimizer slots (common_sparse_table role)."""
+
+    def __init__(self, dim, optimizer="sgd", lr=0.01, init_std=0.01, seed=0):
+        self.dim = dim
+        self._lock = threading.Lock()
+        self._rows: Dict[int, np.ndarray] = {}
+        self._slots: Dict[int, dict] = {}
+        self._rule = _RULES[optimizer](lr=lr)
+        self._init_std = init_std
+        self._rng = np.random.default_rng(seed)
+
+    def _row(self, key: int) -> np.ndarray:
+        r = self._rows.get(key)
+        if r is None:
+            r = self._rng.normal(0, self._init_std, self.dim).astype(np.float32)
+            self._rows[key] = r
+            self._slots[key] = self._rule.slots(self.dim)
+        return r
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        with self._lock:
+            # accumulate duplicate ids before applying (one update per key)
+            acc: Dict[int, np.ndarray] = {}
+            for i, g in zip(ids, grads):
+                k = int(i)
+                if k in acc:
+                    acc[k] = acc[k] + g
+                else:
+                    acc[k] = g.copy()
+            for k, g in acc.items():
+                self._rule.apply(self._row(k), g, self._slots[k])
+
+    def __len__(self):
+        return len(self._rows)
+
+    def state(self):
+        return {"rows": self._rows, "slots": self._slots}
+
+    def save(self, path):
+        with self._lock:
+            keys = np.asarray(list(self._rows), np.int64)
+            vals = np.stack([self._rows[int(k)] for k in keys]) if len(keys) \
+                else np.zeros((0, self.dim), np.float32)
+        np.savez(path, keys=keys, vals=vals)
+
+    def load(self, path):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        with self._lock:
+            for k, v in zip(data["keys"], data["vals"]):
+                self._rows[int(k)] = np.asarray(v, np.float32)
+                self._slots.setdefault(int(k), self._rule.slots(self.dim))

@@ -1,0 +1,124 @@
+"""Parameter-server tier tests.
+
+Reference techniques: ps_local_client-style in-process server
+(`ps/service/ps_local_client.h`), CTR trainer flow (SURVEY §3.5)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.ps import (Communicator, DistributedEmbedding,
+                                       PsClient, PsServer)
+from paddle_tpu.distributed.ps.table import DenseTable, SparseTable
+
+
+class TestTables:
+    def test_sparse_lazy_rows_and_sgd(self):
+        t = SparseTable(dim=4, optimizer="sgd", lr=0.5)
+        rows = t.pull([7, 9])
+        assert len(t) == 2 and rows.shape == (2, 4)
+        g = np.ones((2, 4), np.float32)
+        t.push([7, 9], g)
+        rows2 = t.pull([7, 9])
+        np.testing.assert_allclose(rows2, rows - 0.5, rtol=1e-6)
+
+    def test_sparse_duplicate_ids_accumulate(self):
+        t = SparseTable(dim=2, lr=1.0)
+        r0 = t.pull([3])[0]
+        t.push([3, 3], np.ones((2, 2), np.float32))
+        np.testing.assert_allclose(t.pull([3])[0], r0 - 2.0, rtol=1e-6)
+
+    def test_dense_adagrad(self):
+        t = DenseTable((3,), optimizer="adagrad", lr=1.0)
+        t.set(np.zeros(3, np.float32))
+        t.push(np.ones(3, np.float32))
+        # adagrad first step: -lr * g / (sqrt(g^2) + eps) ~= -1
+        np.testing.assert_allclose(t.pull(), -np.ones(3), rtol=1e-5)
+
+    def test_sparse_save_load(self, tmp_path):
+        t = SparseTable(dim=3)
+        t.pull([1, 5])
+        p = str(tmp_path / "table.npz")
+        t.save(p)
+        t2 = SparseTable(dim=3)
+        t2.load(p)
+        np.testing.assert_allclose(t2.pull([1, 5]), t.pull([1, 5]))
+
+
+@pytest.fixture()
+def cluster():
+    servers = [PsServer() for _ in range(2)]
+    for s in servers:
+        s.add_sparse_table("emb", dim=4, lr=0.5)
+        s.run()
+    servers[0].add_dense_table("fc", (4, 2), lr=0.5)
+    client = PsClient([f"{s.host}:{s.port}" for s in servers])
+    client.register_sparse_dim("emb", 4)
+    yield servers, client
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+class TestService:
+    def test_sharded_pull_push_roundtrip(self, cluster):
+        servers, client = cluster
+        ids = np.array([0, 1, 2, 3, 10, 11], np.int64)  # both shards
+        rows = client.pull_sparse("emb", ids)
+        assert rows.shape == (6, 4)
+        # id routing: even ids on server 0, odd on server 1
+        assert len(servers[0].table("emb")) == 3
+        assert len(servers[1].table("emb")) == 3
+        client.push_sparse("emb", ids, np.ones((6, 4), np.float32))
+        rows2 = client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(rows2, rows - 0.5, rtol=1e-6)
+
+    def test_dense_roundtrip(self, cluster):
+        servers, client = cluster
+        w = client.pull_dense("fc")
+        client.push_dense("fc", np.ones(8, np.float32))
+        np.testing.assert_allclose(client.pull_dense("fc"), w - 0.5,
+                                   rtol=1e-6)
+
+    def test_communicator_async(self, cluster):
+        servers, client = cluster
+        comm = Communicator(client)
+        base = client.pull_sparse("emb", [42])
+        for _ in range(5):
+            comm.push_sparse_async("emb", [42], np.ones((1, 4), np.float32))
+        comm.flush()
+        np.testing.assert_allclose(client.pull_sparse("emb", [42]),
+                                   base - 5 * 0.5, rtol=1e-5)
+        comm.stop()
+
+
+class TestCtrEndToEnd:
+    def test_ctr_model_trains_through_ps(self, cluster):
+        """DownpourWorker dataflow: pull sparse rows -> dense model on
+        device -> push sparse grads; loss descends, server rows move."""
+        servers, client = cluster
+        comm = Communicator(client)
+        emb = DistributedEmbedding(client, "emb", dim=4, communicator=comm)
+        paddle.seed(0)
+        head = nn.Linear(8, 2)
+        opt = paddle.optimizer.SGD(parameters=head.parameters(),
+                                   learning_rate=0.1)
+        ce = nn.CrossEntropyLoss()
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 50, (16, 2))
+        y = paddle.to_tensor((ids.sum(1) % 2).astype(np.int32))
+        before = client.pull_sparse("emb", ids.reshape(-1)).copy()
+        losses = []
+        for _ in range(15):
+            e = emb(paddle.to_tensor(ids))          # [16, 2, 4] pulled rows
+            feat = e.reshape([16, 8])
+            loss = ce(head(feat), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            comm.flush()                            # sync point per step
+            losses.append(float(loss))
+        after = client.pull_sparse("emb", ids.reshape(-1))
+        assert losses[-1] < losses[0], losses
+        assert np.abs(after - before).max() > 1e-5  # server rows updated
+        comm.stop()
