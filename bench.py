@@ -72,16 +72,22 @@ def bench_ernie_train(backend):
     step = TrainStep(net, loss_fn, opt, amp_dtype="bfloat16", n_model_inputs=1)
 
     vocab = base.embeddings.word_embeddings.weight.shape[0]
-    ids = paddle.to_tensor(np.random.randint(0, vocab, (batch, seqlen)).astype(np.int32))
-    nsp = paddle.to_tensor(np.random.randint(0, 2, (batch,)).astype(np.int32))
+    n_steps, reps = (100, 5) if backend == "tpu" else (5, 2)
+    # Device-side training loop (TrainStep.run = lax.scan over steps): one
+    # dispatch + one sync per span, mirroring the reference's C++ trainer
+    # hot loop (trainer.h:59) that likewise never returns to the host
+    # between steps. Batches are stacked [n_steps, ...] on device up front.
+    ids_all = paddle.to_tensor(
+        np.random.randint(0, vocab, (n_steps, batch, seqlen)).astype(np.int32))
+    nsp_all = paddle.to_tensor(
+        np.random.randint(0, 2, (n_steps, batch)).astype(np.int32))
 
     def run(n):
-        for _ in range(n):
-            loss = step(ids, ids, nsp)
-        return loss._value
+        assert n == n_steps, "span length is fixed by the stacked batch"
+        losses = step.run(ids_all, ids_all, nsp_all)
+        return losses._value
 
-    _sync(run(2))  # compile + warmup
-    n_steps, reps = (20, 5) if backend == "tpu" else (5, 2)
+    _sync(run(n_steps))  # compile + warmup (one full span)
     sps, spread = _median_rate(run, n_steps, reps, batch)
 
     # train matmul FLOPs/sample ~= 6*N_matmul*S + 3*L*4*S^2*H (PaLM-style)

@@ -49,10 +49,12 @@ class PsContext:
         return self.client
 
     def stop_worker(self):
-        if self.communicator is not None:
-            self.communicator.stop()
-        if self.client is not None:
-            self.client.close()
+        try:
+            if self.communicator is not None:
+                self.communicator.stop()
+        finally:
+            if self.client is not None:
+                self.client.close()
 
 
 class DistributedEmbedding:

@@ -5,16 +5,20 @@ Reference parity: `ps/service/brpc_ps_client.h` / `brpc_ps_server.cc`
 (async grad send batching), proto `sendrecv.proto`.
 
 Redesign: brpc is replaced by a length-prefixed binary protocol over raw
-sockets (the C++ TCPStore's wire style) — header `cmd table n_ids dim` +
-raw little-endian buffers, no pickle on the hot path. Sparse tables shard
-across servers by `id % n_servers`; dense tables live on server 0.
+sockets (the C++ TCPStore's wire style) — request header `cmd table n dim`
++ raw little-endian buffers, no pickle on the hot path. Every response
+starts with a one-byte status; errors carry a message frame so server-side
+failures (unknown table, barrier timeout) surface to the caller instead of
+tearing the connection down. Sparse tables shard across servers by
+`id % n_servers`; dense tables live on server 0. Shard RPCs are issued
+send-first-then-receive so a pull touches all servers in ~one RTT (the
+brpc client's concurrent-request role).
 """
 from __future__ import annotations
 
 import socket
 import struct
 import threading
-import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -22,13 +26,21 @@ import numpy as np
 from .table import DenseTable, SparseTable
 
 _HDR = struct.Struct("<B16sqq")  # cmd, table name (padded), n, dim
+_LEN = struct.Struct("<q")
 CMD_PULL_SPARSE = 1
 CMD_PUSH_SPARSE = 2
 CMD_PULL_DENSE = 3
 CMD_PUSH_DENSE = 4
 CMD_STOP = 5
 CMD_BARRIER = 6
-_OK = b"\x01"
+_ST_OK = b"\x01"
+_ST_ERR = b"\x00"
+
+_BARRIER_TIMEOUT = 60.0
+
+
+class PsError(RuntimeError):
+    """Server-reported request failure (carried in an error frame)."""
 
 
 def _recv_exact(sock, n):
@@ -42,7 +54,25 @@ def _recv_exact(sock, n):
 
 
 def _tname(name: str) -> bytes:
-    return name.encode()[:16].ljust(16, b"\0")
+    b = name.encode()
+    if len(b) > 16:
+        raise ValueError(
+            f"ps table name {name!r} exceeds the 16-byte wire limit")
+    return b.ljust(16, b"\0")
+
+
+def _send_err(conn, msg: str):
+    m = msg.encode()
+    conn.sendall(_ST_ERR + _LEN.pack(len(m)) + m)
+
+
+def _check_status(sock):
+    """Read the response status byte; raise PsError on an error frame."""
+    st = _recv_exact(sock, 1)
+    if st == _ST_OK:
+        return
+    (ln,) = _LEN.unpack(_recv_exact(sock, 8))
+    raise PsError(_recv_exact(sock, ln).decode())
 
 
 class PsServer:
@@ -57,14 +87,19 @@ class PsServer:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._barrier_count = 0
-        self._barrier_lock = threading.Lock()
+        # generation-counted barrier: CMD_BARRIER carries n participants;
+        # the ACK is held until all n arrive (gloo-barrier role)
+        self._barrier_cond = threading.Condition()
+        self._barrier_arrived = 0
+        self._barrier_gen = 0
 
     def add_sparse_table(self, name, dim, **kw):
+        _tname(name)  # validate against the wire limit at registration
         self._tables[name] = SparseTable(dim, **kw)
         return self._tables[name]
 
     def add_dense_table(self, name, shape, **kw):
+        _tname(name)
         self._tables[name] = DenseTable(shape, **kw)
         return self._tables[name]
 
@@ -90,40 +125,71 @@ class PsServer:
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
+    def _barrier(self, n_participants: int):
+        with self._barrier_cond:
+            gen = self._barrier_gen
+            self._barrier_arrived += 1
+            if self._barrier_arrived >= max(n_participants, 1):
+                self._barrier_arrived = 0
+                self._barrier_gen += 1
+                self._barrier_cond.notify_all()
+                return
+            if not self._barrier_cond.wait_for(
+                    lambda: self._barrier_gen != gen,
+                    timeout=_BARRIER_TIMEOUT):
+                # roll back our arrival so later generations aren't corrupted
+                if self._barrier_gen == gen:
+                    self._barrier_arrived -= 1
+                raise PsError(
+                    f"barrier timed out after {_BARRIER_TIMEOUT}s "
+                    f"({n_participants} participants expected)")
+
     def _handle(self, conn):
         try:
             while True:
                 hdr = _recv_exact(conn, _HDR.size)
                 cmd, name, n, dim = _HDR.unpack(hdr)
                 name = name.rstrip(b"\0").decode()
-                if cmd == CMD_STOP:
-                    conn.sendall(_OK)
-                    self._stop.set()
-                    return
-                if cmd == CMD_BARRIER:
-                    with self._barrier_lock:
-                        self._barrier_count += 1
-                    conn.sendall(_OK)
-                    continue
-                tbl = self._tables[name]
+                # read the FULL request payload before processing so an
+                # error reply leaves the stream in sync for the next request
+                ids = grads = None
                 if cmd == CMD_PULL_SPARSE:
                     ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
-                    rows = tbl.pull(ids)
-                    conn.sendall(rows.astype(np.float32).tobytes())
                 elif cmd == CMD_PUSH_SPARSE:
                     ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
                     grads = np.frombuffer(
                         _recv_exact(conn, 4 * n * dim), np.float32
                     ).reshape(n, dim)
-                    tbl.push(ids, grads)
-                    conn.sendall(_OK)
-                elif cmd == CMD_PULL_DENSE:
-                    w = tbl.pull().astype(np.float32)
-                    conn.sendall(struct.pack("<q", w.size) + w.tobytes())
                 elif cmd == CMD_PUSH_DENSE:
-                    g = np.frombuffer(_recv_exact(conn, 4 * n), np.float32)
-                    tbl.push(g.reshape(tbl.w.shape))
-                    conn.sendall(_OK)
+                    grads = np.frombuffer(_recv_exact(conn, 4 * n), np.float32)
+                try:
+                    if cmd == CMD_STOP:
+                        conn.sendall(_ST_OK)
+                        self._stop.set()
+                        return
+                    if cmd == CMD_BARRIER:
+                        self._barrier(int(n))
+                        conn.sendall(_ST_OK)
+                        continue
+                    tbl = self._tables.get(name)
+                    if tbl is None:
+                        raise KeyError(f"ps: unknown table {name!r}")
+                    if cmd == CMD_PULL_SPARSE:
+                        rows = tbl.pull(ids)
+                        conn.sendall(_ST_OK + rows.astype(np.float32).tobytes())
+                    elif cmd == CMD_PUSH_SPARSE:
+                        tbl.push(ids, grads)
+                        conn.sendall(_ST_OK)
+                    elif cmd == CMD_PULL_DENSE:
+                        w = tbl.pull().astype(np.float32)
+                        conn.sendall(_ST_OK + _LEN.pack(w.size) + w.tobytes())
+                    elif cmd == CMD_PUSH_DENSE:
+                        tbl.push(grads.reshape(tbl.w.shape))
+                        conn.sendall(_ST_OK)
+                    else:
+                        raise ValueError(f"ps: unknown command {cmd}")
+                except (KeyError, ValueError, PsError) as e:
+                    _send_err(conn, str(e))
         except (ConnectionError, OSError):
             pass
         finally:
@@ -141,7 +207,8 @@ class PsServer:
 
 class PsClient:
     """Sharded client (brpc_ps_client role): sparse ids route to server
-    `id % n_servers`; dense tables live on server 0."""
+    `id % n_servers`; dense tables live on server 0. Transport errors
+    invalidate the cached connection so the next call reconnects."""
 
     def __init__(self, endpoints: Sequence[str]):
         self.endpoints = list(endpoints)
@@ -152,75 +219,128 @@ class PsClient:
     def _sock(self, i):
         if self._socks[i] is None:
             host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=30)
+            s = socket.create_connection((host, int(port)), timeout=120)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[i] = s
         return self._socks[i]
 
-    # -- sparse --
-    def pull_sparse(self, table: str, ids) -> np.ndarray:
-        ids = np.asarray(ids, np.int64).reshape(-1)
-        dim = self._dims[table]
-        n_srv = len(self.endpoints)
-        out = np.empty((len(ids), dim), np.float32)
-        for s in range(n_srv):
-            sel = np.where(ids % n_srv == s)[0]
-            if len(sel) == 0:
-                continue
-            sub = ids[sel]
-            with self._locks[s]:
-                sk = self._sock(s)
-                sk.sendall(_HDR.pack(CMD_PULL_SPARSE, _tname(table),
-                                     len(sub), 0) + sub.tobytes())
-                rows = np.frombuffer(
-                    _recv_exact(sk, 4 * len(sub) * dim), np.float32
-                ).reshape(len(sub), dim)
-            out[sel] = rows
-        return out
+    def _drop(self, i):
+        # a transport error leaves the stream byte-desynced: close and
+        # forget the socket so the next request starts clean
+        if self._socks[i] is not None:
+            try:
+                self._socks[i].close()
+            except OSError:
+                pass
+            self._socks[i] = None
 
+    def _shard_sel(self, ids):
+        n_srv = len(self.endpoints)
+        return [(s, np.where(ids % n_srv == s)[0]) for s in range(n_srv)
+                if (ids % n_srv == s).any()]
+
+    # -- sparse --
     def register_sparse_dim(self, table: str, dim: int):
         """Client-side table metadata (the reference ships this in the
         TableAccessor config)."""
         self._dims[table] = dim
 
+    def pull_sparse(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        dim = self._dims[table]
+        shards = self._shard_sel(ids)
+        out = np.empty((len(ids), dim), np.float32)
+        # acquire in ascending shard order (deadlock-free), send all
+        # requests, then collect all responses: ~one RTT total
+        for s, sel in shards:
+            self._locks[s].acquire()
+        try:
+            for s, sel in shards:
+                try:
+                    self._sock(s).sendall(
+                        _HDR.pack(CMD_PULL_SPARSE, _tname(table), len(sel), 0)
+                        + ids[sel].tobytes())
+                except OSError:
+                    self._drop(s)
+                    raise
+            for s, sel in shards:
+                sk = self._socks[s]
+                try:
+                    _check_status(sk)
+                    out[sel] = np.frombuffer(
+                        _recv_exact(sk, 4 * len(sel) * dim), np.float32
+                    ).reshape(len(sel), dim)
+                except OSError:
+                    self._drop(s)
+                    raise
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
+        return out
+
     def push_sparse(self, table: str, ids, grads):
         ids = np.asarray(ids, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
-        n_srv = len(self.endpoints)
-        for s in range(n_srv):
-            sel = np.where(ids % n_srv == s)[0]
-            if len(sel) == 0:
-                continue
-            sub, g = ids[sel], grads[sel]
-            with self._locks[s]:
-                sk = self._sock(s)
-                sk.sendall(_HDR.pack(CMD_PUSH_SPARSE, _tname(table),
-                                     len(sub), g.shape[1])
-                           + sub.tobytes() + g.tobytes())
-                _recv_exact(sk, 1)
+        shards = self._shard_sel(ids)
+        for s, sel in shards:
+            self._locks[s].acquire()
+        try:
+            for s, sel in shards:
+                g = grads[sel]
+                try:
+                    self._sock(s).sendall(
+                        _HDR.pack(CMD_PUSH_SPARSE, _tname(table), len(sel),
+                                  g.shape[1]) + ids[sel].tobytes() + g.tobytes())
+                except OSError:
+                    self._drop(s)
+                    raise
+            for s, _ in shards:
+                try:
+                    _check_status(self._socks[s])
+                except OSError:
+                    self._drop(s)
+                    raise
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
 
     # -- dense --
     def pull_dense(self, table: str) -> np.ndarray:
         with self._locks[0]:
-            sk = self._sock(0)
-            sk.sendall(_HDR.pack(CMD_PULL_DENSE, _tname(table), 0, 0))
-            (size,) = struct.unpack("<q", _recv_exact(sk, 8))
-            return np.frombuffer(_recv_exact(sk, 4 * size), np.float32).copy()
+            try:
+                sk = self._sock(0)
+                sk.sendall(_HDR.pack(CMD_PULL_DENSE, _tname(table), 0, 0))
+                _check_status(sk)
+                (size,) = _LEN.unpack(_recv_exact(sk, 8))
+                return np.frombuffer(_recv_exact(sk, 4 * size),
+                                     np.float32).copy()
+            except OSError:
+                self._drop(0)
+                raise
 
     def push_dense(self, table: str, grad):
         g = np.asarray(grad, np.float32).reshape(-1)
         with self._locks[0]:
-            sk = self._sock(0)
-            sk.sendall(_HDR.pack(CMD_PUSH_DENSE, _tname(table), g.size, 0)
-                       + g.tobytes())
-            _recv_exact(sk, 1)
+            try:
+                sk = self._sock(0)
+                sk.sendall(_HDR.pack(CMD_PUSH_DENSE, _tname(table), g.size, 0)
+                           + g.tobytes())
+                _check_status(sk)
+            except OSError:
+                self._drop(0)
+                raise
 
-    def barrier(self):
-        for s in range(len(self.endpoints)):
-            with self._locks[s]:
-                sk = self._sock(s)
-                sk.sendall(_HDR.pack(CMD_BARRIER, _tname(""), 0, 0))
-                _recv_exact(sk, 1)
+    def barrier(self, n_trainers: int = 1):
+        """Block until `n_trainers` clients reach this point (coordinated by
+        server 0 — the gloo-barrier role in the reference's PS bring-up)."""
+        with self._locks[0]:
+            try:
+                sk = self._sock(0)
+                sk.sendall(_HDR.pack(CMD_BARRIER, _tname(""), n_trainers, 0))
+                _check_status(sk)
+            except OSError:
+                self._drop(0)
+                raise
 
     def stop_server(self):
         for s in range(len(self.endpoints)):
@@ -228,17 +348,13 @@ class PsClient:
                 with self._locks[s]:
                     sk = self._sock(s)
                     sk.sendall(_HDR.pack(CMD_STOP, _tname(""), 0, 0))
-                    _recv_exact(sk, 1)
-            except (ConnectionError, OSError):
+                    _check_status(sk)
+            except (ConnectionError, OSError, PsError):
                 pass
 
     def close(self):
-        for s in self._socks:
-            if s is not None:
-                try:
-                    s.close()
-                except OSError:
-                    pass
+        for i in range(len(self._socks)):
+            self._drop(i)
 
 
 class Communicator:
@@ -251,9 +367,12 @@ class Communicator:
         self.client = client
         import queue as q
         self._q = q.Queue(maxsize=max_queue)
-        self._stop = threading.Event()
-        self._idle = threading.Event()
-        self._idle.set()
+        # pending counts enqueued-but-not-yet-applied items; a Condition
+        # (not q.empty + idle flag) closes the pop-before-clear race where
+        # flush() could return while the last push was still in flight
+        self._pending = 0
+        self._cond = threading.Condition()
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -262,31 +381,55 @@ class Communicator:
             item = self._q.get()
             if item is None:
                 return
-            self._idle.clear()
             kind, table, a, b = item
             try:
-                if kind == "sparse":
-                    self.client.push_sparse(table, a, b)
-                else:
-                    self.client.push_dense(table, a)
+                if self._error is None:
+                    if kind == "sparse":
+                        self.client.push_sparse(table, a, b)
+                    else:
+                        self.client.push_dense(table, a)
+            except BaseException as e:  # surface on next flush/push
+                self._error = e
             finally:
-                if self._q.empty():
-                    self._idle.set()
+                with self._cond:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._cond.notify_all()
+
+    def _raise_if_failed(self):
+        if self._error is not None:
+            raise RuntimeError(
+                "Communicator push failed; queued gradients were dropped"
+            ) from self._error
+
+    def _put(self, item):
+        self._raise_if_failed()
+        with self._cond:
+            self._pending += 1
+        self._q.put(item)
 
     def push_sparse_async(self, table, ids, grads):
-        self._q.put(("sparse", table, np.asarray(ids), np.asarray(grads)))
+        self._put(("sparse", table, np.asarray(ids), np.asarray(grads)))
 
     def push_dense_async(self, table, grad):
-        self._q.put(("dense", table, np.asarray(grad), None))
+        self._put(("dense", table, np.asarray(grad), None))
 
     def flush(self, timeout=30.0):
-        t0 = time.time()
-        while not (self._q.empty() and self._idle.is_set()):
-            if time.time() - t0 > timeout:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._pending == 0,
+                                       timeout=timeout):
                 raise TimeoutError("Communicator flush timed out")
-            time.sleep(0.005)
+        self._raise_if_failed()
 
     def stop(self):
-        self.flush()
+        """Drain and shut down the worker; the thread is always joined and
+        any recorded push error re-raised AFTER cleanup."""
+        err: Optional[BaseException] = None
+        try:
+            self.flush()
+        except BaseException as e:
+            err = e
         self._q.put(None)
         self._thread.join(timeout=5)
+        if err is not None:
+            raise err

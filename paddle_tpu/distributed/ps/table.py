@@ -123,15 +123,25 @@ class SparseTable:
         return {"rows": self._rows, "slots": self._slots}
 
     def save(self, path):
+        # rows AND per-row optimizer slots round-trip (reference sparse
+        # tables persist accessor state alongside embeddings)
         with self._lock:
             keys = np.asarray(list(self._rows), np.int64)
             vals = np.stack([self._rows[int(k)] for k in keys]) if len(keys) \
                 else np.zeros((0, self.dim), np.float32)
-        np.savez(path, keys=keys, vals=vals)
+            slot_arrays = {}
+            for sname in self._rule.slots(self.dim):
+                slot_arrays["slot_" + sname] = np.stack(
+                    [self._slots[int(k)][sname] for k in keys]) if len(keys) \
+                    else np.zeros((0, self.dim), np.float32)
+        np.savez(path, keys=keys, vals=vals, **slot_arrays)
 
     def load(self, path):
         data = np.load(path if path.endswith(".npz") else path + ".npz")
+        snames = [f[5:] for f in data.files if f.startswith("slot_")]
         with self._lock:
-            for k, v in zip(data["keys"], data["vals"]):
-                self._rows[int(k)] = np.asarray(v, np.float32)
-                self._slots.setdefault(int(k), self._rule.slots(self.dim))
+            for i, (k, v) in enumerate(zip(data["keys"], data["vals"])):
+                k = int(k)
+                self._rows[k] = np.asarray(v, np.float32)
+                self._slots[k] = {s: np.asarray(data["slot_" + s][i])
+                                  for s in snames} or self._rule.slots(self.dim)

@@ -51,10 +51,7 @@ class TrainStep:
         pnames, bnames = self._pnames, self._bnames
         amp_dtype = self.amp_dtype
 
-        def pure(params, slots, buffers, rng_key, lr, t, inputs, labels):
-            # rng advance + step counter live IN the program: zero per-step
-            # host->device scalar traffic (matters on remote/tunnel targets)
-            step_key, carry_key = jax.random.split(rng_key)
+        def one_step(params, slots, buffers, step_key, lr, t, inputs, labels):
             rnd.push_trace_key(step_key)
             try:
                 def fwd(ps):
@@ -71,22 +68,49 @@ class TrainStep:
                 loss, grads = jax.value_and_grad(fwd)(params)
                 new_params, new_slots = optimizer.functional_update(
                     params, grads, slots, lr, t, params_meta=ptensors)
-                return new_params, new_slots, loss, carry_key, t + 1.0
+                return new_params, new_slots, loss
             finally:
                 rnd.pop_trace_key()
 
+        def pure(params, slots, buffers, rng_key, lr, t, inputs, labels):
+            # rng advance + step counter live IN the program: zero per-step
+            # host->device scalar traffic (matters on remote/tunnel targets)
+            step_key, carry_key = jax.random.split(rng_key)
+            new_params, new_slots, loss = one_step(
+                params, slots, buffers, step_key, lr, t, inputs, labels)
+            return new_params, new_slots, loss, carry_key, t + 1.0
+
+        def pure_scan(params, slots, buffers, rng_key, lr, t, inputs, labels):
+            # Device-side training loop: N steps inside ONE executable via
+            # lax.scan — the TPU answer to the reference's C++ trainer hot
+            # loop (framework/trainer.h:59, hogwild_worker.cc TrainFiles),
+            # which likewise iterates steps without returning to the host.
+            # inputs/labels are stacked [n_steps, ...]; weights/opt state
+            # stay device-resident across the whole span.
+            def body(carry, xs):
+                params, slots, key, t = carry
+                ins, labs = xs
+                step_key, key = jax.random.split(key)
+                new_params, new_slots, loss = one_step(
+                    params, slots, buffers, step_key, lr, t, ins, labs)
+                return (new_params, new_slots, key, t + 1.0), loss
+
+            (params, slots, key, t), losses = jax.lax.scan(
+                body, (params, slots, rng_key, t), (list(inputs), list(labels)))
+            return params, slots, losses, key, t
+
         donate = (0, 1, 3, 5) if self._donate else ()
         self._jitted = jax.jit(pure, donate_argnums=donate)
+        self._jitted_scan = jax.jit(pure_scan, donate_argnums=donate)
         self._key = rnd.default_generator().next_key()
         self._t_arr = jnp.asarray(float(self.optimizer._step_count + 1),
                                   jnp.float32)
         self._lr_val = None
         self._lr_arr = None
 
-    def __call__(self, *batch):
-        """batch: input tensors consumed by model.forward; loss_fn receives the
-        model output(s) — close labels into loss_fn or pass them as model inputs.
-        """
+    def _prepare(self, batch):
+        """Shared prep for __call__/run: param/buffer arrays, model-input vs
+        label split, lr-array cache refresh."""
         if self._jitted is None:
             self._build()
         params = [t._value for t in self._ptensors]
@@ -95,11 +119,17 @@ class TrainStep:
         n_mi = self._n_model_inputs
         if n_mi is None:
             n_mi = len(arrs) if len(arrs) <= 1 else len(arrs) - 1
-        inputs, labels = arrs[:n_mi], arrs[n_mi:]
         lr_val = self.optimizer.get_lr()
         if lr_val != self._lr_val:
             self._lr_val = lr_val
             self._lr_arr = jnp.asarray(lr_val, jnp.float32)
+        return params, buffers, arrs[:n_mi], arrs[n_mi:]
+
+    def __call__(self, *batch):
+        """batch: input tensors consumed by model.forward; loss_fn receives the
+        model output(s) — close labels into loss_fn or pass them as model inputs.
+        """
+        params, buffers, inputs, labels = self._prepare(batch)
         new_params, self._slots, loss, self._key, self._t_arr = self._jitted(
             params, self._slots, buffers, self._key, self._lr_arr,
             self._t_arr, inputs, labels)
@@ -107,3 +137,20 @@ class TrainStep:
             tns._value = v
         self.optimizer._step_count += 1
         return Tensor(loss)
+
+    def run(self, *batch):
+        """Device-side multi-step loop: every tensor in `batch` is stacked
+        along a leading n_steps axis ([n, ...] per step-shape [...]); runs
+        all n optimizer steps in one executable and returns the [n] loss
+        history as a Tensor. One host dispatch + one sync per span instead
+        of per step — the eager/tunnel dispatch tax disappears.
+        """
+        params, buffers, inputs, labels = self._prepare(batch)
+        n_steps = int(inputs[0].shape[0]) if inputs else int(labels[0].shape[0])
+        new_params, self._slots, losses, self._key, self._t_arr = \
+            self._jitted_scan(params, self._slots, buffers, self._key,
+                              self._lr_arr, self._t_arr, inputs, labels)
+        for tns, v in zip(self._ptensors, new_params):
+            tns._value = v
+        self.optimizer._step_count += n_steps
+        return Tensor(losses)

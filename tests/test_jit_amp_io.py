@@ -95,6 +95,35 @@ class TestTrainStep:
         losses = [float(step(x, y)) for _ in range(60)]
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
+    def test_trainstep_run_matches_stepwise(self):
+        # run(n) — the device-side lax.scan loop — must produce the exact
+        # same weights/loss history as n individual step() dispatches
+        # (identical rng-key chain and step counter).
+        paddle.seed(7)
+        xs = _r(5, 16, 8)
+        ys = np.random.randint(0, 4, (5, 16))
+
+        def train(use_run):
+            paddle.seed(3)
+            net = SmallNet()
+            opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                        learning_rate=1e-2)
+            step = TrainStep(net, nn.CrossEntropyLoss(), opt)
+            if use_run:
+                losses = step.run(paddle.to_tensor(xs), paddle.to_tensor(ys))
+                out = np.asarray(losses._value)
+            else:
+                out = np.array([float(step(paddle.to_tensor(xs[i]),
+                                           paddle.to_tensor(ys[i])))
+                                for i in range(5)])
+            return out, [np.asarray(p._value) for p in net.parameters()]
+
+        l_run, p_run = train(True)
+        l_step, p_step = train(False)
+        np.testing.assert_allclose(l_run, l_step, rtol=1e-5)
+        for a, b in zip(p_run, p_step):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
     def test_trainstep_amp_bf16(self):
         net = SmallNet()
         opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)

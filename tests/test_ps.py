@@ -44,6 +44,27 @@ class TestTables:
         t2.load(p)
         np.testing.assert_allclose(t2.pull([1, 5]), t.pull([1, 5]))
 
+    def test_sparse_save_load_preserves_optimizer_slots(self, tmp_path):
+        # adagrad g2 must survive a save/load: a restored table continues
+        # the damped trajectory, not a near-full first-step update
+        t = SparseTable(dim=3, optimizer="adagrad", lr=0.1)
+        g = np.ones((2, 3), np.float32)
+        t.pull([1, 5])
+        for _ in range(5):
+            t.push([1, 5], g)
+        p = str(tmp_path / "table.npz")
+        t.save(p)
+        t2 = SparseTable(dim=3, optimizer="adagrad", lr=0.1)
+        t2.load(p)
+        t.push([1, 5], g)
+        t2.push([1, 5], g)
+        np.testing.assert_allclose(t2.pull([1, 5]), t.pull([1, 5]), rtol=1e-6)
+
+    def test_table_name_wire_limit(self):
+        from paddle_tpu.distributed.ps.service import _tname
+        with pytest.raises(ValueError):
+            _tname("a_table_name_longer_than_sixteen_bytes")
+
 
 @pytest.fixture()
 def cluster():
@@ -90,6 +111,35 @@ class TestService:
         np.testing.assert_allclose(client.pull_sparse("emb", [42]),
                                    base - 5 * 0.5, rtol=1e-5)
         comm.stop()
+
+    def test_barrier_blocks_until_all_arrive(self, cluster):
+        import threading
+        import time
+        servers, client = cluster
+        order = []
+        c2 = PsClient([f"{s.host}:{s.port}" for s in servers])
+
+        def late():
+            time.sleep(0.3)
+            order.append("b-enter")
+            c2.barrier(n_trainers=2)
+
+        th = threading.Thread(target=late)
+        th.start()
+        t0 = time.time()
+        client.barrier(n_trainers=2)  # must wait for the late arrival
+        order.append("a-release")
+        assert time.time() - t0 > 0.25, "barrier returned before 2nd trainer"
+        th.join()
+        c2.close()
+        assert order[0] == "b-enter"
+
+    def test_communicator_surfaces_push_errors(self, cluster):
+        servers, client = cluster
+        comm = Communicator(client)
+        comm.push_sparse_async("no_such_table", [1], np.ones((1, 4), np.float32))
+        with pytest.raises((RuntimeError, TimeoutError)):
+            comm.flush(timeout=10)
 
 
 class TestCtrEndToEnd:
