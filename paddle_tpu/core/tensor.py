@@ -28,7 +28,7 @@ _ops = None  # set by paddle_tpu.ops at import time (monkey_patch_varbase parity
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_node", "name", "persistable",
-                 "_hooks", "dist_attr")
+                 "_hooks", "dist_attr", "process_mesh")
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
@@ -43,6 +43,7 @@ class Tensor:
         self.persistable = False
         self._hooks = []
         self.dist_attr = None  # PartitionSpec-like tuple for SPMD placement
+        self.process_mesh = None  # auto_parallel ProcessMesh annotation
 
     # ---- metadata ----
     @property

@@ -1,0 +1,18 @@
+"""Semi-auto parallel: annotate -> complete -> plan -> run.
+
+Reference parity: `python/paddle/distributed/auto_parallel/` (interface.py
+shard_tensor/shard_op, process_mesh.py, completion.py, partitioner.py,
+reshard.py, planner.py, cost_model.py, engine.py — 21 files).
+
+TPU-native collapse: Partitioner + Reshard + much of Completion are XLA
+GSPMD's job; what survives is the user annotate API, the planner/cost
+model choosing the mesh, the completion *query* (reading propagated
+shardings off the compiled executable), and the Engine driver.
+"""
+from .process_mesh import ProcessMesh  # noqa: F401
+from .interface import shard_tensor, shard_op  # noqa: F401
+from .completion import Completer  # noqa: F401
+from .reshard import reshard  # noqa: F401
+from .cost_model import ClusterInfo, PlanCost, train_step_cost  # noqa: F401
+from .planner import ParallelPlan, Planner  # noqa: F401
+from .engine import Engine  # noqa: F401

@@ -1,0 +1,72 @@
+"""Annotate API: `shard_tensor` / `shard_op`.
+
+Reference parity: `python/paddle/distributed/auto_parallel/interface.py:1`
+(shard_tensor attaches a dist_attr {process_mesh, dims_mapping} to a
+variable; shard_op annotates an op's inputs/outputs).
+
+TPU-native: annotations ARE the mechanism — eager tensors are device_put
+onto the mesh with a NamedSharding; traced values get
+`lax.with_sharding_constraint`, and XLA's GSPMD pass plays the reference's
+"completion" role for everything unannotated.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+
+def _spec(shard_spec) -> P:
+    return P(*[s if s else None for s in shard_spec])
+
+
+def shard_tensor(x, process_mesh: ProcessMesh, shard_spec: Sequence):
+    """Place `x` on the mesh with per-dim axis names (None = replicated).
+
+    Returns the same Tensor with `dist_attr` set; data is moved/annotated:
+    - eager value -> `jax.device_put` with a NamedSharding;
+    - traced value (inside jit) -> `with_sharding_constraint`.
+    """
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if len(shard_spec) != t._value.ndim:
+        raise ValueError(
+            f"shard_spec {list(shard_spec)} rank != tensor rank {t._value.ndim}")
+    for s in shard_spec:
+        if s is not None and s not in process_mesh.dim_names:
+            raise ValueError(f"unknown mesh dim {s!r}; mesh has "
+                             f"{process_mesh.dim_names}")
+    sharding = NamedSharding(process_mesh.to_jax_mesh(), _spec(shard_spec))
+    if isinstance(t._value, jax.core.Tracer):
+        t._value = jax.lax.with_sharding_constraint(t._value, sharding)
+    else:
+        t._value = jax.device_put(t._value, sharding)
+    t.dist_attr = tuple(s if s else None for s in shard_spec)
+    t.process_mesh = process_mesh
+    return t
+
+
+def shard_op(fn: Callable, process_mesh: ProcessMesh,
+             in_specs: Optional[Sequence] = None,
+             out_specs: Optional[Sequence] = None) -> Callable:
+    """Wrap `fn` so its tensor inputs/outputs are constrained to the given
+    shardings (the reference's shard_op dist-attr annotation)."""
+    def wrapped(*args, **kwargs):
+        if in_specs is not None:
+            args = tuple(
+                shard_tensor(a, process_mesh, sp) if sp is not None else a
+                for a, sp in zip(args, in_specs)
+            ) + tuple(args[len(in_specs):])
+        out = fn(*args, **kwargs)
+        if out_specs is None:
+            return out
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        outs = [shard_tensor(o, process_mesh, sp) if sp is not None else o
+                for o, sp in zip(outs, out_specs)]
+        return outs[0] if single else type(out)(outs)
+    return wrapped

@@ -1,0 +1,63 @@
+"""ProcessMesh — the auto-parallel device grid.
+
+Reference parity: `python/paddle/distributed/auto_parallel/process_mesh.py`
+(ProcessMesh holding an N-D array of process ids + dim names, used by
+`shard_tensor`/`shard_op` annotations).
+
+TPU-native: a ProcessMesh is a thin, picklable description that lowers to a
+`jax.sharding.Mesh` over real (or virtual) devices; dim names become mesh
+axis names, so annotated dims ride GSPMD/ICI collectives directly.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[Sequence[str]] = None):
+        self._ids = np.asarray(mesh, dtype=np.int64)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._ids.ndim)]
+        if len(dim_names) != self._ids.ndim:
+            raise ValueError(
+                f"dim_names {list(dim_names)} rank != mesh rank {self._ids.ndim}")
+        self.dim_names: List[str] = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    @property
+    def shape(self):
+        return tuple(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def process_ids(self):
+        return [int(i) for i in self._ids.reshape(-1)]
+
+    def to_jax_mesh(self) -> Mesh:
+        """Materialize over the runtime's devices (process id -> device)."""
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            if self._ids.size > len(devs):
+                raise ValueError(
+                    f"ProcessMesh needs {self._ids.size} devices, "
+                    f"have {len(devs)}")
+            arr = np.empty(self._ids.shape, dtype=object)
+            for idx, pid in np.ndenumerate(self._ids):
+                arr[idx] = devs[int(pid)]
+            self._jax_mesh = Mesh(arr, tuple(self.dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self.dim_names == other.dim_names
+                and np.array_equal(self._ids, other._ids))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"

@@ -1,0 +1,36 @@
+"""Reshard — move a tensor between shardings/meshes.
+
+Reference parity: `python/paddle/distributed/auto_parallel/reshard.py`
+(Reshard inserts slice/concat/send/recv ops to convert a tensor from one
+dist_attr to another between pipeline/parallel regions).
+
+TPU-native: resharding is a `jax.device_put` onto the target
+NamedSharding — XLA emits the minimal collective (all-gather, all-to-all,
+collective-permute or slice) on ICI; inside jit the same conversion is a
+`with_sharding_constraint`. No manual send/recv graph surgery survives.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+
+def reshard(x, process_mesh: ProcessMesh, shard_spec: Sequence):
+    """Return `x` placed with the new per-dim sharding (None=replicated)."""
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    sharding = NamedSharding(process_mesh.to_jax_mesh(),
+                             P(*[s if s else None for s in shard_spec]))
+    if isinstance(t._value, jax.core.Tracer):
+        val = jax.lax.with_sharding_constraint(t._value, sharding)
+    else:
+        val = jax.device_put(t._value, sharding)
+    out = Tensor(val, stop_gradient=t.stop_gradient)
+    out.dist_attr = tuple(s if s else None for s in shard_spec)
+    out.process_mesh = process_mesh
+    return out

@@ -236,8 +236,45 @@ class PsClient:
 
     def _shard_sel(self, ids):
         n_srv = len(self.endpoints)
-        return [(s, np.where(ids % n_srv == s)[0]) for s in range(n_srv)
-                if (ids % n_srv == s).any()]
+        m = ids % n_srv  # one modulo pass over the id vector
+        out = []
+        for s in range(n_srv):
+            sel = np.where(m == s)[0]
+            if len(sel):
+                out.append((s, sel))
+        return out
+
+    def _send_all(self, shards, make_payload):
+        """Send one request per shard; on a transport error every involved
+        socket is dropped (earlier sends may have unread responses that
+        would byte-desync a reused connection)."""
+        try:
+            for s, sel in shards:
+                self._sock(s).sendall(make_payload(s, sel))
+        except OSError:
+            for s, _ in shards:
+                self._drop(s)
+            raise
+
+    def _recv_all(self, shards, recv_one):
+        """Read every shard's response even if one errors (keeps the other
+        sockets in sync); re-raise the first failure afterwards."""
+        first: Optional[BaseException] = None
+        for s, sel in shards:
+            sk = self._socks[s]
+            if sk is None:
+                continue
+            try:
+                _check_status(sk)
+                if recv_one is not None:
+                    recv_one(s, sel, sk)
+            except OSError as e:
+                self._drop(s)
+                first = first or e
+            except PsError as e:
+                first = first or e
+        if first is not None:
+            raise first
 
     # -- sparse --
     def register_sparse_dim(self, table: str, dim: int):
@@ -255,24 +292,16 @@ class PsClient:
         for s, sel in shards:
             self._locks[s].acquire()
         try:
-            for s, sel in shards:
-                try:
-                    self._sock(s).sendall(
-                        _HDR.pack(CMD_PULL_SPARSE, _tname(table), len(sel), 0)
-                        + ids[sel].tobytes())
-                except OSError:
-                    self._drop(s)
-                    raise
-            for s, sel in shards:
-                sk = self._socks[s]
-                try:
-                    _check_status(sk)
-                    out[sel] = np.frombuffer(
-                        _recv_exact(sk, 4 * len(sel) * dim), np.float32
-                    ).reshape(len(sel), dim)
-                except OSError:
-                    self._drop(s)
-                    raise
+            self._send_all(shards, lambda s, sel: (
+                _HDR.pack(CMD_PULL_SPARSE, _tname(table), len(sel), 0)
+                + ids[sel].tobytes()))
+
+            def recv_rows(s, sel, sk):
+                out[sel] = np.frombuffer(
+                    _recv_exact(sk, 4 * len(sel) * dim), np.float32
+                ).reshape(len(sel), dim)
+
+            self._recv_all(shards, recv_rows)
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -285,21 +314,11 @@ class PsClient:
         for s, sel in shards:
             self._locks[s].acquire()
         try:
-            for s, sel in shards:
-                g = grads[sel]
-                try:
-                    self._sock(s).sendall(
-                        _HDR.pack(CMD_PUSH_SPARSE, _tname(table), len(sel),
-                                  g.shape[1]) + ids[sel].tobytes() + g.tobytes())
-                except OSError:
-                    self._drop(s)
-                    raise
-            for s, _ in shards:
-                try:
-                    _check_status(self._socks[s])
-                except OSError:
-                    self._drop(s)
-                    raise
+            self._send_all(shards, lambda s, sel: (
+                _HDR.pack(CMD_PUSH_SPARSE, _tname(table), len(sel),
+                          grads[sel].shape[1])
+                + ids[sel].tobytes() + grads[sel].tobytes()))
+            self._recv_all(shards, None)
         finally:
             for s, _ in shards:
                 self._locks[s].release()

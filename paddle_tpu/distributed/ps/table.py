@@ -139,9 +139,13 @@ class SparseTable:
     def load(self, path):
         data = np.load(path if path.endswith(".npz") else path + ".npz")
         snames = [f[5:] for f in data.files if f.startswith("slot_")]
+        # decompress each npz member ONCE; store per-row copies so a row
+        # update can't pin the whole backing array
+        keys, vals = data["keys"], data["vals"]
+        slot_data = {s: data["slot_" + s] for s in snames}
         with self._lock:
-            for i, (k, v) in enumerate(zip(data["keys"], data["vals"])):
+            for i, k in enumerate(keys):
                 k = int(k)
-                self._rows[k] = np.asarray(v, np.float32)
-                self._slots[k] = {s: np.asarray(data["slot_" + s][i])
+                self._rows[k] = np.array(vals[i], np.float32)
+                self._slots[k] = {s: np.array(slot_data[s][i])
                                   for s in snames} or self._rule.slots(self.dim)

@@ -134,6 +134,20 @@ class TestService:
         c2.close()
         assert order[0] == "b-enter"
 
+    def test_partial_shard_failure_keeps_sockets_in_sync(self, cluster):
+        # table registered only on server 0: a cross-shard pull fails with
+        # the server's error, but server 1's response is still drained so
+        # later RPCs on that socket return correct bytes
+        from paddle_tpu.distributed.ps.service import PsError
+        servers, client = cluster
+        servers[0].add_sparse_table("solo", dim=4, lr=0.5)
+        client.register_sparse_dim("solo", 4)
+        base = client.pull_sparse("emb", [2, 3])  # both shards, valid
+        with pytest.raises(PsError, match="solo"):
+            client.pull_sparse("solo", [2, 3])  # shard 1 lacks the table
+        after = client.pull_sparse("emb", [2, 3])
+        np.testing.assert_allclose(after, base)
+
     def test_communicator_surfaces_push_errors(self, cluster):
         servers, client = cluster
         comm = Communicator(client)
