@@ -49,6 +49,7 @@ from . import models  # noqa: F401
 from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
 from . import incubate  # noqa: F401
+from . import quantization  # noqa: F401
 from . import distributed  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401

@@ -39,9 +39,9 @@ class Completer:
         GSPMD-propagated output shardings for `fn(*example_args)` given
         the annotated inputs (None spec = let the compiler decide)."""
         mesh = self.process_mesh.to_jax_mesh()
+        # None passes through to jax.jit unconstrained: GSPMD chooses
         in_shardings = tuple(
-            NamedSharding(mesh, P(*sp)) if sp is not None
-            else NamedSharding(mesh, P())
+            NamedSharding(mesh, P(*sp)) if sp is not None else None
             for sp in in_specs)
         jitted = jax.jit(fn, in_shardings=in_shardings)
         compiled = jitted.lower(*example_args).compile()

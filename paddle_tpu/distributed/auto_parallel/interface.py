@@ -21,8 +21,26 @@ from ...core.tensor import Tensor
 from .process_mesh import ProcessMesh
 
 
-def _spec(shard_spec) -> P:
-    return P(*[s if s else None for s in shard_spec])
+def validated_sharding(process_mesh: ProcessMesh, shard_spec: Sequence,
+                       ndim: int) -> "jax.sharding.NamedSharding":
+    """Validate a per-dim spec against the mesh + tensor rank and build the
+    NamedSharding (shared by shard_tensor and reshard)."""
+    if len(shard_spec) != ndim:
+        raise ValueError(
+            f"shard_spec {list(shard_spec)} rank != tensor rank {ndim}")
+    for s in shard_spec:
+        if s is not None and s and s not in process_mesh.dim_names:
+            raise ValueError(f"unknown mesh dim {s!r}; mesh has "
+                             f"{process_mesh.dim_names}")
+    return NamedSharding(process_mesh.to_jax_mesh(),
+                         P(*[s if s else None for s in shard_spec]))
+
+
+def place_value(value, sharding):
+    """eager -> device_put; traced (inside jit) -> sharding constraint."""
+    if isinstance(value, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(value, sharding)
+    return jax.device_put(value, sharding)
 
 
 def shard_tensor(x, process_mesh: ProcessMesh, shard_spec: Sequence):
@@ -33,18 +51,8 @@ def shard_tensor(x, process_mesh: ProcessMesh, shard_spec: Sequence):
     - traced value (inside jit) -> `with_sharding_constraint`.
     """
     t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
-    if len(shard_spec) != t._value.ndim:
-        raise ValueError(
-            f"shard_spec {list(shard_spec)} rank != tensor rank {t._value.ndim}")
-    for s in shard_spec:
-        if s is not None and s not in process_mesh.dim_names:
-            raise ValueError(f"unknown mesh dim {s!r}; mesh has "
-                             f"{process_mesh.dim_names}")
-    sharding = NamedSharding(process_mesh.to_jax_mesh(), _spec(shard_spec))
-    if isinstance(t._value, jax.core.Tracer):
-        t._value = jax.lax.with_sharding_constraint(t._value, sharding)
-    else:
-        t._value = jax.device_put(t._value, sharding)
+    sharding = validated_sharding(process_mesh, shard_spec, t._value.ndim)
+    t._value = place_value(t._value, sharding)
     t.dist_attr = tuple(s if s else None for s in shard_spec)
     t.process_mesh = process_mesh
     return t

@@ -13,24 +13,19 @@ from __future__ import annotations
 
 from typing import Sequence
 
-import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
+from .interface import place_value, validated_sharding
 from .process_mesh import ProcessMesh
 
 
 def reshard(x, process_mesh: ProcessMesh, shard_spec: Sequence):
     """Return `x` placed with the new per-dim sharding (None=replicated)."""
     t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
-    sharding = NamedSharding(process_mesh.to_jax_mesh(),
-                             P(*[s if s else None for s in shard_spec]))
-    if isinstance(t._value, jax.core.Tracer):
-        val = jax.lax.with_sharding_constraint(t._value, sharding)
-    else:
-        val = jax.device_put(t._value, sharding)
-    out = Tensor(val, stop_gradient=t.stop_gradient)
+    sharding = validated_sharding(process_mesh, shard_spec, t._value.ndim)
+    out = Tensor(place_value(t._value, sharding),
+                 stop_gradient=t.stop_gradient)
     out.dist_attr = tuple(s if s else None for s in shard_spec)
     out.process_mesh = process_mesh
     return out

@@ -314,10 +314,13 @@ class PsClient:
         for s, sel in shards:
             self._locks[s].acquire()
         try:
-            self._send_all(shards, lambda s, sel: (
-                _HDR.pack(CMD_PUSH_SPARSE, _tname(table), len(sel),
-                          grads[sel].shape[1])
-                + ids[sel].tobytes() + grads[sel].tobytes()))
+            def payload(s, sel):
+                g = grads[sel]  # one fancy-index copy per shard
+                return (_HDR.pack(CMD_PUSH_SPARSE, _tname(table), len(sel),
+                                  g.shape[1])
+                        + ids[sel].tobytes() + g.tobytes())
+
+            self._send_all(shards, payload)
             self._recv_all(shards, None)
         finally:
             for s, _ in shards:

@@ -1,2 +1,8 @@
-"""paddle.incubate parity surface (experimental APIs live elsewhere in this
-build; kept for import compatibility)."""
+"""paddle.incubate parity surface.
+
+Reference parity: `python/paddle/incubate/` — ASP structured sparsity
+(`fluid/contrib/sparsity/asp/asp.py`), LookAhead/ModelAverage wrapper
+optimizers (`incubate/optimizer/`).
+"""
+from . import asp  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,73 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference parity: `python/paddle/fluid/contrib/sparsity/asp/asp.py:1`
+(`prune_model` computes 2:4 masks per supported weight,
+`decorate(optimizer)` re-applies masks after each optimizer step so
+training preserves the sparsity pattern; `check_sparsity` validates).
+
+TPU-native: masks are plain arrays multiplied into the weights — XLA fuses
+the multiply; the value is the n:m-sparse deployment artifact and the
+accuracy protocol (prune -> masked finetune), not a special kernel.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def compute_mask(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m mask along the REDUCTION dim (dim 0 of an [in, out] matmul
+    weight): in every group of m consecutive inputs, keep the n largest
+    |w| per output channel."""
+    w = np.asarray(w)
+    if w.ndim != 2 or w.shape[0] % m != 0:
+        return np.ones_like(w)
+    din, dout = w.shape
+    g = np.abs(w).reshape(din // m, m, dout)
+    # indices of the top-n |w| within each group
+    order = np.argsort(-g, axis=1)[:, :n, :]
+    mask = np.zeros_like(g)
+    np.put_along_axis(mask, order, 1.0, axis=1)
+    return mask.reshape(din, dout).astype(w.dtype)
+
+
+def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
+    """True iff every m-group along dim 0 has at most n non-zeros."""
+    w = np.asarray(w)
+    if w.ndim != 2 or w.shape[0] % m != 0:
+        return False
+    nz = (w.reshape(w.shape[0] // m, m, w.shape[1]) != 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def prune_model(model, n: int = 2, m: int = 4, min_dim: int = 4):
+    """Compute + apply n:m masks to every prunable 2-D weight (reference
+    prune_model). Masks are stored ON the model (`model._asp_masks`) so
+    their lifetime tracks the model's. Returns {param_name: mask}."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if len(p.shape) != 2 or p.shape[0] % m != 0 or min(p.shape) < min_dim:
+            continue
+        mask = compute_mask(np.asarray(p._value), n, m)
+        p._value = p._value * jnp.asarray(mask)
+        masks[name] = jnp.asarray(mask)
+    model._asp_masks = masks
+    return masks
+
+
+def decorate(optimizer, model):
+    """Wrap optimizer.step so masks are re-applied after every update
+    (reference ASP decorate: OptimizerWithSparsityGuarantee)."""
+    masks = getattr(model, "_asp_masks", {})
+    named = dict(model.named_parameters())
+    inner_step = optimizer.step
+
+    def step():
+        out = inner_step()
+        for name, mask in masks.items():
+            p = named[name]
+            p._value = p._value * mask
+        return out
+
+    optimizer.step = step
+    return optimizer

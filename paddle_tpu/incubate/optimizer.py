@@ -1,0 +1,84 @@
+"""Wrapper optimizers: LookAhead and ModelAverage.
+
+Reference parity: `python/paddle/incubate/optimizer/lookahead.py:1`
+(slow/fast weights: every k steps slow += alpha*(fast-slow), fast := slow)
+and `python/paddle/incubate/optimizer/modelaverage.py` (running average of
+params swapped in for eval via apply()/restore()).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = None
+        self._steps = 0
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        params = self.inner_optimizer._parameter_list
+        if self._slow is None:
+            self._slow = [jnp.asarray(p._value) for p in params]
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            a = self.alpha
+            for i, p in enumerate(params):
+                self._slow[i] = self._slow[i] + a * (p._value - self._slow[i])
+                p._value = self._slow[i]
+
+    def clear_grad(self, *a, **kw):
+        self.inner_optimizer.clear_grad(*a, **kw)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Maintains a running average of parameters; `apply()` swaps the
+    averages in (eval), `restore()` swaps training weights back."""
+
+    def __init__(self, parameters, average_window_rate: float = 0.15,
+                 min_average_window: int = 1, max_average_window: int = 10000):
+        self._params = list(parameters)
+        self._sum = [np.zeros(p.shape, np.float32) for p in self._params]
+        self._cnt = 0
+        self._backup = None
+        self.max_average_window = max_average_window
+
+    def step(self):
+        """Accumulate current weights (call after optimizer.step)."""
+        if self._cnt >= self.max_average_window:
+            # restart the window (reference's window restart policy)
+            self._sum = [np.zeros_like(s) for s in self._sum]
+            self._cnt = 0
+        for s, p in zip(self._sum, self._params):
+            s += np.asarray(p._value)
+        self._cnt += 1
+
+    def apply(self):
+        if self._cnt == 0 or self._backup is not None:
+            return  # already applied: don't clobber the training weights
+        self._backup = [p._value for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._value = jnp.asarray(s / self._cnt)
+
+    def restore(self):
+        if self._backup is None:
+            return
+        for p, v in zip(self._params, self._backup):
+            p._value = v
+        self._backup = None

@@ -1,0 +1,206 @@
+"""Quantization: QAT (fake-quant + STE), PTQ calibration, int8 weight-only.
+
+Reference parity: `python/paddle/fluid/contrib/slim/quantization/`
+(QuantizationTransformPass fake-quant insertion, `imperative/qat.py`
+ImperativeQuantAware layer swap, PTQ calibration) and the inference-side
+quantizer (`inference/api/mkldnn_quantizer.cc:1`).
+
+TPU-native: fake-quant is a jnp straight-through estimator fused by XLA
+into the surrounding matmul — no pass framework needed; the "transform
+pass" is a Layer-tree swap (QuantedLinear/QuantedConv2D). True int8
+storage is weight-only (per-channel symmetric), the useful TPU deployment
+mode: int8 HBM + bf16 MXU compute after an on-chip dequant.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+def _qrange(bits: int):
+    return -(2 ** (bits - 1)), 2 ** (bits - 1) - 1
+
+
+def _fq_arr(v, s, qmin, qmax):
+    s = jnp.maximum(s, 1e-9)
+    q = jnp.clip(jnp.round(v / s), qmin, qmax) * s
+    # straight-through estimator: jax.vjp of this is identity wrt v
+    return v + jax.lax.stop_gradient(q - v)
+
+
+def fake_quant(x, scale, bits: int = 8):
+    """Simulated symmetric quantization with a straight-through gradient:
+    forward rounds to the int grid, backward passes through unchanged.
+    Tensor inputs go through the op dispatch (`ops/_dispatch.run_op`) so the
+    STE is recorded on the autograd tape — QAT gradients flow to the
+    underlying weights/activations."""
+    qmin, qmax = _qrange(bits)
+    s = scale._value if isinstance(scale, Tensor) else jnp.asarray(scale)
+    if isinstance(x, Tensor):
+        from ..ops._dispatch import run_op
+        return run_op(lambda v: _fq_arr(v, s, qmin, qmax), [x],
+                      "fake_quantize_dequantize")
+    return _fq_arr(x, s, qmin, qmax)
+
+
+def abs_max_scale(w, bits: int = 8, channel_axis: Optional[int] = None):
+    """Symmetric scale from the abs-max (per-tensor, or per output channel
+    when channel_axis is given — the weight mode)."""
+    v = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    _, qmax = _qrange(bits)
+    if channel_axis is None:
+        return jnp.max(jnp.abs(v)) / qmax
+    axes = tuple(i for i in range(v.ndim) if i != channel_axis)
+    return jnp.max(jnp.abs(v), axis=axes, keepdims=True) / qmax
+
+
+class MovingAbsMaxObserver:
+    """Activation-range observer (moving_average_abs_max in the reference)."""
+
+    def __init__(self, momentum: float = 0.9, bits: int = 8):
+        self.momentum = momentum
+        self.bits = bits
+        self._state: Optional[float] = None
+
+    def update(self, x) -> float:
+        v = x._value if isinstance(x, Tensor) else x
+        if isinstance(v, jax.core.Tracer):
+            raise RuntimeError(
+                "MovingAbsMaxObserver cannot host-sync a traced value; "
+                "quantized layers use per-batch dynamic scales under jit")
+        cur = float(jnp.max(jnp.abs(v)))
+        self._state = cur if self._state is None else \
+            self.momentum * self._state + (1 - self.momentum) * cur
+        return self.scale
+
+    @property
+    def scale(self) -> float:
+        _, qmax = _qrange(self.bits)
+        if self._state is None:
+            raise RuntimeError(
+                "observer was never calibrated: run at least one forward "
+                "pass before freeze()/convert()")
+        return max(self._state / qmax, 1e-9)
+
+
+class _QuantedBase(nn.Layer):
+    """Shared act-scale policy: frozen scale if converted; live observer in
+    eager calibration/QAT; per-batch dynamic in-graph scale under jit
+    (tracers can't feed the host-side observer)."""
+
+    def __init__(self, bits: int):
+        super().__init__()
+        self.bits = bits
+        self.act_observer = MovingAbsMaxObserver(bits=bits)
+        self._frozen_act_scale: Optional[float] = None
+
+    def _act_scale(self, x):
+        if self._frozen_act_scale is not None:
+            return self._frozen_act_scale
+        v = x._value if isinstance(x, Tensor) else x
+        if isinstance(v, jax.core.Tracer):
+            return abs_max_scale(x, self.bits)
+        return self.act_observer.update(x)
+
+    def freeze(self):
+        self._frozen_act_scale = self.act_observer.scale
+
+
+class QuantedLinear(_QuantedBase):
+    """Linear with fake-quant on activation (per-tensor) and weight
+    (per-output-channel); shares the wrapped layer's parameters."""
+
+    def __init__(self, layer: nn.Linear, bits: int = 8):
+        super().__init__(bits)
+        self.weight = layer.weight
+        self.bias = layer.bias
+
+    def forward(self, x):
+        xq = fake_quant(x, self._act_scale(x), self.bits)
+        wq = fake_quant(self.weight, abs_max_scale(self.weight, self.bits,
+                                                   channel_axis=1), self.bits)
+        return F.linear(xq, wq, self.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def __init__(self, layer, bits: int = 8):
+        super().__init__(bits)
+        self._inner = layer
+
+    def forward(self, x):
+        xq = fake_quant(x, self._act_scale(x), self.bits)
+        w = self._inner.weight
+        wq = fake_quant(w, abs_max_scale(w, self.bits, channel_axis=0),
+                        self.bits)
+        return F.conv2d(xq, wq, self._inner.bias, self._inner.stride,
+                        self._inner.padding, self._inner.dilation,
+                        self._inner.groups, self._inner.data_format)
+
+
+def quant_aware(model: nn.Layer, bits: int = 8) -> nn.Layer:
+    """Swap quantizable sublayers for fake-quant twins IN PLACE
+    (ImperativeQuantAware.quantize role). Returns the model."""
+    for layer in list(model.sublayers(include_self=True)):
+        for name, sub in list(layer._sub_layers.items()):
+            if type(sub) is nn.Linear:
+                layer._sub_layers[name] = QuantedLinear(sub, bits)
+            elif type(sub) is nn.Conv2D:
+                layer._sub_layers[name] = QuantedConv2D(sub, bits)
+    return model
+
+
+def freeze(model: nn.Layer) -> nn.Layer:
+    """Freeze observers after calibration/QAT (convert role): scales become
+    constants so the model jits/exports deterministically."""
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+            layer.freeze()
+    return model
+
+
+class PTQ:
+    """Post-training quantization driver (reference PTQ/mkldnn_quantizer):
+    wrap -> run calibration batches -> freeze."""
+
+    def __init__(self, bits: int = 8):
+        self.bits = bits
+
+    def quantize(self, model: nn.Layer) -> nn.Layer:
+        return quant_aware(model, self.bits)
+
+    def convert(self, model: nn.Layer) -> nn.Layer:
+        return freeze(model)
+
+
+# ---- true int8 storage (weight-only deployment) ----
+def quantize_weights(model: nn.Layer, bits: int = 8
+                     ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """Per-channel symmetric int8 of every 2-D+ weight: name -> (q, scale).
+    Weights are REPLACED by their dequantized values in place (so accuracy
+    impact is visible immediately); the returned dict is the artifact to
+    ship (int8 HBM footprint)."""
+    out = {}
+    qmin, qmax = _qrange(bits)
+    for name, p in model.named_parameters():
+        if len(p.shape) < 2:
+            continue
+        w = np.asarray(p._value)
+        ch_axis = 1 if len(p.shape) == 2 else 0
+        axes = tuple(i for i in range(w.ndim) if i != ch_axis)
+        scale = np.maximum(np.abs(w).max(axis=axes, keepdims=True) / qmax, 1e-9)
+        q = np.clip(np.round(w / scale), qmin, qmax).astype(np.int8)
+        out[name] = (q, scale.astype(np.float32))
+        p._value = jnp.asarray(q.astype(np.float32) * scale)
+    return out
+
+
+def dequantize_weights(artifact: Dict[str, Tuple[np.ndarray, np.ndarray]]
+                       ) -> Dict[str, np.ndarray]:
+    return {k: q.astype(np.float32) * s for k, (q, s) in artifact.items()}
