@@ -22,6 +22,7 @@ from .core.place import (  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
 from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
 from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .core.lod import LoDTensor, create_lod_tensor  # noqa: F401
 from .core.autograd import grad_fn as _grad_fn
 from .core import enforce  # noqa: F401  (typed errors: paddle.enforce.errors)
 from .core.enforce import errors  # noqa: F401

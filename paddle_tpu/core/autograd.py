@@ -187,6 +187,17 @@ def backward(root, grad=None, retain_graph: bool = False):
                 if t.stop_gradient:
                     continue
                 if t._node is None:  # leaf: accumulate .grad
+                    from .selected_rows import SelectedRows
+                    if isinstance(c, SelectedRows):
+                        # sparse embedding grad: stays row-form; hooks see
+                        # the SelectedRows; mixing with an existing dense
+                        # grad densifies via __add__
+                        for h in getattr(t, "_hooks", ()):
+                            r = h(c)
+                            if r is not None:
+                                c = r._value if hasattr(r, "_value") else r
+                        t.grad = c if t.grad is None else t.grad + c
+                        continue
                     gc = c.astype(t._value.dtype) if c.dtype != t._value.dtype else c
                     for h in getattr(t, "_hooks", ()):
                         r = h(gc)

@@ -1,0 +1,82 @@
+"""LoDTensor — ragged sequences as padded-dense + lengths (TPU policy).
+
+Reference parity: LoD (level-of-detail) tensors
+(`paddle/fluid/framework/tensor.h`, LoD utils `phi/core/lod_utils.h`,
+python `fluid.create_lod_tensor`): a flat value buffer + offset table
+describing ragged sequence boundaries, consumed by `operators/sequence_ops/`.
+
+TPU-native redesign: XLA wants static shapes, so raggedness is carried as
+(padded dense data [B, T, ...], lengths [B]) with a bucketing policy that
+pads T up to a bounded set of bucket boundaries — the executor-cache-key
+answer to dynamic shapes (SURVEY §7 hard part 1: "LoD/ragged ops need a
+bucketing/padding policy baked into the cache key"). Compute stays dense
+and masked — MXU-friendly — and every sequence op is a fused jnp program.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+
+DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def bucket_length(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    """Smallest bucket >= n: bounds the set of padded shapes (and thus the
+    XLA executable cache size) regardless of input length distribution."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(n)  # beyond the table: pad exactly (rare, still compiles)
+
+
+class LoDTensor:
+    """Ragged batch: `data` [B, T, ...] padded dense + `lengths` [B]."""
+
+    def __init__(self, data, lengths):
+        self.data = jnp.asarray(data)
+        self.lengths = jnp.asarray(lengths, jnp.int32)
+        if self.data.shape[0] != self.lengths.shape[0]:
+            raise ValueError(
+                f"batch mismatch: data {self.data.shape[0]} vs "
+                f"lengths {self.lengths.shape[0]}")
+
+    @property
+    def shape(self):
+        return list(self.data.shape)
+
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        """Reference LoDTensor API (one ragged level)."""
+        return [[int(l) for l in np.asarray(self.lengths)]]
+
+    def lod(self) -> List[List[int]]:
+        """Offset form: [0, l0, l0+l1, ...] (framework LoD convention)."""
+        off = np.concatenate([[0], np.cumsum(np.asarray(self.lengths))])
+        return [[int(o) for o in off]]
+
+    def mask(self, dtype=jnp.float32):
+        """[B, T] validity mask."""
+        t = self.data.shape[1]
+        return (jnp.arange(t)[None, :] < self.lengths[:, None]).astype(dtype)
+
+    def to_list(self) -> List[np.ndarray]:
+        d = np.asarray(self.data)
+        return [d[i, :int(l)] for i, l in enumerate(np.asarray(self.lengths))]
+
+
+def create_lod_tensor(seqs: Sequence, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                      pad_value=0.0) -> LoDTensor:
+    """Build from a list of variable-length arrays, padding T to the bucket
+    boundary (fluid.create_lod_tensor role, plus the padding policy)."""
+    seqs = [np.asarray(s) for s in seqs]
+    if not seqs:
+        raise ValueError("empty sequence list")
+    lengths = [len(s) for s in seqs]
+    t = bucket_length(max(lengths), buckets)
+    trailing = seqs[0].shape[1:]
+    out = np.full((len(seqs), t) + trailing, pad_value, seqs[0].dtype)
+    for i, s in enumerate(seqs):
+        out[i, :len(s)] = s
+    return LoDTensor(out, np.asarray(lengths, np.int32))

@@ -1,0 +1,64 @@
+"""SelectedRows — sparse row-set gradients (embedding backward).
+
+Reference parity: `phi/core/selected_rows.h` / `framework/selected_rows_utils.h`
+(rows + value block + height), produced by lookup-table grad kernels and
+consumed by sparse optimizer kernels (`operators/optimizers/` sparse adam/
+sgd paths with merged duplicate rows).
+
+TPU-native: rows/values are device arrays; `merge()` fuses duplicate ids
+with a segment-sum (one XLA scatter-add); densify only when an optimizer
+has no sparse rule. For a [vocab, dim] embedding touched by B ids, grads
+carry B*dim floats instead of vocab*dim — the HBM/dispatch win the
+reference gets from SelectedRows.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+        if self.values.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"rows {self.rows.shape[0]} != values rows "
+                f"{self.values.shape[0]}")
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate row ids (merge_selected_rows op role)."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True)
+        summed = jnp.zeros((uniq.shape[0],) + self.values.shape[1:],
+                           self.values.dtype).at[inv].add(self.values)
+        return SelectedRows(uniq, summed, self.height)
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                                jnp.concatenate([self.values, other.values]),
+                                self.height)
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"SelectedRows(n_rows={self.rows.shape[0]}, "
+                f"height={self.height}, dim={self.values.shape[1:]})")

@@ -49,32 +49,54 @@ class LookAhead:
 
 class ModelAverage:
     """Maintains a running average of parameters; `apply()` swaps the
-    averages in (eval), `restore()` swaps training weights back."""
+    averages in (eval), `restore()` swaps training weights back.
+
+    Window policy (reference modelaverage.py semantics): the live window
+    rolls over into an 'old' accumulator once it reaches
+    max(min_average_window, min(max_average_window,
+    num_updates * average_window_rate)); the average spans old + live, so
+    the effective window tracks ~average_window_rate of training."""
 
     def __init__(self, parameters, average_window_rate: float = 0.15,
-                 min_average_window: int = 1, max_average_window: int = 10000):
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000):
         self._params = list(parameters)
         self._sum = [np.zeros(p.shape, np.float32) for p in self._params]
+        self._old_sum = None
         self._cnt = 0
+        self._old_cnt = 0
+        self._num_updates = 0
         self._backup = None
-        self.max_average_window = max_average_window
+        self.average_window_rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+
+    def _window(self) -> int:
+        return max(self.min_average_window,
+                   min(self.max_average_window,
+                       int(self._num_updates * self.average_window_rate) or 1))
 
     def step(self):
         """Accumulate current weights (call after optimizer.step)."""
-        if self._cnt >= self.max_average_window:
-            # restart the window (reference's window restart policy)
-            self._sum = [np.zeros_like(s) for s in self._sum]
-            self._cnt = 0
+        self._num_updates += 1
         for s, p in zip(self._sum, self._params):
             s += np.asarray(p._value)
         self._cnt += 1
+        if self._cnt >= self._window():
+            # roll the live window into the old accumulator
+            self._old_sum = [s.copy() for s in self._sum]
+            self._old_cnt = self._cnt
+            self._sum = [np.zeros_like(s) for s in self._sum]
+            self._cnt = 0
 
     def apply(self):
-        if self._cnt == 0 or self._backup is not None:
-            return  # already applied: don't clobber the training weights
+        total = self._cnt + self._old_cnt
+        if total == 0 or self._backup is not None:
+            return  # nothing accumulated / already applied
         self._backup = [p._value for p in self._params]
-        for p, s in zip(self._params, self._sum):
-            p._value = jnp.asarray(s / self._cnt)
+        for i, p in enumerate(self._params):
+            acc = self._sum[i] + (self._old_sum[i] if self._old_sum else 0.0)
+            p._value = jnp.asarray(acc / total)
 
     def restore(self):
         if self._backup is None:

@@ -86,7 +86,11 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Lookup rows of weight [vocab, dim] by integer ids."""
+    """Lookup rows of weight [vocab, dim] by integer ids.
+
+    sparse=True: the weight gradient is a SelectedRows (rows=looked-up ids,
+    values=row cotangents) instead of a dense [vocab, dim] scatter —
+    reference lookup_table grad -> SelectedRows -> sparse optimizer path."""
     x, weight = ensure_tensor(x), ensure_tensor(weight)
     ids = x._value.astype(jnp.int32)
 
@@ -97,7 +101,28 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, jnp.zeros((), out.dtype), out)
         return out
 
-    return run_op(f, [weight], "embedding")
+    if not sparse:
+        return run_op(f, [weight], "embedding")
+
+    from ...core import autograd
+    from ...core.selected_rows import SelectedRows
+    from ...core.tensor import Tensor
+    height, dim = weight._value.shape
+    out = Tensor(f(weight._value))
+    if autograd.is_grad_enabled() and not weight.stop_gradient:
+        flat_ids = ids.reshape(-1)
+        pad = padding_idx
+
+        def vjp(g):
+            g = g._value if hasattr(g, "_value") else g
+            vals = jnp.reshape(g, (-1, dim))
+            if pad is not None and pad >= 0:
+                vals = jnp.where((flat_ids == pad)[:, None],
+                                 jnp.zeros((), vals.dtype), vals)
+            return (SelectedRows(flat_ids, vals, height),)
+
+        autograd.record_node(vjp, [weight], [out], "lookup_table_sparse_grad")
+    return out
 
 
 def one_hot(x, num_classes, name=None):

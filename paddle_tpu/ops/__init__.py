@@ -13,6 +13,7 @@ from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from . import random as _random_mod
 from .random import (  # noqa: F401
     uniform, uniform_, normal, gaussian, standard_normal, randn, rand, randint,

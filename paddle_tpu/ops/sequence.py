@@ -1,0 +1,126 @@
+"""Sequence ops over (data, lengths) ragged batches.
+
+Reference parity: `paddle/fluid/operators/sequence_ops/` —
+sequence_pad/unpad, sequence_mask, sequence_pool (sum/mean/max/first/last),
+sequence_expand, sequence_softmax. The reference walks LoD offsets with
+per-sequence loops; here every op is a masked dense jnp program (one XLA
+fusion, no per-sequence host loop — the TPU hot-path answer).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._dispatch import ensure_tensor, run_op, to_arr
+
+__all__ = ["sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+           "sequence_expand", "sequence_softmax"]
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="float32"):
+    """[B] -> [B, T] validity mask (sequence_mask_op.cc)."""
+    lengths = ensure_tensor(lengths)
+
+    def fn(l):
+        t = maxlen if maxlen is not None else int(jnp.max(l))  # eager only
+        return (jnp.arange(t)[None, :] < l[:, None]).astype(dtype)
+
+    if maxlen is None:
+        # data-dependent output shape: resolve eagerly (host), like the
+        # reference's runtime InferShape on LoD
+        l = to_arr(lengths)
+        t = int(np.asarray(jnp.max(l)))
+        return Tensor((jnp.arange(t)[None, :] < l[:, None]).astype(dtype))
+    return run_op(fn, [lengths], "sequence_mask")
+
+
+def sequence_pad(seqs, pad_value=0.0, maxlen: Optional[int] = None):
+    """list-of-arrays -> (padded [B,T,...] Tensor, lengths Tensor)
+    (sequence_pad_op.cc; host-side assembly, device-side result)."""
+    from ..core.lod import DEFAULT_BUCKETS, create_lod_tensor
+    if maxlen is not None:
+        longest = max(len(s) for s in seqs)
+        if longest > maxlen:
+            raise ValueError(
+                f"sequence_pad: maxlen={maxlen} < longest sequence "
+                f"({longest}) — reference sequence_pad_op rejects this")
+        buckets = (maxlen,)
+    else:
+        buckets = DEFAULT_BUCKETS
+    lt = create_lod_tensor(seqs, buckets=buckets, pad_value=pad_value)
+    return Tensor(lt.data), Tensor(lt.lengths)
+
+
+def sequence_unpad(x, lengths):
+    """Padded [B,T,...] -> list of numpy arrays (sequence_unpad_op.cc)."""
+    xv, lv = np.asarray(to_arr(ensure_tensor(x))), np.asarray(to_arr(ensure_tensor(lengths)))
+    return [xv[i, :int(l)] for i, l in enumerate(lv)]
+
+
+def sequence_pool(x, lengths, pool_type: str = "sum"):
+    """Masked pooling over T: sum/mean/max/sqrt/first/last
+    (sequence_pool_op.cc semantics on the padded layout)."""
+    x, lengths = ensure_tensor(x), ensure_tensor(lengths)
+    pt = pool_type.lower()
+
+    def fn(v, l):
+        t = v.shape[1]
+        m = (jnp.arange(t)[None, :] < l[:, None])
+        shape = m.shape + (1,) * (v.ndim - 2)
+        mf = m.reshape(shape)
+        if pt == "sum":
+            return jnp.sum(v * mf, axis=1)
+        if pt == "average" or pt == "mean":
+            return jnp.sum(v * mf, axis=1) / jnp.maximum(
+                l.reshape((-1,) + (1,) * (v.ndim - 2)), 1)
+        if pt == "sqrt":
+            return jnp.sum(v * mf, axis=1) / jnp.sqrt(jnp.maximum(
+                l.reshape((-1,) + (1,) * (v.ndim - 2)), 1).astype(v.dtype))
+        if pt == "max":
+            neg = jnp.where(mf, v, jnp.full_like(v, -jnp.inf))
+            return jnp.max(neg, axis=1)
+        if pt == "first":
+            return v[:, 0]
+        if pt == "last":
+            idx = jnp.maximum(l - 1, 0)
+            return jnp.take_along_axis(
+                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), axis=1
+            ).squeeze(1)
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    return run_op(fn, [x, lengths], f"sequence_pool_{pt}")
+
+
+def sequence_expand(x, lengths):
+    """Repeat row i of x lengths[i] times -> [sum(lengths), ...]
+    (sequence_expand_op.cc). Output shape is data-dependent: computed with
+    a host-resolved total (padded to the exact sum)."""
+    lv = np.asarray(to_arr(ensure_tensor(lengths)))
+    reps = jnp.asarray(np.repeat(np.arange(len(lv)), lv))
+    return run_op(lambda v: jnp.take(v, reps, axis=0), [ensure_tensor(x)],
+                  "sequence_expand")
+
+
+def sequence_softmax(x, lengths):
+    """Masked softmax over T (sequence_softmax_op.cc): padding positions
+    get zero probability and contribute nothing to the normalizer."""
+    x, lengths = ensure_tensor(x), ensure_tensor(lengths)
+
+    def fn(v, l):
+        t = v.shape[1]
+        m = jnp.arange(t)[None, :] < l[:, None]
+        z = jnp.where(m, v, jnp.full_like(v, -jnp.inf))
+        z = z - jax_stop_max(z)
+        e = jnp.where(m, jnp.exp(z), jnp.zeros_like(v))
+        return e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-30)
+
+    return run_op(fn, [x, lengths], "sequence_softmax")
+
+
+def jax_stop_max(z):
+    import jax
+    return jax.lax.stop_gradient(jnp.max(jnp.where(jnp.isfinite(z), z, -1e30),
+                                         axis=1, keepdims=True))

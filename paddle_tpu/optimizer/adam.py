@@ -17,6 +17,7 @@ class Adam(Optimizer):
                  lazy_mode=False, multi_precision=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
 
     def _create_slots(self, p):
         return {"moment1": jnp.zeros_like(p._value, dtype=jnp.float32),
@@ -39,6 +40,29 @@ class Adam(Optimizer):
     def _decoupled(self):
         return False
 
+    def _apply_sparse(self, p, sr, slots, *, lr, t, wd):
+        """Sparse adam (adam_op.h SelectedRows path). lazy_mode=True:
+        moments and weights update ONLY at the gradient's rows (untouched
+        rows keep stale moments). Default lazy_mode=False matches the
+        reference default: densify so every row's moments decay each step."""
+        if not self._lazy_mode:
+            return super()._apply_sparse(p, sr, slots, lr=lr, t=t, wd=wd)
+        rows = sr.rows
+        g32 = sr.values.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd and not self._decoupled():
+            g32 = g32 + wd * p32[rows]
+        m_r = self._beta1 * slots["moment1"][rows] + (1 - self._beta1) * g32
+        v_r = self._beta2 * slots["moment2"][rows] + (1 - self._beta2) * (g32 * g32)
+        mhat = m_r / (1 - self._beta1 ** t)
+        vhat = v_r / (1 - self._beta2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if wd and self._decoupled():
+            upd = upd + wd * p32[rows]
+        p_new = p32.at[rows].add(-lr * upd).astype(p.dtype)
+        return p_new, {"moment1": slots["moment1"].at[rows].set(m_r),
+                       "moment2": slots["moment2"].at[rows].set(v_r)}
+
 
 class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
@@ -46,7 +70,7 @@ class AdamW(Adam):
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
                  multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip, name)
+                         weight_decay, grad_clip, name, lazy_mode=lazy_mode)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _decoupled(self):

@@ -64,6 +64,13 @@ class Optimizer:
         """Pure update rule: arrays in, (new_param, new_slots) out."""
         raise NotImplementedError
 
+    def _apply_sparse(self, p, sr, slots, *, lr, t, wd):
+        """Row-wise update for a merged SelectedRows grad (reference sparse
+        optimizer kernels, `operators/optimizers/`). Default: densify —
+        always correct; SGD/Adam override with true row-wise rules."""
+        return self._apply(p, sr.to_dense().astype(p.dtype), slots,
+                           lr=lr, t=t, wd=wd)
+
     def _uses_decoupled_wd(self) -> bool:
         return False
 
@@ -76,35 +83,65 @@ class Optimizer:
 
     # ---- step ----
     def step(self):
+        from ..core.selected_rows import SelectedRows
         params = [p for p in (self._parameter_list or [])
                   if not p.stop_gradient and p.grad is not None]
+        # sparse (SelectedRows) grads take the row-wise path (reference
+        # sparse sgd/adam kernels); dense grads go through the fused jit
+        sparse = [p for p in params if isinstance(p.grad, SelectedRows)]
+        params = [p for p in params if not isinstance(p.grad, SelectedRows)]
+        grads = [p.grad._value if isinstance(p.grad, Tensor) else p.grad for p in params]
+        clip = self._grad_clip
+        clip_in_jit = clip
+
+        merged = []
+        if sparse:
+            merged = [p.grad.merge() for p in sparse]
+            if clip is not None:
+                # clip dense+sparse together HERE (eager): a global norm
+                # must include the sparse rows' contribution (reference
+                # ClipGradByGlobalNorm handles SelectedRows), and per-grad
+                # rules apply to the row values directly
+                all_g = grads + [m.values for m in merged]
+                all_need = tuple(getattr(p, "need_clip", True)
+                                 for p in params + sparse)
+                all_g = _clip_fn(clip, all_g, all_need)
+                grads = all_g[:len(grads)]
+                merged = [SelectedRows(m.rows, v, m.height)
+                          for m, v in zip(merged, all_g[len(grads):])]
+                clip_in_jit = None  # already applied
+
+        lr_s = jnp.asarray(self.get_lr(), jnp.float32)
+        t_s = jnp.asarray(self._step_count + 1, jnp.float32)
+        for p, sr in zip(sparse, merged):
+            if id(p) not in self._accumulators:
+                self._accumulators[id(p)] = self._create_slots(p)
+            p._value, self._accumulators[id(p)] = self._apply_sparse(
+                p._value, sr, self._accumulators[id(p)],
+                lr=lr_s * p.optimize_attr.get("learning_rate", 1.0), t=t_s,
+                wd=self._param_wd(p))
         if not params:
             self._step_count += 1
-            if hasattr(self._learning_rate, "step") and False:
-                pass
             return
-        grads = [p.grad._value if isinstance(p.grad, Tensor) else p.grad for p in params]
 
         for p in params:
             if id(p) not in self._accumulators:
                 self._accumulators[id(p)] = self._create_slots(p)
         slots = [self._accumulators[id(p)] for p in params]
 
-        clip = self._grad_clip
         wds = tuple(self._param_wd(p) for p in params)
         need_clip = tuple(getattr(p, "need_clip", True) for p in params)
         lrs = tuple(p.optimize_attr.get("learning_rate", 1.0) for p in params)
 
         key = (tuple((tuple(p.shape), str(p.dtype)) for p in params), wds, need_clip, lrs,
-               type(clip).__name__)
+               type(clip_in_jit).__name__)
         fn = self._jit_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._make_update(clip, wds, need_clip, lrs))
+            fn = jax.jit(self._make_update(clip_in_jit, wds, need_clip, lrs))
             self._jit_cache[key] = fn
 
-        lr = jnp.asarray(self.get_lr(), jnp.float32)
-        t = jnp.asarray(self._step_count + 1, jnp.float32)
-        new_vals, new_slots = fn([p._value for p in params], grads, slots, lr, t)
+        new_vals, new_slots = fn([p._value for p in params], grads, slots,
+                                 lr_s, t_s)
         for p, v, s in zip(params, new_vals, new_slots):
             p._value = v
             self._accumulators[id(p)] = s
@@ -221,6 +258,14 @@ class SGD(Optimizer):
         if wd:
             g = g + wd * p
         return p - lr.astype(p.dtype) * g, slots
+
+    def _apply_sparse(self, p, sr, slots, *, lr, t, wd):
+        # true sparse rule (sgd_op.h SelectedRows path): touch only the
+        # gradient's rows; wd applies to touched rows only
+        vals = sr.values.astype(p.dtype)
+        if wd:
+            vals = vals + wd * p[sr.rows]
+        return p.at[sr.rows].add(-lr.astype(p.dtype) * vals), slots
 
 
 class Momentum(Optimizer):

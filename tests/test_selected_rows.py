@@ -1,0 +1,173 @@
+"""SelectedRows sparse embedding grads + sparse optimizer rules."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.selected_rows import SelectedRows
+
+
+class TestSelectedRows:
+    def test_merge_sums_duplicates(self):
+        sr = SelectedRows([1, 3, 1], np.ones((3, 2), np.float32), height=5)
+        m = sr.merge()
+        assert sorted(np.asarray(m.rows).tolist()) == [1, 3]
+        d = np.asarray(m.to_dense())
+        np.testing.assert_array_equal(d[1], [2, 2])
+        np.testing.assert_array_equal(d[3], [1, 1])
+
+    def test_add_concats_and_mixed_densifies(self):
+        a = SelectedRows([0], np.ones((1, 2), np.float32), 3)
+        b = SelectedRows([2], np.ones((1, 2), np.float32), 3)
+        c = (a + b).to_dense()
+        np.testing.assert_array_equal(np.asarray(c),
+                                      [[1, 1], [0, 0], [1, 1]])
+        dense = np.full((3, 2), 5.0, np.float32)
+        np.testing.assert_array_equal(np.asarray(a + dense),
+                                      [[6, 6], [5, 5], [5, 5]])
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            SelectedRows([1, 2], np.ones((3, 2)), 5)
+
+
+class TestSparseEmbeddingGrad:
+    def _grad(self, sparse):
+        paddle.seed(0)
+        emb = nn.Embedding(10, 4, sparse=sparse)
+        ids = paddle.to_tensor(np.array([[1, 2], [2, 3]]))
+        out = emb(ids)
+        (out * out).sum().backward()
+        return emb
+
+    def test_grad_is_selected_rows_and_matches_dense(self):
+        e_d = self._grad(False)
+        e_s = self._grad(True)
+        g = e_s.weight.grad
+        assert isinstance(g, SelectedRows)
+        assert g.rows.shape[0] == 4  # one entry per looked-up id
+        dense_g = e_d.weight.grad
+        dense_g = dense_g._value if hasattr(dense_g, "_value") else dense_g
+        np.testing.assert_allclose(np.asarray(g.to_dense()),
+                                   np.asarray(dense_g), rtol=1e-6)
+
+    def test_padding_idx_rows_get_zero_grad(self):
+        paddle.seed(0)
+        emb = nn.Embedding(10, 4, padding_idx=0, sparse=True)
+        ids = paddle.to_tensor(np.array([[0, 1]]))
+        (emb(ids) ** 2).sum().backward()
+        d = np.asarray(emb.weight.grad.to_dense())
+        assert (d[0] == 0).all() and (d[1] != 0).any()
+
+
+class TestSparseOptimizers:
+    def _train(self, opt_cls, sparse, steps=3, **kw):
+        paddle.seed(0)
+        emb = nn.Embedding(12, 4, sparse=sparse)
+        opt = opt_cls(parameters=emb.parameters(), learning_rate=0.1, **kw)
+        ids = paddle.to_tensor(np.array([1, 5, 5, 9]))
+        for _ in range(steps):
+            loss = (emb(ids) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight._value)
+
+    def test_sparse_sgd_matches_dense(self):
+        w_d = self._train(paddle.optimizer.SGD, False)
+        w_s = self._train(paddle.optimizer.SGD, True)
+        np.testing.assert_allclose(w_s, w_d, rtol=1e-5, atol=1e-7)
+
+    def test_sparse_adam_touches_only_grad_rows(self):
+        # lazy-mode semantics: untouched rows (and their moments) unchanged
+        paddle.seed(0)
+        emb = nn.Embedding(12, 4, sparse=True)
+        w0 = np.asarray(emb.weight._value).copy()
+        opt = paddle.optimizer.Adam(parameters=emb.parameters(),
+                                    learning_rate=0.1)
+        ids = paddle.to_tensor(np.array([2, 7]))
+        (emb(ids) ** 2).sum().backward()
+        opt.step()
+        w1 = np.asarray(emb.weight._value)
+        touched = {2, 7}
+        for r in range(12):
+            if r in touched:
+                assert np.abs(w1[r] - w0[r]).max() > 1e-6
+            else:
+                np.testing.assert_array_equal(w1[r], w0[r])
+
+    def test_sparse_adam_matches_dense_when_all_rows_touched(self):
+        # with every row in the batch each step, lazy == dense exactly
+        def run(sparse):
+            paddle.seed(0)
+            emb = nn.Embedding(6, 3, sparse=sparse)
+            opt = paddle.optimizer.Adam(parameters=emb.parameters(),
+                                        learning_rate=0.05)
+            ids = paddle.to_tensor(np.arange(6))
+            for _ in range(4):
+                (emb(ids) ** 2).sum().backward()
+                opt.step()
+                opt.clear_grad()
+            return np.asarray(emb.weight._value)
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-7)
+
+    def test_sparse_grads_respect_global_norm_clip(self):
+        # a huge sparse grad must be clipped exactly like its dense twin
+        def run(sparse):
+            paddle.seed(0)
+            emb = nn.Embedding(8, 4, sparse=sparse)
+            opt = paddle.optimizer.SGD(
+                parameters=emb.parameters(), learning_rate=1.0,
+                grad_clip=nn.ClipGradByGlobalNorm(1.0))
+            ids = paddle.to_tensor(np.array([1, 3]))
+            (1000.0 * emb(ids)).sum().backward()
+            opt.step()
+            return np.asarray(emb.weight._value)
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+    def test_hooks_fire_on_sparse_grads(self):
+        paddle.seed(0)
+        emb = nn.Embedding(8, 4, sparse=True)
+        seen = []
+        emb.weight.register_hook(lambda g: seen.append(type(g).__name__))
+        (emb(paddle.to_tensor(np.array([1]))) ** 2).sum().backward()
+        assert seen == ["SelectedRows"]
+
+    def test_adam_default_nonlazy_decays_all_moments(self):
+        # lazy_mode=False (default): sparse grad densifies, so untouched
+        # rows' weights still move once their moments are non-zero
+        paddle.seed(0)
+        emb = nn.Embedding(6, 3, sparse=True)
+        opt = paddle.optimizer.Adam(parameters=emb.parameters(),
+                                    learning_rate=0.1)
+        (emb(paddle.to_tensor(np.array([0]))) ** 2).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        w1 = np.asarray(emb.weight._value).copy()
+        # second step touches row 5 only; row 0's momentum from step 1 must
+        # still decay-move row 0 under non-lazy semantics
+        (emb(paddle.to_tensor(np.array([5]))) ** 2).sum().backward()
+        opt.step()
+        w2 = np.asarray(emb.weight._value)
+        assert np.abs(w2[0] - w1[0]).max() > 1e-7  # non-lazy: row 0 moved
+        # and lazy mode leaves it frozen
+        paddle.seed(0)
+        emb_l = nn.Embedding(6, 3, sparse=True)
+        opt_l = paddle.optimizer.Adam(parameters=emb_l.parameters(),
+                                      learning_rate=0.1, lazy_mode=True)
+        (emb_l(paddle.to_tensor(np.array([0]))) ** 2).sum().backward()
+        opt_l.step()
+        opt_l.clear_grad()
+        w1l = np.asarray(emb_l.weight._value).copy()
+        (emb_l(paddle.to_tensor(np.array([5]))) ** 2).sum().backward()
+        opt_l.step()
+        w2l = np.asarray(emb_l.weight._value)
+        np.testing.assert_array_equal(w2l[0], w1l[0])  # lazy: row 0 frozen
+
+    def test_fallback_densify_rule(self):
+        # Momentum has no sparse override: densify path must still train
+        w = self._train(paddle.optimizer.Momentum, True, momentum=0.9)
+        w_d = self._train(paddle.optimizer.Momentum, False, momentum=0.9)
+        np.testing.assert_allclose(w, w_d, rtol=1e-5, atol=1e-7)

@@ -1,0 +1,112 @@
+"""LoDTensor (padded-dense ragged policy) + sequence ops vs numpy oracles
+(reference OpTest pattern, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.lod import DEFAULT_BUCKETS, bucket_length
+from paddle_tpu.ops.sequence import (sequence_expand, sequence_mask,
+                                     sequence_pad, sequence_pool,
+                                     sequence_softmax, sequence_unpad)
+
+
+def ragged(seed=0, n=5, dim=3, maxlen=20):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=(int(l), dim)).astype(np.float32)
+            for l in rng.integers(1, maxlen, n)]
+
+
+class TestLoDTensor:
+    def test_roundtrip_and_lod_offsets(self):
+        seqs = ragged()
+        lt = paddle.create_lod_tensor(seqs)
+        back = lt.to_list()
+        for a, b in zip(seqs, back):
+            np.testing.assert_array_equal(a, b)
+        lens = [len(s) for s in seqs]
+        assert lt.recursive_sequence_lengths() == [lens]
+        assert lt.lod() == [[0] + list(np.cumsum(lens))]
+
+    def test_bucketing_bounds_padded_shapes(self):
+        # any length in (16, 32] pads to 32: the executable cache key set
+        # stays bounded no matter the length distribution
+        assert bucket_length(17) == 32 and bucket_length(32) == 32
+        assert bucket_length(1) == DEFAULT_BUCKETS[0]
+        lt_a = paddle.create_lod_tensor([np.zeros(18), np.zeros(25)])
+        lt_b = paddle.create_lod_tensor([np.zeros(31)])
+        assert lt_a.data.shape[1] == lt_b.data.shape[1] == 32
+
+    def test_mask(self):
+        lt = paddle.create_lod_tensor([np.ones(3), np.ones(5)])
+        m = np.asarray(lt.mask())
+        assert m.shape == (2, 16)
+        assert m[0].sum() == 3 and m[1].sum() == 5
+
+    def test_batch_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            paddle.LoDTensor(np.zeros((3, 4)), np.array([1, 2]))
+
+
+class TestSequenceOps:
+    def test_pad_unpad_roundtrip(self):
+        seqs = ragged(1)
+        x, lens = sequence_pad(seqs)
+        back = sequence_unpad(x, lens)
+        for a, b in zip(seqs, back):
+            np.testing.assert_array_equal(a, b)
+
+    def test_pad_maxlen_too_small_raises(self):
+        with pytest.raises(ValueError, match="maxlen"):
+            sequence_pad([np.zeros(10), np.zeros(3)], maxlen=8)
+
+    def test_mask_explicit_maxlen(self):
+        m = sequence_mask(paddle.to_tensor(np.array([2, 4])), maxlen=6)
+        np.testing.assert_array_equal(
+            np.asarray(m._value),
+            [[1, 1, 0, 0, 0, 0], [1, 1, 1, 1, 0, 0]])
+
+    @pytest.mark.parametrize("pool", ["sum", "mean", "sqrt", "max", "first", "last"])
+    def test_pool_matches_numpy(self, pool):
+        seqs = ragged(2)
+        x, lens = sequence_pad(seqs)
+        out = np.asarray(sequence_pool(x, lens, pool)._value)
+        for i, s in enumerate(seqs):
+            if pool == "sum":
+                want = s.sum(0)
+            elif pool == "mean":
+                want = s.mean(0)
+            elif pool == "sqrt":
+                want = s.sum(0) / np.sqrt(len(s))
+            elif pool == "max":
+                want = s.max(0)
+            elif pool == "first":
+                want = s[0]
+            else:
+                want = s[-1]
+            np.testing.assert_allclose(out[i], want, rtol=1e-5, atol=1e-6)
+
+    def test_pool_grad_ignores_padding(self):
+        seqs = [np.ones((2, 3), np.float32), np.ones((4, 3), np.float32)]
+        x, lens = sequence_pad(seqs)
+        x.stop_gradient = False
+        out = sequence_pool(x, lens, "sum").sum()
+        out.backward()
+        g = np.asarray(x.grad._value if hasattr(x.grad, "_value") else x.grad)
+        assert g[0, :2].sum() == 6 and g[0, 2:].sum() == 0  # pad rows: no grad
+
+    def test_expand(self):
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        out = sequence_expand(x, paddle.to_tensor(np.array([2, 3])))
+        np.testing.assert_array_equal(
+            np.asarray(out._value),
+            [[1, 2], [1, 2], [3, 4], [3, 4], [3, 4]])
+
+    def test_softmax_padding_gets_zero_prob(self):
+        seqs = [np.array([1.0, 2.0], np.float32),
+                np.array([1.0, 1.0, 1.0, 1.0], np.float32)]
+        x, lens = sequence_pad(seqs)
+        p = np.asarray(sequence_softmax(x, lens)._value)
+        np.testing.assert_allclose(p.sum(1), 1.0, rtol=1e-6)
+        assert (p[0, 2:] == 0).all()
+        e = np.exp(np.array([1.0, 2.0]) - 2.0)
+        np.testing.assert_allclose(p[0, :2], e / e.sum(), rtol=1e-5)
