@@ -143,6 +143,11 @@ def _collect(roots):
 
 
 def _accumulate(store: dict, tensor, value):
+    from .selected_rows import SelectedRows
+    if isinstance(value, SelectedRows):
+        # non-leaf consumer: upstream vjps are jnp programs that can't eat
+        # a SelectedRows — densify at the sparse/dense boundary
+        value = value.to_dense()
     key = id(tensor)
     cur = store.get(key)
     store[key] = value if cur is None else cur + value

@@ -4,3 +4,4 @@ from ..parallel import *  # noqa: F401,F403
 from ..parallel import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .fleet_executor import DistModel, FleetExecutor  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401

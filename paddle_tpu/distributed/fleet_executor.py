@@ -171,17 +171,30 @@ class FleetExecutor:
         for m, payload in enumerate(microbatches):
             bus.send(Message(-1, 0, "data", payload, m))
         import time as _time
+
+        def join_all():
+            # every actor gets its stop message even if one failed —
+            # otherwise surviving threads block on inbox.get() forever
+            first = None
+            for a in actors:
+                try:
+                    a.join()
+                except RuntimeError as e:
+                    first = first or e
+            return first
+
         deadline = _time.time() + timeout
         while not sink.done.is_set():
             if any(a._error is not None for a in actors):
                 break  # fail fast: surface the stage error via join below
             if _time.time() > deadline:
-                for a in actors:
-                    a.join()
-                raise TimeoutError("FleetExecutor: pipeline did not drain")
+                err = join_all()
+                raise TimeoutError(
+                    "FleetExecutor: pipeline did not drain") from err
             sink.done.wait(0.01)
-        for a in actors:
-            a.join()
+        err = join_all()
+        if err is not None:
+            raise err
         return [sink.results[m] for m in range(len(microbatches))]
 
 

@@ -89,32 +89,71 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    """Wrap per DistributedStrategy toggles (the meta-optimizer resolution
+    the reference does in fleet_base.py:1367 `_minimize_impl`): gradient
+    merge and LocalSGD stack around the hybrid optimizer; recompute is an
+    API (`fleet.utils.recompute`) applied at model level."""
     from .hybrid_optimizer import HybridParallelOptimizer
-    return HybridParallelOptimizer(optimizer, get_hybrid_group(),
-                                   strategy or _FLEET["strategy"])
+    st = strategy or _FLEET["strategy"]
+    opt = HybridParallelOptimizer(optimizer, get_hybrid_group(), st)
+    if st is not None and getattr(st, "gradient_merge", False):
+        from .meta_optimizers import GradientMergeOptimizer
+        cfg = getattr(st, "gradient_merge_configs", {})
+        opt = GradientMergeOptimizer(opt, k_steps=cfg.get("k_steps", 1),
+                                     avg=cfg.get("avg", True))
+    if st is not None and getattr(st, "localsgd", False):
+        from .meta_optimizers import LocalSGDOptimizer
+        cfg = getattr(st, "localsgd_configs", {"k_steps": 4})
+        opt = LocalSGDOptimizer(opt, k_steps=cfg.get("k_steps", 4))
+    return opt
 
 
-# PS-mode surface (reference fleet PS API) — not in the TPU round-1 scope;
-# explicit errors keep ports honest.
-def init_server(*a, **kw):
-    raise NotImplementedError("parameter-server mode: planned (CTR tier, round 2+)")
+# PS-mode surface (reference fleet PS API, fleet_base.py init_server/
+# init_worker/run_server/stop_worker) — backed by the PS tier
+# (paddle_tpu.distributed.ps, reference ps/service/brpc_ps_*).
+_PS_CTX = [None]
+
+
+def _ps_context():
+    if _PS_CTX[0] is None:
+        from ..distributed.ps import PsContext
+        _PS_CTX[0] = PsContext()
+    return _PS_CTX[0]
+
+
+def init_server(host="127.0.0.1", port=0, **kw):
+    return _ps_context().init_server(host, port)
 
 
 def init_worker(*a, **kw):
-    raise NotImplementedError("parameter-server mode: planned (CTR tier, round 2+)")
+    return _ps_context().init_worker()
 
 
-def run_server():
-    raise NotImplementedError("parameter-server mode: planned (CTR tier, round 2+)")
+def run_server(block=True):
+    return _ps_context().run_server(block=block)
 
 
 def stop_worker():
-    pass
+    ctx = _PS_CTX[0]
+    if ctx is not None:
+        ctx.stop_worker()
 
 
 def barrier_worker():
     from .collective import barrier
     barrier()
+
+
+class _FleetUtils:
+    """fleet.utils namespace (reference fleet/utils/: recompute etc.)."""
+
+    @staticmethod
+    def recompute(function, *args, **kwargs):
+        from .meta_optimizers import recompute as _rc
+        return _rc(function, *args, **kwargs)
+
+
+utils = _FleetUtils()
 
 
 def save_inference_model(*a, **kw):

@@ -1,0 +1,185 @@
+"""Meta-optimizers: recompute, gradient merge, LocalSGD.
+
+Reference parity: `fleet/meta_optimizers/recompute_optimizer.py` (+
+`fleet/utils/recompute` dygraph API), `gradient_merge_optimizer.py`
+(accumulate k micro-steps then apply), `localsgd_optimizer.py` (local
+steps + periodic parameter averaging). The reference implements these as
+program rewrites; here they wrap the imperative tape/optimizer directly.
+
+TPU-native recompute: the forward runs WITHOUT storing residuals (no tape
+nodes inside); ONE tape node is recorded whose vjp re-runs the forward
+under `jax.vjp` at backward time — activation memory traded for FLOPs,
+the `jax.checkpoint` policy expressed at tape level (and `jax.checkpoint`
+itself is applied when tracing inside jit).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..jit.functional import functional_call, split_state
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """fleet.utils.recompute parity: run `function` (a Layer or callable)
+    without storing intermediate activations; recompute them in backward.
+
+    Gradients flow to tensor args AND, when `function` is a Layer, to its
+    parameters (functional substitution)."""
+    from ..nn.layer.layers import Layer
+
+    arg_tensors = [a for a in args if isinstance(a, Tensor)]
+    if isinstance(function, Layer):
+        trainable, frozen = split_state(function)
+        pnames, bnames = list(trainable), list(frozen)
+        ptensors = [trainable[n] for n in pnames]
+        btensors = [frozen[n] for n in bnames]
+        diff_inputs = arg_tensors + [p for p in ptensors if not p.stop_gradient]
+
+        def pure(*arrs):
+            n_args = len(arg_tensors)
+            it = iter(arrs[:n_args])
+            rebuilt = [Tensor(next(it)) if isinstance(a, Tensor) else a
+                       for a in args]
+            pvals = list(arrs[n_args:])
+            # frozen/stop-gradient params enter as constants
+            full = []
+            k = 0
+            for p in ptensors:
+                if p.stop_gradient:
+                    full.append(p._value)
+                else:
+                    full.append(pvals[k])
+                    k += 1
+            out = functional_call(function, pnames, full, bnames,
+                                  [b._value for b in btensors],
+                                  *rebuilt, **kwargs)
+            return out._value if isinstance(out, Tensor) else out
+    else:
+        diff_inputs = arg_tensors
+
+        def pure(*arrs):
+            it = iter(arrs)
+            rebuilt = [Tensor(next(it)) if isinstance(a, Tensor) else a
+                       for a in args]
+            out = function(*rebuilt, **kwargs)
+            return out._value if isinstance(out, Tensor) else out
+
+    arrays = tuple(t._value for t in diff_inputs)
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        # already inside a jit trace: jax.checkpoint IS the recompute
+        return Tensor(jax.checkpoint(pure)(*arrays))
+
+    with autograd.no_grad():
+        out_val = pure(*arrays)  # forward only: no residuals retained
+    out = Tensor(out_val)
+    if autograd.is_grad_enabled() and diff_inputs:
+
+        def lazy_vjp(g):
+            g = g._value if hasattr(g, "_value") else g
+            _, vjp_fn = jax.vjp(pure, *arrays)  # re-run forward NOW
+            return vjp_fn(g)
+
+        autograd.record_node(lazy_vjp, diff_inputs, [out], "recompute")
+    return out
+
+
+class GradientMergeOptimizer:
+    """Accumulate gradients for k_steps micro-steps, then apply ONE inner
+    optimizer step with the (averaged) merged grads
+    (gradient_merge_optimizer.py / GradientMergeOptimizer)."""
+
+    def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._acc = {}  # id(param) -> (param, accumulated grad)
+        self._micro = 0
+
+    def __getattr__(self, name):
+        # delegate the rest of the optimizer API (state_dict, set_lr, ...)
+        if name == "inner_optimizer":
+            raise AttributeError(name)  # guard pre-__init__ recursion
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        from ..core.selected_rows import SelectedRows
+        params = [p for p in (self.inner_optimizer._parameter_list or [])
+                  if not p.stop_gradient and p.grad is not None]
+        for p in params:
+            g = p.grad._value if isinstance(p.grad, Tensor) else p.grad
+            if isinstance(g, SelectedRows):
+                g = g.to_dense()
+            cur = self._acc.get(id(p))
+            self._acc[id(p)] = (p, g if cur is None else cur[1] + g)
+        self._micro += 1
+        if self._micro < self.k_steps:
+            # merge-only step: inner optimizer must NOT run
+            for p in params:
+                p.grad = None
+            return
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        # write back over EVERY accumulated param — including ones with no
+        # grad on this final micro-step (conditional branches, unused params)
+        for p, acc in self._acc.values():
+            p.grad = acc * scale
+        self.inner_optimizer.step()
+        self._acc.clear()
+        self._micro = 0
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, *a, **kw):
+        self.inner_optimizer.clear_grad(*a, **kw)
+
+
+class LocalSGDOptimizer:
+    """Local steps + periodic parameter averaging across the data-parallel
+    group (localsgd_optimizer.py): every k_steps, params := mean over
+    replicas. The averaging collective is injectable; by default it uses
+    the eager collective all_reduce when a process group is initialized
+    and is a no-op single-process."""
+
+    def __init__(self, inner_optimizer, k_steps: int = 4,
+                 allreduce_mean: Optional[Callable] = None):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self._steps = 0
+        self._allreduce_mean = allreduce_mean
+
+    def __getattr__(self, name):
+        if name == "inner_optimizer":
+            raise AttributeError(name)  # guard pre-__init__ recursion
+        return getattr(self.inner_optimizer, name)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def _default_mean(self, arr):
+        from . import collective
+        from .env import get_world_size
+        if get_world_size() <= 1:
+            return arr
+        t = Tensor(arr)
+        collective.all_reduce(t)
+        return t._value / get_world_size()
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k_steps == 0:
+            mean = self._allreduce_mean or self._default_mean
+            for p in (self.inner_optimizer._parameter_list or []):
+                p._value = jnp.asarray(mean(p._value))
+
+    def clear_grad(self, *a, **kw):
+        self.inner_optimizer.clear_grad(*a, **kw)

@@ -43,6 +43,7 @@ class DistributedStrategy:
         self.lamb = False
         self.lars = False
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 4}
         self.a_sync = False
         self.heter_ccl_mode = False
         self.find_unused_parameters = False

@@ -57,6 +57,23 @@ class Executor:
         outs = out if isinstance(out, (list, tuple)) else [out]
         return [_np.asarray(getattr(o, "_value", o)) for o in outs]
 
+    def train_from_dataset(self, program, dataset, fetch_list=None,
+                           print_period=100, debug=False):
+        """Dataset-driven training loop (reference `executor.py:1731`
+        `_run_from_dataset` -> C++ Trainer/DeviceWorker TrainFiles hot
+        loop, SURVEY §3.5). `program` is a callable taking the batch dict
+        {slot: array} and returning the loss; the loop host-side feeds
+        batches exactly like MultiTrainer+HogwildWorker."""
+        import numpy as _np
+        losses = []
+        for i, batch in enumerate(dataset):
+            loss = program(batch)
+            losses.append(float(getattr(loss, "_value", loss)))
+            if debug and print_period and (i + 1) % print_period == 0:
+                print(f"[train_from_dataset] batch {i + 1} "
+                      f"loss {losses[-1]:.6f}")
+        return losses
+
 
 def default_startup_program():
     """Functional init: parameters are initialized at construction, so the

@@ -1,0 +1,218 @@
+"""Meta-optimizers: recompute (tape-level remat), gradient merge, LocalSGD,
+fleet strategy wiring, fleet PS surface."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel.meta_optimizers import (GradientMergeOptimizer,
+                                                 LocalSGDOptimizer, recompute)
+
+
+class Block(nn.Layer):
+    def __init__(self, dim=8):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, dim)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(dim, dim)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def _r(*shape):
+    return np.random.default_rng(0).normal(size=shape).astype(np.float32)
+
+
+class TestRecompute:
+    def test_grads_match_plain_forward(self):
+        x = _r(4, 8)
+
+        def run(use_rc):
+            paddle.seed(0)
+            blk = Block()
+            xt = paddle.to_tensor(x)
+            xt.stop_gradient = False
+            out = recompute(blk, xt) if use_rc else blk(xt)
+            (out ** 2).sum().backward()
+            g = [np.asarray(p.grad._value if hasattr(p.grad, "_value")
+                            else p.grad) for p in blk.parameters()]
+            xg = xt.grad
+            return g, np.asarray(xg._value if hasattr(xg, "_value") else xg)
+
+        g_rc, xg_rc = run(True)
+        g_pl, xg_pl = run(False)
+        np.testing.assert_allclose(xg_rc, xg_pl, rtol=1e-5, atol=1e-7)
+        for a, b in zip(g_rc, g_pl):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+    def test_no_tape_nodes_stored_for_inner_ops(self):
+        # the point of remat: forward must leave exactly ONE node (the
+        # recompute node), not one per inner op
+        from paddle_tpu.core import autograd
+        autograd.clear_tape()
+        blk = Block()
+        xt = paddle.to_tensor(_r(2, 8))
+        xt.stop_gradient = False
+        out = recompute(blk, xt)
+        assert len(autograd._STATE.live) == 1
+        assert out._node is not None and out._node.name == "recompute"
+
+    def test_training_with_recompute_descends(self):
+        paddle.seed(0)
+        blk = Block()
+        head = nn.Linear(8, 2)
+        params = list(blk.parameters()) + list(head.parameters())
+        opt = paddle.optimizer.Adam(parameters=params, learning_rate=1e-2)
+        ce = nn.CrossEntropyLoss()
+        x = _r(32, 8)
+        y = (x.sum(1) > 0).astype(np.int64)
+        losses = []
+        for _ in range(25):
+            h = recompute(blk, paddle.to_tensor(x))
+            loss = ce(head(h), paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_plain_callable_args_only(self):
+        xt = paddle.to_tensor(_r(3, 3))
+        xt.stop_gradient = False
+        out = recompute(lambda a: (a * a).sum(), xt)
+        out.backward()
+        g = xt.grad
+        np.testing.assert_allclose(
+            np.asarray(g._value if hasattr(g, "_value") else g),
+            2 * np.asarray(xt._value), rtol=1e-6)
+
+
+class TestGradientMerge:
+    def test_k_steps_equals_large_batch(self):
+        # k merged micro-steps with avg == one step on the mean gradient
+        x = _r(8, 8)
+        y = (x.sum(1) > 0).astype(np.int64)
+
+        def run(merged):
+            paddle.seed(0)
+            net = nn.Linear(8, 2)
+            inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                         learning_rate=0.1)
+            ce = nn.CrossEntropyLoss()
+            if merged:
+                opt = GradientMergeOptimizer(inner, k_steps=4, avg=True)
+                for i in range(4):
+                    loss = ce(net(paddle.to_tensor(x[i*2:(i+1)*2])),
+                              paddle.to_tensor(y[i*2:(i+1)*2]))
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+            else:
+                # one step over the full batch = mean of micro grads
+                loss = ce(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+                loss.backward()
+                inner.step()
+            return np.asarray(net.weight._value)
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-7)
+
+    def test_param_missing_on_final_microstep_still_applied(self):
+        # param B gets a grad only on micro-step 1 of 2; its accumulated
+        # grad must still be applied at the merge step
+        paddle.seed(0)
+        a, b = nn.Linear(4, 4), nn.Linear(4, 4)
+        inner = paddle.optimizer.SGD(
+            parameters=list(a.parameters()) + list(b.parameters()),
+            learning_rate=0.1)
+        opt = GradientMergeOptimizer(inner, k_steps=2, avg=False)
+        wb0 = np.asarray(b.weight._value).copy()
+        x = paddle.to_tensor(_r(2, 4))
+        (b(a(x)) ** 2).sum().backward()   # micro 1: touches a AND b
+        opt.step(); opt.clear_grad()
+        (a(x) ** 2).sum().backward()      # micro 2: touches only a
+        opt.step(); opt.clear_grad()
+        assert np.abs(np.asarray(b.weight._value) - wb0).max() > 1e-7
+
+    def test_wrapper_delegates_full_optimizer_api(self):
+        net = nn.Linear(4, 2)
+        inner = paddle.optimizer.Adam(parameters=net.parameters(),
+                                      learning_rate=0.1)
+        opt = GradientMergeOptimizer(inner, k_steps=2)
+        sd = opt.state_dict()          # delegated via __getattr__
+        assert isinstance(sd, dict)
+        opt.set_lr(0.05)
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_inner_untouched_before_k(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.1)
+        opt = GradientMergeOptimizer(inner, k_steps=3)
+        w0 = np.asarray(net.weight._value).copy()
+        for _ in range(2):
+            (net(paddle.to_tensor(_r(2, 4))) ** 2).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_array_equal(np.asarray(net.weight._value), w0)
+
+
+class TestLocalSGD:
+    def test_periodic_averaging_with_injected_comm(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.1)
+        calls = []
+
+        def fake_mean(arr):
+            calls.append(arr.shape)
+            return arr * 0.5  # visible transform to prove it was applied
+
+        opt = LocalSGDOptimizer(inner, k_steps=2, allreduce_mean=fake_mean)
+        for i in range(4):
+            (net(paddle.to_tensor(_r(2, 4))) ** 2).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        # averaging ran at steps 2 and 4, over both params each time
+        assert len(calls) == 4
+        assert float(np.abs(np.asarray(net.weight._value)).max()) < 1.0
+
+
+class TestFleetWiring:
+    def test_strategy_toggles_wrap_optimizer(self):
+        from paddle_tpu.parallel import fleet, strategy
+        st = strategy.DistributedStrategy()
+        st.gradient_merge = True
+        st.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        st.localsgd = True
+        net = nn.Linear(4, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.1)
+        fleet.init(is_collective=True, strategy=st)
+        opt = fleet.distributed_optimizer(inner, strategy=st)
+        assert isinstance(opt, LocalSGDOptimizer)
+        assert isinstance(opt.inner_optimizer, GradientMergeOptimizer)
+
+    def test_fleet_utils_recompute(self):
+        from paddle_tpu.parallel import fleet
+        blk = Block()
+        out = fleet.utils.recompute(blk, paddle.to_tensor(_r(2, 8)))
+        assert out.shape == [2, 8]
+
+    def test_fleet_ps_surface(self):
+        import os
+        from paddle_tpu.parallel import fleet
+        srv = fleet.init_server()
+        srv.add_sparse_table("emb", dim=4)
+        fleet.run_server(block=False)
+        os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = f"{srv.host}:{srv.port}"
+        fleet._PS_CTX[0].server_endpoints = [f"{srv.host}:{srv.port}"]
+        client = fleet.init_worker()
+        client.register_sparse_dim("emb", 4)
+        rows = client.pull_sparse("emb", [1, 2])
+        assert rows.shape == (2, 4)
+        fleet.stop_worker()
+        srv.stop()
+        fleet._PS_CTX[0] = None

@@ -51,6 +51,20 @@ class TestSparseEmbeddingGrad:
         np.testing.assert_allclose(np.asarray(g.to_dense()),
                                    np.asarray(dense_g), rtol=1e-6)
 
+    def test_sparse_grad_through_nonleaf_weight_densifies(self):
+        # weight is computed (w * scale): the SelectedRows cotangent must
+        # densify at the boundary and flow through the multiply's vjp
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        w = paddle.to_tensor(np.random.rand(6, 3).astype(np.float32))
+        w.stop_gradient = False
+        w2 = w * 2.0  # non-leaf
+        out = F.embedding(paddle.to_tensor(np.array([1, 4])), w2, sparse=True)
+        (out ** 2).sum().backward()
+        g = np.asarray(w.grad._value if hasattr(w.grad, "_value") else w.grad)
+        assert g.shape == (6, 3)
+        assert (g[1] != 0).any() and (g[0] == 0).all()
+
     def test_padding_idx_rows_get_zero_grad(self):
         paddle.seed(0)
         emb = nn.Embedding(10, 4, padding_idx=0, sparse=True)
