@@ -1,0 +1,187 @@
+// C inference API — client for the predictor service.
+//
+// Reference parity: paddle/fluid/inference/capi_exp/ (PD_Predictor*,
+// PD_Tensor* stable C ABI for C/Go apps). The reference links the whole
+// C++ predictor into the app; the TPU runtime is host-served (XLA/PJRT
+// lives with the chips), so the stable ABI here is a thin binary-protocol
+// client to a predictor server process (paddle_tpu.inference.server) —
+// same role: C/Go programs run TPU inference with no Python dependency.
+//
+// Wire protocol (little-endian):
+//   request:  u32 magic 'PDRQ', u32 n_tensors,
+//             per tensor: u32 dtype(0=f32,1=i32,2=i64), u32 ndim,
+//                         i64 dims[ndim], payload bytes
+//   response: u32 magic 'PDRS', u8 status,
+//             status==0: u32 n_tensors + tensors (same encoding)
+//             status!=0: u32 len + utf-8 error message
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kReqMagic = 0x50445251;   // 'PDRQ'
+constexpr uint32_t kRespMagic = 0x50445253;  // 'PDRS'
+constexpr int kMaxNdim = 8;
+
+size_t dtype_size(int dt) { return dt == 0 ? 4 : dt == 1 ? 4 : 8; }
+
+bool send_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool recv_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef struct PD_Tensor {
+  int32_t dtype;  // 0=float32, 1=int32, 2=int64
+  int32_t ndim;
+  int64_t dims[kMaxNdim];
+  void* data;  // owned by the library for outputs (PD_TensorsDestroy)
+} PD_Tensor;
+
+typedef struct PD_Predictor {
+  int fd;
+  std::string last_error;
+} PD_Predictor;
+
+PD_Predictor* PD_PredictorCreate(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return new PD_Predictor{fd, std::string()};
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (p == nullptr) return;
+  ::close(p->fd);
+  delete p;
+}
+
+const char* PD_GetLastError(PD_Predictor* p) {
+  return p != nullptr ? p->last_error.c_str() : "null predictor";
+}
+
+// Returns 0 on success; fills *outputs (malloc'd array of n) + *n_out.
+int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
+                    PD_Tensor** outputs, int* n_out) {
+  if (p == nullptr || inputs == nullptr || outputs == nullptr ||
+      n_out == nullptr || n_in <= 0)
+    return 1;
+  *outputs = nullptr;
+  *n_out = 0;
+  uint32_t hdr[2] = {kReqMagic, static_cast<uint32_t>(n_in)};
+  if (!send_exact(p->fd, hdr, sizeof(hdr))) {
+    p->last_error = "send failed (header)";
+    return 2;
+  }
+  for (int i = 0; i < n_in; ++i) {
+    const PD_Tensor& t = inputs[i];
+    if (t.ndim < 0 || t.ndim > kMaxNdim) {
+      p->last_error = "tensor ndim out of range";
+      return 1;
+    }
+    uint32_t meta[2] = {static_cast<uint32_t>(t.dtype),
+                        static_cast<uint32_t>(t.ndim)};
+    size_t count = 1;
+    for (int d = 0; d < t.ndim; ++d) count *= static_cast<size_t>(t.dims[d]);
+    if (!send_exact(p->fd, meta, sizeof(meta)) ||
+        !send_exact(p->fd, t.dims, sizeof(int64_t) * t.ndim) ||
+        !send_exact(p->fd, t.data, count * dtype_size(t.dtype))) {
+      p->last_error = "send failed (tensor)";
+      return 2;
+    }
+  }
+  uint32_t magic = 0;
+  uint8_t status = 0;
+  if (!recv_exact(p->fd, &magic, 4) || magic != kRespMagic ||
+      !recv_exact(p->fd, &status, 1)) {
+    p->last_error = "bad response header";
+    return 2;
+  }
+  if (status != 0) {
+    uint32_t len = 0;
+    if (!recv_exact(p->fd, &len, 4)) return 2;
+    std::vector<char> msg(len);
+    if (!recv_exact(p->fd, msg.data(), len)) return 2;
+    p->last_error.assign(msg.data(), len);
+    return 3;  // server-side error (message in PD_GetLastError)
+  }
+  uint32_t n = 0;
+  if (!recv_exact(p->fd, &n, 4)) return 2;
+  PD_Tensor* outs =
+      static_cast<PD_Tensor*>(std::calloc(n, sizeof(PD_Tensor)));
+  // one cleanup path frees every buffer received so far (calloc zeroed
+  // data pointers, so free(nullptr) is safe for the rest)
+  auto fail = [&](const char* msg) {
+    for (uint32_t j = 0; j < n; ++j) std::free(outs[j].data);
+    std::free(outs);
+    p->last_error = msg;
+    return 2;
+  };
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t meta[2];
+    if (!recv_exact(p->fd, meta, sizeof(meta)) ||
+        meta[1] > static_cast<uint32_t>(kMaxNdim))
+      return fail("bad output tensor header");
+    outs[i].dtype = static_cast<int32_t>(meta[0]);
+    outs[i].ndim = static_cast<int32_t>(meta[1]);
+    size_t count = 1;
+    if (!recv_exact(p->fd, outs[i].dims, sizeof(int64_t) * outs[i].ndim))
+      return fail("short read (output dims)");
+    for (int d = 0; d < outs[i].ndim; ++d)
+      count *= static_cast<size_t>(outs[i].dims[d]);
+    size_t nbytes = count * dtype_size(outs[i].dtype);
+    outs[i].data = std::malloc(nbytes);
+    if (!recv_exact(p->fd, outs[i].data, nbytes))
+      return fail("short read (output payload)");
+  }
+  *outputs = outs;
+  *n_out = static_cast<int>(n);
+  return 0;
+}
+
+void PD_TensorsDestroy(PD_Tensor* ts, int n) {
+  if (ts == nullptr) return;
+  for (int i = 0; i < n; ++i) std::free(ts[i].data);
+  std::free(ts);
+}
+
+}  // extern "C"

@@ -27,7 +27,8 @@ _lock = threading.Lock()
 
 
 def _sources():
-    return [os.path.join(_CSRC, f) for f in ("tcpstore.cpp", "runtime.cpp")]
+    return [os.path.join(_CSRC, f)
+            for f in ("tcpstore.cpp", "runtime.cpp", "predict_capi.cpp")]
 
 
 def _src_hash() -> str:

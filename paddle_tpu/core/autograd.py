@@ -143,14 +143,19 @@ def _collect(roots):
 
 
 def _accumulate(store: dict, tensor, value):
-    from .selected_rows import SelectedRows
-    if isinstance(value, SelectedRows):
-        # non-leaf consumer: upstream vjps are jnp programs that can't eat
-        # a SelectedRows — densify at the sparse/dense boundary
-        value = value.to_dense()
+    # SelectedRows values accumulate row-form (SelectedRows.__add__ handles
+    # sparse+sparse concat and sparse+dense densify); conversion to dense
+    # happens only when a cotangent is CONSUMED by an upstream jnp vjp
+    # (_dense_cot) — paddle.grad on a sparse leaf stays sparse.
     key = id(tensor)
     cur = store.get(key)
     store[key] = value if cur is None else cur + value
+
+
+def _dense_cot(c):
+    """Cotangent about to enter a jnp-based vjp: densify SelectedRows."""
+    from .selected_rows import SelectedRows
+    return c.to_dense() if isinstance(c, SelectedRows) else c
 
 
 def backward(root, grad=None, retain_graph: bool = False):
@@ -184,7 +189,7 @@ def backward(root, grad=None, retain_graph: bool = False):
                     c = jnp.zeros_like(t._value)
                 else:
                     any_live = True
-                out_cots.append(c)
+                out_cots.append(_dense_cot(c))
             if not any_live:
                 continue
             in_cots = node.vjp_fn(tuple(out_cots) if len(out_cots) > 1 else out_cots[0])
@@ -254,7 +259,7 @@ def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph
                     c = jnp.zeros_like(t._value)
                 else:
                     any_live = True
-                out_cots.append(c)
+                out_cots.append(_dense_cot(c))
             if not any_live:
                 continue
             in_cots = node.vjp_fn(tuple(out_cots) if len(out_cots) > 1 else out_cots[0])
@@ -297,7 +302,7 @@ def _grad_create_graph(outs, ins, grad_outputs, allow_unused, ordered):
                 c = Tensor(jnp.zeros_like(t._value))
             else:
                 any_live = True
-            out_cots.append(c)
+            out_cots.append(_dense_cot(c))
         if not any_live:
             continue
         if node.fn is None:

@@ -43,7 +43,20 @@ class PsContext:
     def run_server(self, block=False):
         return self.server.run(block=block)
 
-    def init_worker(self) -> PsClient:
+    def init_worker(self, endpoints=None) -> PsClient:
+        # re-read the env each time: the documented flow sets
+        # PADDLE_PSERVERS_IP_PORT_LIST AFTER the server binds its port
+        if endpoints is not None:
+            self.server_endpoints = list(endpoints)
+        else:
+            eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            parsed = [e for e in eps.split(",") if e]
+            if parsed:
+                self.server_endpoints = parsed
+        if not self.server_endpoints:
+            raise RuntimeError(
+                "init_worker: no PS endpoints — set "
+                "PADDLE_PSERVERS_IP_PORT_LIST or pass endpoints=")
         self.client = PsClient(self.server_endpoints)
         self.communicator = Communicator(self.client)
         return self.client

@@ -43,14 +43,7 @@ class PsError(RuntimeError):
     """Server-reported request failure (carried in an error frame)."""
 
 
-def _recv_exact(sock, n):
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("ps: peer closed")
-        buf.extend(chunk)
-    return bytes(buf)
+from ...utils.net import recv_exact as _recv_exact  # noqa: E402
 
 
 def _tname(name: str) -> bytes:

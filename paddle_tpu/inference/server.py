@@ -1,0 +1,139 @@
+"""Predictor service — the server side of the C inference API.
+
+Reference parity: the deployment role of `inference/capi_exp/` +
+`goapi/`: C/Go apps run inference against a stable ABI. Here the ABI is a
+binary tensor protocol (see csrc/predict_capi.cpp) served by the process
+that owns the TPU runtime; each connection gets a handler thread and runs
+the shared Predictor (Predictor.clone()-style multi-threaded serving,
+`analysis_predictor.cc` Clone).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REQ_MAGIC = 0x50445251
+_RESP_MAGIC = 0x50445253
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
+                np.dtype(np.int64): 2}
+_MAX_NDIM = 8
+_MAX_TENSOR_BYTES = 1 << 32  # sanity cap against corrupt headers
+
+from ..utils.net import recv_exact as _recv_exact  # noqa: E402
+
+
+def _read_tensor(conn) -> np.ndarray:
+    dt, ndim = struct.unpack("<II", _recv_exact(conn, 8))
+    if dt not in _DTYPES or ndim > _MAX_NDIM:
+        raise ValueError(f"bad tensor header dtype={dt} ndim={ndim}")
+    dims = struct.unpack(f"<{ndim}q", _recv_exact(conn, 8 * ndim))
+    dtype = _DTYPES[dt]
+    if any(d < 0 for d in dims):
+        raise ValueError(f"bad tensor dims {dims}")
+    count = int(np.prod(dims)) if dims else 1
+    nbytes = count * dtype().itemsize
+    if nbytes > _MAX_TENSOR_BYTES:
+        raise ValueError(f"tensor payload {nbytes} bytes exceeds cap")
+    payload = _recv_exact(conn, nbytes)
+    return np.frombuffer(payload, dtype).reshape(dims).copy()
+
+
+def _write_tensor(conn, arr: np.ndarray):
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _DTYPE_CODES:
+        arr = arr.astype(np.float32)
+    conn.sendall(struct.pack("<II", _DTYPE_CODES[arr.dtype], arr.ndim)
+                 + struct.pack(f"<{arr.ndim}q", *arr.shape)
+                 + arr.tobytes())
+
+
+class PredictorServer:
+    """Serve a Predictor (or any callable of numpy arrays) over the C-API
+    wire protocol."""
+
+    def __init__(self, predictor, host="127.0.0.1", port=0):
+        self.predictor = predictor
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # predictor state is shared
+
+    def start(self):
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _run(self, inputs):
+        from . import Predictor
+        if isinstance(self.predictor, Predictor):
+            with self._lock:
+                names = self.predictor.get_input_names()
+                if len(inputs) != len(names):
+                    raise ValueError(
+                        f"model expects {len(names)} inputs, got {len(inputs)}")
+                for name, arr in zip(names, inputs):
+                    self.predictor.get_input_handle(name).copy_from_cpu(arr)
+                self.predictor.run()
+                return [self.predictor.get_output_handle(n).copy_to_cpu()
+                        for n in self.predictor.get_output_names()]
+        outs = self.predictor(*inputs)
+        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+    def _handle(self, conn):
+        try:
+            while True:
+                magic, n = struct.unpack("<II", _recv_exact(conn, 8))
+                if magic != _REQ_MAGIC:
+                    return  # protocol violation: drop the connection
+                try:
+                    inputs = [_read_tensor(conn) for _ in range(n)]
+                except ValueError as e:
+                    # header was bad: stream unrecoverable, report + close
+                    msg = str(e).encode()
+                    conn.sendall(struct.pack("<IB", _RESP_MAGIC, 1)
+                                 + struct.pack("<I", len(msg)) + msg)
+                    return
+                try:
+                    outs = self._run(inputs)
+                except Exception as e:  # surface model errors to the C app
+                    msg = str(e).encode()
+                    conn.sendall(struct.pack("<IB", _RESP_MAGIC, 1)
+                                 + struct.pack("<I", len(msg)) + msg)
+                    continue
+                conn.sendall(struct.pack("<IBI", _RESP_MAGIC, 0, len(outs)))
+                for o in outs:
+                    _write_tensor(conn, np.asarray(o))
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -125,8 +125,8 @@ def init_server(host="127.0.0.1", port=0, **kw):
     return _ps_context().init_server(host, port)
 
 
-def init_worker(*a, **kw):
-    return _ps_context().init_worker()
+def init_worker(endpoints=None, **kw):
+    return _ps_context().init_worker(endpoints=endpoints)
 
 
 def run_server(block=True):

@@ -74,6 +74,9 @@ def recompute(function: Callable, *args, **kwargs):
         # already inside a jit trace: jax.checkpoint IS the recompute
         return Tensor(jax.checkpoint(pure)(*arrays))
 
+    from ..core import random as rnd
+    rng_before = rnd.get_rng_state()  # preserve_rng_state (reference
+    # recompute replays the SAME dropout masks in the backward re-run)
     with autograd.no_grad():
         out_val = pure(*arrays)  # forward only: no residuals retained
     out = Tensor(out_val)
@@ -81,7 +84,12 @@ def recompute(function: Callable, *args, **kwargs):
 
         def lazy_vjp(g):
             g = g._value if hasattr(g, "_value") else g
-            _, vjp_fn = jax.vjp(pure, *arrays)  # re-run forward NOW
+            cur = rnd.get_rng_state()
+            rnd.set_rng_state(rng_before)
+            try:
+                _, vjp_fn = jax.vjp(pure, *arrays)  # re-run forward NOW
+            finally:
+                rnd.set_rng_state(cur)  # leave surrounding RNG untouched
             return vjp_fn(g)
 
         autograd.record_node(lazy_vjp, diff_inputs, [out], "recompute")

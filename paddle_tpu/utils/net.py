@@ -1,0 +1,15 @@
+"""Shared socket framing helpers (used by the PS RPC plane and the
+inference C-API server — one implementation of exact-read)."""
+from __future__ import annotations
+
+
+def recv_exact(sock, n: int) -> bytes:
+    if n < 0:
+        raise ValueError(f"recv_exact: negative length {n}")
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)

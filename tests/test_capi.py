@@ -1,0 +1,137 @@
+"""C inference API: ctypes drives the compiled C client (as a C app would)
+against the PredictorServer. Reference: inference/capi_exp/ ABI."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import _native
+from paddle_tpu.inference.server import PredictorServer
+
+
+class PD_Tensor(ctypes.Structure):
+    _fields_ = [("dtype", ctypes.c_int32), ("ndim", ctypes.c_int32),
+                ("dims", ctypes.c_int64 * 8), ("data", ctypes.c_void_p)]
+
+
+@pytest.fixture(scope="module")
+def capi():
+    lib = _native._load()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    lib.PD_PredictorCreate.restype = ctypes.c_void_p
+    lib.PD_PredictorCreate.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
+    lib.PD_PredictorRun.restype = ctypes.c_int
+    lib.PD_PredictorRun.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(PD_Tensor), ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(PD_Tensor)),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.PD_TensorsDestroy.argtypes = [ctypes.POINTER(PD_Tensor), ctypes.c_int]
+    lib.PD_GetLastError.restype = ctypes.c_char_p
+    lib.PD_GetLastError.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def make_tensor(arr):
+    arr = np.ascontiguousarray(arr)
+    t = PD_Tensor()
+    t.dtype = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
+               np.dtype(np.int64): 2}[arr.dtype]
+    t.ndim = arr.ndim
+    for i, d in enumerate(arr.shape):
+        t.dims[i] = d
+    t.data = arr.ctypes.data_as(ctypes.c_void_p)
+    return t, arr  # keep arr alive
+
+
+@pytest.fixture()
+def lenet_server(tmp_path):
+    from paddle_tpu import models
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit import InputSpec, save
+    paddle.seed(0)
+    net = models.LeNet(num_classes=10)
+    net.eval()
+    path = str(tmp_path / "lenet")
+    save(net, path, input_spec=[InputSpec([2, 1, 28, 28], "float32")])
+    pred = create_predictor(Config(path))
+    srv = PredictorServer(pred).start()
+    yield srv, pred
+    srv.stop()
+
+
+class TestCAPI:
+    def test_run_matches_direct_predictor(self, capi, lenet_server):
+        srv, pred = lenet_server
+        x = np.random.default_rng(0).random((2, 1, 28, 28)).astype(np.float32)
+        h = capi.PD_PredictorCreate(b"127.0.0.1", srv.port)
+        assert h
+        tin, keep = make_tensor(x)
+        outs = ctypes.POINTER(PD_Tensor)()
+        n_out = ctypes.c_int()
+        rc = capi.PD_PredictorRun(h, ctypes.byref(tin), 1,
+                                  ctypes.byref(outs), ctypes.byref(n_out))
+        assert rc == 0, capi.PD_GetLastError(h)
+        assert n_out.value == 1
+        o = outs[0]
+        shape = [o.dims[i] for i in range(o.ndim)]
+        assert shape == [2, 10]
+        got = np.ctypeslib.as_array(
+            ctypes.cast(o.data, ctypes.POINTER(ctypes.c_float)),
+            shape=tuple(shape)).copy()
+        # oracle: run the same predictor directly
+        iname = pred.get_input_names()[0]
+        pred.get_input_handle(iname).copy_from_cpu(x)
+        pred.run()
+        want = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        capi.PD_TensorsDestroy(outs, n_out.value)
+        capi.PD_PredictorDestroy(h)
+
+    def test_server_error_surfaces_to_c(self, capi, lenet_server):
+        srv, _ = lenet_server
+        h = capi.PD_PredictorCreate(b"127.0.0.1", srv.port)
+        x = np.zeros((2, 2), np.float32)
+        t1, k1 = make_tensor(x)
+        t2, k2 = make_tensor(x)
+        tins = (PD_Tensor * 2)(t1, t2)  # model expects 1 input, send 2
+        outs = ctypes.POINTER(PD_Tensor)()
+        n_out = ctypes.c_int()
+        rc = capi.PD_PredictorRun(h, tins, 2, ctypes.byref(outs),
+                                  ctypes.byref(n_out))
+        assert rc == 3  # server-side error
+        assert b"inputs" in capi.PD_GetLastError(h)
+        # connection stays usable after a model-level error
+        x_ok = np.zeros((2, 1, 28, 28), np.float32)
+        t3, k3 = make_tensor(x_ok)
+        rc2 = capi.PD_PredictorRun(h, ctypes.byref(t3), 1,
+                                   ctypes.byref(outs), ctypes.byref(n_out))
+        assert rc2 == 0, capi.PD_GetLastError(h)
+        capi.PD_TensorsDestroy(outs, n_out.value)
+        capi.PD_PredictorDestroy(h)
+
+    def test_connect_failure_returns_null(self, capi):
+        h = capi.PD_PredictorCreate(b"127.0.0.1", 1)  # nothing listens
+        assert not h
+
+    def test_serve_plain_callable(self, capi):
+        srv = PredictorServer(lambda a: a * 2.0).start()
+        h = capi.PD_PredictorCreate(b"127.0.0.1", srv.port)
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        tin, keep = make_tensor(x)
+        outs = ctypes.POINTER(PD_Tensor)()
+        n_out = ctypes.c_int()
+        rc = capi.PD_PredictorRun(h, ctypes.byref(tin), 1,
+                                  ctypes.byref(outs), ctypes.byref(n_out))
+        assert rc == 0
+        got = np.ctypeslib.as_array(
+            ctypes.cast(outs[0].data, ctypes.POINTER(ctypes.c_float)),
+            shape=(2, 3)).copy()
+        np.testing.assert_allclose(got, x * 2.0)
+        capi.PD_TensorsDestroy(outs, n_out.value)
+        capi.PD_PredictorDestroy(h)
+        srv.stop()

@@ -77,6 +77,23 @@ class TestRecompute:
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.5
 
+    def test_dropout_mask_replayed_in_backward(self):
+        # preserve_rng_state: the backward re-run must draw the SAME
+        # dropout mask the forward used. For x=1, out = mask/(1-p) and
+        # d(out)/dx = mask/(1-p), so x.grad must equal out exactly.
+        paddle.seed(123)
+        drop = nn.Dropout(p=0.5)
+        drop.train()
+        xt = paddle.to_tensor(np.ones((64,), np.float32))
+        xt.stop_gradient = False
+        out = recompute(drop, xt)
+        out_v = np.asarray(out._value).copy()
+        assert 0 < (out_v != 0).sum() < 64  # mask is non-trivial
+        out.sum().backward()
+        g = np.asarray(xt.grad._value if hasattr(xt.grad, "_value")
+                       else xt.grad)
+        np.testing.assert_array_equal(g, out_v)
+
     def test_plain_callable_args_only(self):
         xt = paddle.to_tensor(_r(3, 3))
         xt.stop_gradient = False
@@ -207,8 +224,9 @@ class TestFleetWiring:
         srv = fleet.init_server()
         srv.add_sparse_table("emb", dim=4)
         fleet.run_server(block=False)
+        # public flow: env var set AFTER the server binds; init_worker
+        # must pick it up (no private-state poking)
         os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = f"{srv.host}:{srv.port}"
-        fleet._PS_CTX[0].server_endpoints = [f"{srv.host}:{srv.port}"]
         client = fleet.init_worker()
         client.register_sparse_dim("emb", 4)
         rows = client.pull_sparse("emb", [1, 2])

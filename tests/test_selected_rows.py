@@ -51,6 +51,17 @@ class TestSparseEmbeddingGrad:
         np.testing.assert_allclose(np.asarray(g.to_dense()),
                                    np.asarray(dense_g), rtol=1e-6)
 
+    def test_paddle_grad_keeps_sparse_leaf_sparse(self):
+        # grad() on a sparse embedding weight must return SelectedRows,
+        # not a materialized [vocab, dim] dense array
+        from paddle_tpu.core.autograd import grad_fn
+        paddle.seed(0)
+        emb = nn.Embedding(1000, 4, sparse=True)
+        out = emb(paddle.to_tensor(np.array([3, 7])))
+        (g,) = grad_fn((out ** 2).sum(), [emb.weight])
+        assert isinstance(g, SelectedRows)
+        assert g.rows.shape[0] == 2
+
     def test_sparse_grad_through_nonleaf_weight_densifies(self):
         # weight is computed (w * scale): the SelectedRows cotangent must
         # densify at the boundary and flow through the multiply's vjp
