@@ -107,6 +107,21 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
     return 1;
   *outputs = nullptr;
   *n_out = 0;
+  // validate EVERY input before the first byte goes out: an argument
+  // error after the header would leave the connection desynchronized
+  for (int i = 0; i < n_in; ++i) {
+    const PD_Tensor& t = inputs[i];
+    if (t.ndim < 0 || t.ndim > kMaxNdim || t.dtype < 0 || t.dtype > 2 ||
+        t.data == nullptr) {
+      p->last_error = "invalid input tensor (ndim/dtype/data)";
+      return 1;
+    }
+    for (int d = 0; d < t.ndim; ++d)
+      if (t.dims[d] < 0) {
+        p->last_error = "negative input dim";
+        return 1;
+      }
+  }
   uint32_t hdr[2] = {kReqMagic, static_cast<uint32_t>(n_in)};
   if (!send_exact(p->fd, hdr, sizeof(hdr))) {
     p->last_error = "send failed (header)";
@@ -114,10 +129,6 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
   }
   for (int i = 0; i < n_in; ++i) {
     const PD_Tensor& t = inputs[i];
-    if (t.ndim < 0 || t.ndim > kMaxNdim) {
-      p->last_error = "tensor ndim out of range";
-      return 1;
-    }
     uint32_t meta[2] = {static_cast<uint32_t>(t.dtype),
                         static_cast<uint32_t>(t.ndim)};
     size_t count = 1;
@@ -146,8 +157,16 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
   }
   uint32_t n = 0;
   if (!recv_exact(p->fd, &n, 4)) return 2;
+  if (n > 1024) {  // corrupt/hostile response: don't trust the count
+    p->last_error = "implausible output tensor count";
+    return 2;
+  }
   PD_Tensor* outs =
       static_cast<PD_Tensor*>(std::calloc(n, sizeof(PD_Tensor)));
+  if (outs == nullptr && n > 0) {
+    p->last_error = "out of memory (outputs)";
+    return 2;
+  }
   // one cleanup path frees every buffer received so far (calloc zeroed
   // data pointers, so free(nullptr) is safe for the rest)
   auto fail = [&](const char* msg) {
@@ -169,7 +188,11 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
     for (int d = 0; d < outs[i].ndim; ++d)
       count *= static_cast<size_t>(outs[i].dims[d]);
     size_t nbytes = count * dtype_size(outs[i].dtype);
+    if (nbytes > (size_t{1} << 33))
+      return fail("implausible output tensor size");
     outs[i].data = std::malloc(nbytes);
+    if (outs[i].data == nullptr)
+      return fail("out of memory (output payload)");
     if (!recv_exact(p->fd, outs[i].data, nbytes))
       return fail("short read (output payload)");
   }

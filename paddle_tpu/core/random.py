@@ -42,13 +42,27 @@ class Generator:
         return keys[0] if n == 1 else keys
 
     def get_state(self):
-        return (self._seed, self._count)
+        """(seed, count, raw key data) — the raw key makes restore EXACT:
+        replaying `count` draws can't reproduce a stream whose draws had
+        mixed granularity (split(k, n+1) != n sequential split(k, 2))."""
+        import numpy as np
+        kd = None if self._key is None else \
+            np.asarray(jax.random.key_data(self._key))
+        return (self._seed, self._count, kd)
 
     def set_state(self, state):
-        seed, count = state
-        self.manual_seed(seed)
-        if count:
-            self.next_key(count)
+        if len(state) == 2:  # legacy (seed, count) form: replay draws
+            seed, count = state
+            self.manual_seed(seed)
+            if count:
+                self.next_key(count)
+            return
+        seed, count, kd = state
+        with self._lock:
+            self._seed = int(seed)
+            self._count = int(count)
+            self._key = None if kd is None else \
+                jax.random.wrap_key_data(jax.numpy.asarray(kd))
 
 
 _DEFAULT = Generator(0)

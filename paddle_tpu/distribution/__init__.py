@@ -160,3 +160,206 @@ def kl_divergence(p, q):
     if hasattr(p, "kl_divergence"):
         return p.kl_divergence(q)
     raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")
+
+
+class Beta(Distribution):
+    """Reference `python/paddle/distribution/beta.py` parity."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+
+    def sample(self, shape=()):
+        a, b = self.alpha._value, self.beta._value
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(a.shape, b.shape))
+        k1, k2 = jax.random.split(rnd.next_key())
+        ga = jax.random.gamma(k1, jnp.broadcast_to(a, shp))
+        gb = jax.random.gamma(k2, jnp.broadcast_to(b, shp))
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(
+            lambda a, b, x: (a - 1) * jnp.log(x) + (b - 1) * jnp.log1p(-x)
+            - (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+               - jax.scipy.special.gammaln(a + b)),
+            [self.alpha, self.beta, v], "beta_log_prob")
+
+    def mean(self):
+        return run_op(lambda a, b: a / (a + b), [self.alpha, self.beta],
+                      "beta_mean")
+
+    def entropy(self):
+        def f(a, b):
+            lnB = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                   - jax.scipy.special.gammaln(a + b))
+            dg = jax.scipy.special.digamma
+            return (lnB - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return run_op(f, [self.alpha, self.beta], "beta_entropy")
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+
+    def sample(self, shape=()):
+        c, r = self.concentration._value, self.rate._value
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(c.shape, r.shape))
+        g = jax.random.gamma(rnd.next_key(), jnp.broadcast_to(c, shp))
+        return Tensor(g / jnp.broadcast_to(r, shp))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(
+            lambda c, r, x: c * jnp.log(r) + (c - 1) * jnp.log(x) - r * x
+            - jax.scipy.special.gammaln(c),
+            [self.concentration, self.rate, v], "gamma_log_prob")
+
+    def mean(self):
+        return run_op(lambda c, r: c / r, [self.concentration, self.rate],
+                      "gamma_mean")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=()):
+        m, s = self.loc._value, self.scale._value
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(m.shape, s.shape))
+        z = jax.random.laplace(rnd.next_key(), shp)
+        return run_op(lambda mm, ss: mm + ss * z, [self.loc, self.scale],
+                      "laplace_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(
+            lambda m, s, x: -jnp.abs(x - m) / s - jnp.log(2 * s),
+            [self.loc, self.scale, v], "laplace_log_prob")
+
+    def entropy(self):
+        return run_op(lambda m, s: 1 + jnp.log(2 * s) + jnp.zeros_like(m),
+                      [self.loc, self.scale], "laplace_entropy")
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+
+    def sample(self, shape=()):
+        import paddle_tpu as paddle
+        return paddle.exp(self._base.sample(shape))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(
+            lambda m, s, x: -((jnp.log(x) - m) ** 2) / (2 * s * s)
+            - jnp.log(x * s) - 0.5 * math.log(2 * math.pi),
+            [self.loc, self.scale, v], "lognormal_log_prob")
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=()):
+        m, s = self.loc._value, self.scale._value
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(m.shape, s.shape))
+        z = jax.random.gumbel(rnd.next_key(), shp)
+        return run_op(lambda mm, ss: mm + ss * z, [self.loc, self.scale],
+                      "gumbel_sample")
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(
+            lambda m, s, x: -(x - m) / s - jnp.exp(-(x - m) / s) - jnp.log(s),
+            [self.loc, self.scale, v], "gumbel_log_prob")
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k >= 0 (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = _t(probs)
+
+    def sample(self, shape=()):
+        p = self.probs_._value
+        shp = tuple(shape) + tuple(p.shape)
+        u = jax.random.uniform(rnd.next_key(), shp, minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-p)))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        return run_op(lambda p, k: k * jnp.log1p(-p) + jnp.log(p),
+                      [self.probs_, v], "geometric_log_prob")
+
+
+class ExponentialFamily(Distribution):
+    """Marker base (reference exponential_family.py) — entropy via the
+    Bregman identity is specialized in subclasses here."""
+
+
+class TransformedDistribution(Distribution):
+    """y = transform(x), x ~ base (reference transformed_distribution.py);
+    transform provides forward(x), inverse(y), log_det_jacobian(x)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = ensure_tensor(value)
+        ldj_sum = None
+        x = y
+        for t in reversed(self.transforms):
+            x = t.inverse(x)
+            ldj = t.log_det_jacobian(x)
+            ldj_sum = ldj if ldj_sum is None else ldj_sum + ldj
+        lp = self.base.log_prob(x)
+        return lp - ldj_sum if ldj_sum is not None else lp
+
+
+class ExpTransform:
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.exp(x)
+
+    def inverse(self, y):
+        import paddle_tpu as paddle
+        return paddle.log(y)
+
+    def log_det_jacobian(self, x):
+        return x  # log|d e^x / dx| = x
+
+
+class AffineTransform:
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return run_op(lambda m, s, v: m + s * v, [self.loc, self.scale,
+                                                  ensure_tensor(x)], "affine_fwd")
+
+    def inverse(self, y):
+        return run_op(lambda m, s, v: (v - m) / s, [self.loc, self.scale,
+                                                    ensure_tensor(y)], "affine_inv")
+
+    def log_det_jacobian(self, x):
+        return run_op(lambda m, s, v: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                       v.shape),
+                      [self.loc, self.scale, ensure_tensor(x)], "affine_ldj")

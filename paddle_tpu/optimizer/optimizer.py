@@ -118,7 +118,7 @@ class Optimizer:
                 self._accumulators[id(p)] = self._create_slots(p)
             p._value, self._accumulators[id(p)] = self._apply_sparse(
                 p._value, sr, self._accumulators[id(p)],
-                lr=lr_s * p.optimize_attr.get("learning_rate", 1.0), t=t_s,
+                lr=lr_s * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0), t=t_s,
                 wd=self._param_wd(p))
         if not params:
             self._step_count += 1
@@ -131,7 +131,8 @@ class Optimizer:
 
         wds = tuple(self._param_wd(p) for p in params)
         need_clip = tuple(getattr(p, "need_clip", True) for p in params)
-        lrs = tuple(p.optimize_attr.get("learning_rate", 1.0) for p in params)
+        lrs = tuple(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+                    for p in params)
 
         key = (tuple((tuple(p.shape), str(p.dtype)) for p in params), wds, need_clip, lrs,
                type(clip_in_jit).__name__)
@@ -223,7 +224,7 @@ class Optimizer:
         need_clip = tuple(getattr(p, "need_clip", True) for p in params) or (True,) * len(values)
         clip = self._grad_clip if grad_clip == "default" else grad_clip
         fn = self._make_update(clip, wds, need_clip,
-                               tuple(p.optimize_attr.get("learning_rate", 1.0) for p in params)
+                               tuple(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) for p in params)
                                or (1.0,) * len(values))
         return fn(values, grads, slots, lr, t)
 

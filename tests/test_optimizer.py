@@ -106,3 +106,19 @@ def test_minimize_api():
     loss = (lin(paddle.to_tensor(_r(2, 3))) ** 2).mean()
     opt.minimize(loss)
     assert lin.weight.grad is not None
+
+
+class TestPlainTensorParams:
+    def test_optimizer_accepts_plain_tensors(self):
+        # reference optimizers accept any trainable tensor, not only
+        # Layer-created Parameters (e.g. distribution params, custom vars)
+        import numpy as np
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.float32(4.0))
+        t.stop_gradient = False
+        opt = paddle.optimizer.Adam(parameters=[t], learning_rate=0.5)
+        for _ in range(30):
+            (t * t).backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(float(np.asarray(t._value))) < 1.0

@@ -1,0 +1,101 @@
+"""Sharded checkpoint round-trip on the 8-device mesh + auto-checkpoint
+epoch resume (kill-and-resume protocol)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.sharded_io import (AutoCheckpoint, load_sharded,
+                                             save_sharded)
+
+
+class TestShardedCheckpoint:
+    def test_sharded_roundtrip_preserves_values_and_placement(self, tmp_path):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.parallel.topology import create_mesh
+        mesh = create_mesh({"dp": 2, "mp": 4})
+        w = np.arange(64, dtype=np.float32).reshape(8, 8)
+        sharding = NamedSharding(mesh, P("dp", "mp"))
+        arr = jax.device_put(w, sharding)
+        b = jax.device_put(np.ones(8, np.float32), NamedSharding(mesh, P()))
+        save_sharded({"w": arr, "b": b}, str(tmp_path / "ckpt"))
+        got = load_sharded(str(tmp_path / "ckpt"),
+                           shardings={"w": sharding})
+        np.testing.assert_array_equal(np.asarray(got["w"]), w)
+        np.testing.assert_array_equal(np.asarray(got["b"]), np.ones(8))
+        # re-placed with the requested sharding
+        assert got["w"].sharding.shard_shape(got["w"].shape) == (4, 2)
+
+    def test_missing_checkpoint_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_sharded(str(tmp_path / "nope"))
+
+
+class TestAutoCheckpoint:
+    def test_resume_skips_completed_epochs(self, tmp_path):
+        state = {"w": 0.0}
+        log = []
+
+        def save_fn(d):
+            import json, os
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump(state, f)
+
+        def load_fn(d):
+            import json, os
+            with open(os.path.join(d, "s.json")) as f:
+                state.update(json.load(f))
+
+        acp = AutoCheckpoint(str(tmp_path / "acp"), save_fn, load_fn)
+        # run 1: crash after epoch 2 completes
+        for epoch in acp.train_epoch_range(5):
+            state["w"] += 1.0
+            log.append(("run1", epoch))
+            if epoch == 2:
+                break  # simulated kill AFTER snapshot of epoch 2? no —
+                # break exits before the post-yield snapshot of epoch 2
+        # epochs 0,1 committed; epoch 2's work is lost (crashed mid-epoch)
+        assert acp.completed_epochs() == 2
+
+        state["w"] = -99.0  # relaunched process: fresh (wrong) state
+        acp2 = AutoCheckpoint(str(tmp_path / "acp"), save_fn, load_fn)
+        for epoch in acp2.train_epoch_range(5):
+            state["w"] += 1.0
+            log.append(("run2", epoch))
+        # restored w=2.0 (after epoch 0,1), then epochs 2,3,4 -> 5.0
+        assert state["w"] == 5.0
+        assert [e for r, e in log if r == "run2"] == [2, 3, 4]
+        assert acp2.completed_epochs() == 5
+
+    def test_spmd_model_snapshot_integration(self, tmp_path):
+        # end-to-end: SPMD-trained params -> sharded snapshot -> new model
+        import jax
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel.spmd import SPMDTrainStep
+        from paddle_tpu.parallel.topology import create_mesh
+
+        mesh = create_mesh({"dp": 8})
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int64)
+
+        paddle.seed(0)
+        net = nn.Linear(8, 2)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                                   learning_rate=0.1)
+        step = SPMDTrainStep(net, nn.CrossEntropyLoss(), opt, mesh=mesh)
+        for _ in range(3):
+            step(paddle.to_tensor(x), paddle.to_tensor(y))
+        save_sharded({n: p for n, p in net.named_parameters()},
+                     str(tmp_path / "model"))
+
+        paddle.seed(1)
+        net2 = nn.Linear(8, 2)
+        got = load_sharded(str(tmp_path / "model"))
+        for n, p in net2.named_parameters():
+            p._value = jax.numpy.asarray(got[n])
+        for (_, a), (_, b) in zip(net.named_parameters(),
+                                  net2.named_parameters()):
+            np.testing.assert_allclose(np.asarray(a._value),
+                                       np.asarray(b._value), rtol=1e-6)
