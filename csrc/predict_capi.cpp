@@ -182,11 +182,20 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
       return fail("bad output tensor header");
     outs[i].dtype = static_cast<int32_t>(meta[0]);
     outs[i].ndim = static_cast<int32_t>(meta[1]);
-    size_t count = 1;
     if (!recv_exact(p->fd, outs[i].dims, sizeof(int64_t) * outs[i].ndim))
       return fail("short read (output dims)");
-    for (int d = 0; d < outs[i].ndim; ++d)
-      count *= static_cast<size_t>(outs[i].dims[d]);
+    // per-dim + cumulative bounds: a hostile dims pair like 2^33 x 2^33
+    // must not wrap size_t past the total-size guard
+    constexpr size_t kMaxElems = size_t{1} << 33;
+    size_t count = 1;
+    for (int d = 0; d < outs[i].ndim; ++d) {
+      int64_t dim = outs[i].dims[d];
+      if (dim < 0 || static_cast<size_t>(dim) > kMaxElems)
+        return fail("implausible output dim");
+      if (dim != 0 && count > kMaxElems / static_cast<size_t>(dim))
+        return fail("implausible output tensor size");
+      count *= static_cast<size_t>(dim);
+    }
     size_t nbytes = count * dtype_size(outs[i].dtype);
     if (nbytes > (size_t{1} << 33))
       return fail("implausible output tensor size");

@@ -46,9 +46,10 @@ class Generator:
         replaying `count` draws can't reproduce a stream whose draws had
         mixed granularity (split(k, n+1) != n sequential split(k, 2))."""
         import numpy as np
-        kd = None if self._key is None else \
-            np.asarray(jax.random.key_data(self._key))
-        return (self._seed, self._count, kd)
+        with self._lock:  # consistent (count, key) snapshot
+            kd = None if self._key is None else \
+                np.asarray(jax.random.key_data(self._key))
+            return (self._seed, self._count, kd)
 
     def set_state(self, state):
         if len(state) == 2:  # legacy (seed, count) form: replay draws

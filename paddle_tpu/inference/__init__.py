@@ -25,6 +25,7 @@ class Config:
         self._device = "tpu"
         self._precision = "float32"
         self._memory_optim = True
+        self._options = {}  # recorded knobs: TPU-mapped or explicit N/A
 
     # paddle API spellings
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -37,28 +38,40 @@ class Config:
         self._device = "cpu"
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        # XLA:CPU threading is runtime-owned; recorded for summary()
+        self._options["cpu_math_threads"] = int(n)
 
     def enable_memory_optim(self, flag=True):
         self._memory_optim = flag
 
     def enable_mkldnn(self):
-        pass
+        # oneDNN is an x86 backend concern: N/A on TPU, XLA fuses instead
+        self._options["mkldnn"] = "n/a-on-tpu (XLA fusion)"
 
     def enable_tensorrt_engine(self, workspace_size=1 << 30, max_batch_size=1,
                                min_subgraph_size=3, precision_mode="float32",
                                use_static=False, use_calib_mode=False):
-        # TRT subgraphs ⇒ XLA whole-graph; accept precision hint
+        # TRT subgraphs ⇒ XLA whole-graph; the precision hint IS honored
         self._precision = precision_mode if isinstance(precision_mode, str) else "float16"
+        self._options["trt"] = f"mapped-to-XLA (precision={self._precision})"
 
     def switch_use_feed_fetch_ops(self, flag):
-        pass
+        self._options["feed_fetch_ops"] = bool(flag)  # zero-copy either way
 
     def switch_ir_optim(self, flag=True):
-        pass
+        # XLA optimization always runs; recorded so summary() shows intent
+        self._options["ir_optim"] = bool(flag)
 
     def precision(self):
         return self._precision
+
+    def summary(self) -> str:
+        """Effective config incl. which knobs are TPU-mapped vs N/A
+        (AnalysisConfig::Summary role)."""
+        lines = [f"device: {self._device}", f"precision: {self._precision}",
+                 f"memory_optim: {self._memory_optim}"]
+        lines += [f"{k}: {v}" for k, v in sorted(self._options.items())]
+        return "\n".join(lines)
 
 
 class PredictorTensor:

@@ -17,3 +17,7 @@ from .gpt import (  # noqa: F401
     gpt_10b, gpt_pipeline_layer,
 )
 from .yoloe import PPYOLOE, ppyoloe_l, ppyoloe_m, ppyoloe_s  # noqa: F401
+from .small_nets import (  # noqa: F401
+    AlexNet, DenseNet, ShuffleNetV2, SqueezeNet, alexnet, densenet121,
+    shufflenet_v2_x1_0, squeezenet1_1,
+)

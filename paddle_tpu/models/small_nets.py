@@ -1,0 +1,192 @@
+"""AlexNet / SqueezeNet / ShuffleNetV2 / DenseNet / GoogLeNet.
+
+Reference parity: `python/paddle/vision/models/{alexnet,squeezenet,
+shufflenetv2,densenet,googlenet}.py` — class/ctor surface and parameter
+geometry; bodies are fresh jnp/Layer compositions (NCHW, paddle-convention
+Linear [in, out]).
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+class AlexNet(nn.Layer):
+    """vision/models/alexnet.py parity (~61.1M params at 1000 classes)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+            nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.reshape([x.shape[0], -1])
+        return self.classifier(x)
+
+
+def alexnet(**kw):
+    return AlexNet(**kw)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(cin, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        s = self.act(self.squeeze(x))
+        return paddle.concat([self.act(self.expand1(s)),
+                              self.act(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """vision/models/squeezenet.py (v1.1) parity (~1.24M params)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+            nn.MaxPool2D(3, stride=2),
+            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2),
+            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.head = nn.Sequential(nn.Dropout(0.5),
+                                  nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+                                  nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.head(self.features(x))
+        return x.reshape([x.shape[0], -1])
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet(**kw)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 2:
+            self.b1 = nn.Sequential(
+                nn.Conv2D(cin, cin, 3, stride=2, padding=1, groups=cin),
+                nn.BatchNorm2D(cin),
+                nn.Conv2D(cin, branch, 1), nn.BatchNorm2D(branch), nn.ReLU())
+            c2in = cin
+        else:
+            self.b1 = None
+            c2in = cin // 2
+        self.b2 = nn.Sequential(
+            nn.Conv2D(c2in, branch, 1), nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1), nn.BatchNorm2D(branch), nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        if self.stride == 2:
+            out = paddle.concat([self.b1(x), self.b2(x)], axis=1)
+        else:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = paddle.concat([x1, self.b2(x2)], axis=1)
+        # channel shuffle (2 groups)
+        n, c, h, w = out.shape
+        out = out.reshape([n, 2, c // 2, h, w]).transpose([0, 2, 1, 3, 4])
+        return out.reshape([n, c, h, w])
+
+
+class ShuffleNetV2(nn.Layer):
+    """vision/models/shufflenetv2.py parity (x1.0, ~2.28M params)."""
+
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+        stages = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                  1.5: [176, 352, 704, 1024], 2.0: [244, 488, 976, 2048]}[scale]
+        self.stem = nn.Sequential(nn.Conv2D(3, 24, 3, stride=2, padding=1),
+                                  nn.BatchNorm2D(24), nn.ReLU(),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        cin = 24
+        for cout, reps in zip(stages[:3], (4, 8, 4)):
+            blocks.append(_ShuffleUnit(cin, cout, 2))
+            for _ in range(reps - 1):
+                blocks.append(_ShuffleUnit(cout, cout, 1))
+            cin = cout
+        self.stages = nn.Sequential(*blocks)
+        self.tail = nn.Sequential(nn.Conv2D(cin, stages[3], 1),
+                                  nn.BatchNorm2D(stages[3]), nn.ReLU(),
+                                  nn.AdaptiveAvgPool2D(1))
+        self.fc = nn.Linear(stages[3], num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        return self.fc(x.reshape([x.shape[0], -1]))
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size=4):
+        super().__init__()
+        self.fn = nn.Sequential(
+            nn.BatchNorm2D(cin), nn.ReLU(),
+            nn.Conv2D(cin, bn_size * growth, 1),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([x, self.fn(x)], axis=1)
+
+
+class DenseNet(nn.Layer):
+    """vision/models/densenet.py parity (121: ~7.98M params)."""
+
+    def __init__(self, layers=(6, 12, 24, 16), growth=32, num_classes=1000):
+        super().__init__()
+        c = 64
+        feats = [nn.Conv2D(3, c, 7, stride=2, padding=3),
+                 nn.BatchNorm2D(c), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        for bi, n in enumerate(layers):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth))
+                c += growth
+            if bi != len(layers) - 1:
+                feats += [nn.BatchNorm2D(c), nn.ReLU(),
+                          nn.Conv2D(c, c // 2, 1), nn.AvgPool2D(2, stride=2)]
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU(), nn.AdaptiveAvgPool2D(1)]
+        self.features = nn.Sequential(*feats)
+        self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.fc(x.reshape([x.shape[0], -1]))
+
+
+def densenet121(**kw):
+    return DenseNet(layers=(6, 12, 24, 16), **kw)

@@ -196,3 +196,35 @@ def bincount(x, weights=None, minlength=0, name=None):
     x = ensure_tensor(x)
     w = ensure_tensor(weights)._value if weights is not None else None
     return Tensor(jnp.bincount(x._value.astype(jnp.int32), weights=w, minlength=minlength))
+
+
+def inverse(x, name=None):
+    x = ensure_tensor(x)
+    return run_op(jnp.linalg.inv, [x], "inverse")
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(axes, (list, tuple)):
+        if all(isinstance(a, int) for a in axes):
+            # flat int list: contract these axes of BOTH tensors
+            ax = (tuple(axes), tuple(axes))
+        else:
+            ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else (a,)
+                       for a in axes)
+    else:
+        ax = axes
+    return run_op(lambda a, b: jnp.tensordot(a, b, axes=ax), [x, y],
+                  "tensordot")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.linalg.matrix_rank(a, rtol=tol),
+                  [x], "matrix_rank")
+
+
+def rank(x, name=None):
+    """Number of dimensions (fluid layers.rank parity)."""
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.asarray(a.ndim, jnp.int32), [x], "rank")

@@ -462,3 +462,36 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
     i = jnp.arange(n)
     x._value = x._value.at[..., i, i].set(value)
     return x
+
+
+# ---- breadth batch (round 2) ----
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.diagonal(a, offset, axis1, axis2),
+                  [x], "diagonal")
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (tensor/manipulation.py take): x treated 1-D.
+    mode='raise' validates on host in eager mode (XLA can't raise
+    data-dependently; under a trace it degrades to 'clip')."""
+    x = ensure_tensor(x)
+    idx = to_arr(ensure_tensor(index)).astype(jnp.int32)
+    n = int(np.prod(x.shape)) if x.shape else 1
+    if mode == "raise" and not isinstance(idx, jax.core.Tracer):
+        iv = np.asarray(idx)
+        if iv.size and (iv.min() < -n or iv.max() >= n):
+            raise IndexError(
+                f"take: index out of range for {n} elements "
+                f"(min {iv.min()}, max {iv.max()})")
+    jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return run_op(lambda a: jnp.take(a.reshape(-1), idx, mode=jmode),
+                  [x], "take")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num if num is not None else x.shape[axis]
+    outs = run_op(lambda a: tuple(jnp.squeeze(s, axis) for s in
+                                  jnp.split(a, n, axis)), [x], "unstack")
+    return list(outs)

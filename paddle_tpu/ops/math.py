@@ -385,3 +385,49 @@ round_ = _make_inplace(round)
 reciprocal_ = _make_inplace(reciprocal)
 tanh_ = _make_inplace(tanh)
 abs_ = _make_inplace(abs)
+
+
+# ---- breadth batch (round 2): reference tensor/math.py stragglers ----
+logit = unary_op(jax.scipy.special.logit, "logit")
+signbit = unary_op(jnp.signbit, "signbit")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        v = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.cumlogsumexp(v.astype(dtype or v.dtype), axis=ax)
+
+    return run_op(f, [x], "logcumsumexp")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim)
+                  .astype(jnp.int32), [x], "count_nonzero")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+                  [x], "nanmedian")
+
+
+def cdist(x, y, p=2.0, name=None, **kw):
+    """Pairwise p-norm distance between row vectors ([..., M, D] x
+    [..., N, D] -> [..., M, N])."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 1e-30))
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+    return run_op(f, [x, y], "cdist")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

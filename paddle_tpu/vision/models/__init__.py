@@ -4,3 +4,7 @@ from ...models import (  # noqa: F401
     resnet18, resnet34, resnet50, resnet101, resnet152, vgg11, vgg13, vgg16, vgg19,
     wide_resnet50_2, wide_resnet101_2,
 )
+from ...models import (  # noqa: F401
+    AlexNet, DenseNet, ShuffleNetV2, SqueezeNet, alexnet, densenet121,
+    shufflenet_v2_x1_0, squeezenet1_1,
+)

@@ -311,3 +311,17 @@ class TestHapiModel:
         assert "loss" in logs and logs["loss"] is not None
         preds = model.predict(ds, batch_size=8)
         assert len(preds) == 4
+
+
+class TestInferenceConfigSummary:
+    def test_knobs_recorded_not_silent(self):
+        from paddle_tpu.inference import Config
+        cfg = Config("/tmp/nope")
+        cfg.enable_mkldnn()
+        cfg.switch_ir_optim(False)
+        cfg.enable_tensorrt_engine(precision_mode="bfloat16")
+        s = cfg.summary()
+        assert "mkldnn: n/a-on-tpu" in s
+        assert "ir_optim: False" in s
+        assert "precision: bfloat16" in s
+        assert cfg.precision() == "bfloat16"

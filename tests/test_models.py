@@ -160,3 +160,39 @@ class TestTensorParallelModels:
         l0 = float(step(ids, y))
         l5 = [float(step(ids, y)) for _ in range(5)][-1]
         assert l5 < l0
+
+
+class TestSmallNets:
+    """Round-2 zoo breadth: param geometry vs reference + forward shapes."""
+
+    def test_alexnet(self):
+        net = models.alexnet(num_classes=10)
+        n = sum(int(np.prod(p.shape)) for p in net.parameters())
+        assert 55_000_000 < n < 58_000_000  # 61.1M @1000cls - fc8 delta
+        net.eval()
+        out = net(paddle.to_tensor(np.zeros((1, 3, 224, 224), np.float32)))
+        assert out.shape == [1, 10]
+
+    def test_squeezenet(self):
+        net = models.squeezenet1_1()
+        n = sum(int(np.prod(p.shape)) for p in net.parameters())
+        assert 1_100_000 < n < 1_400_000  # 1.24M reference
+        net.eval()
+        out = net(paddle.to_tensor(np.zeros((1, 3, 224, 224), np.float32)))
+        assert out.shape == [1, 1000]
+
+    def test_shufflenet_v2(self):
+        net = models.shufflenet_v2_x1_0(num_classes=10)
+        n = sum(int(np.prod(p.shape)) for p in net.parameters())
+        assert 1_200_000 < n < 1_600_000  # 2.28M @1000cls minus big fc
+        net.eval()
+        out = net(paddle.to_tensor(np.zeros((2, 3, 224, 224), np.float32)))
+        assert out.shape == [2, 10]
+
+    def test_densenet121(self):
+        net = models.densenet121(num_classes=10)
+        n = sum(int(np.prod(p.shape)) for p in net.parameters())
+        assert 6_900_000 < n < 8_100_000  # 7.98M @1000cls
+        net.eval()
+        out = net(paddle.to_tensor(np.zeros((1, 3, 224, 224), np.float32)))
+        assert out.shape == [1, 10]

@@ -106,3 +106,74 @@ class TestClipScale:
         x = _r(2, 2)
         out = paddle.scale(paddle.to_tensor(x), scale=2.0, bias=1.0)
         np.testing.assert_allclose(out.numpy(), x * 2 + 1, rtol=1e-6)
+
+
+class TestRound2BreadthOps:
+    """Numpy-oracle checks for the round-2 op-surface stragglers."""
+
+    def test_values_match_numpy(self):
+        rng = np.random.default_rng(0)
+        x = rng.random((3, 4)).astype(np.float32)
+        t = paddle.to_tensor
+        np.testing.assert_allclose(np.asarray(paddle.diagonal(t(x))._value),
+                                   np.diagonal(x), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(paddle.take(t(x), t(np.array([1, 7])))._value),
+            x.reshape(-1)[[1, 7]], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(paddle.count_nonzero(t(np.array([0., 1., 2., 0.])))._value), 2)
+        np.testing.assert_allclose(
+            np.asarray(paddle.nanmedian(t(np.array([1., np.nan, 3.], np.float32)))._value),
+            2.0)
+        np.testing.assert_allclose(
+            np.asarray(paddle.signbit(t(np.array([-1., 2.], np.float32)))._value),
+            [True, False])
+        np.testing.assert_allclose(
+            np.asarray(paddle.logit(t(np.array([0.25], np.float32)))._value),
+            np.log(0.25 / 0.75), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.logcumsumexp(t(np.zeros(4, np.float32)))._value),
+            np.log(np.arange(1, 5)), rtol=1e-6)
+        m = rng.random((3, 3)).astype(np.float32) + np.eye(3, dtype=np.float32) * 3
+        np.testing.assert_allclose(np.asarray(paddle.inverse(t(m))._value),
+                                   np.linalg.inv(m), rtol=1e-3, atol=1e-5)
+        y = rng.random((5, 4)).astype(np.float32)
+        want = np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_allclose(np.asarray(paddle.cdist(t(x), t(y))._value),
+                                   want, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.tensordot(t(x), t(x.T), axes=1)._value),
+            x @ x.T, rtol=1e-5)
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        assert int(paddle.rank(t(x))._value) == 2
+        parts = paddle.unstack(t(x), axis=1)
+        assert len(parts) == 4
+        np.testing.assert_array_equal(np.asarray(parts[2]._value), x[:, 2])
+
+    def test_grads_flow(self):
+        x = paddle.to_tensor(np.random.rand(3, 3).astype(np.float32) + np.eye(3, dtype=np.float32))
+        x.stop_gradient = False
+        paddle.inverse(x).sum().backward()
+        g = x.grad
+        assert np.isfinite(np.asarray(g._value if hasattr(g, "_value") else g)).all()
+        y = paddle.to_tensor(np.random.rand(4, 2).astype(np.float32))
+        y.stop_gradient = False
+        paddle.cdist(y, y + 1.0).sum().backward()
+        gy = y.grad
+        assert np.isfinite(np.asarray(gy._value if hasattr(gy, "_value") else gy)).all()
+
+    def test_take_raise_mode_validates(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        with pytest.raises(IndexError):
+            paddle.take(x, paddle.to_tensor(np.array([100])))
+        out = paddle.take(x, paddle.to_tensor(np.array([100])), mode="clip")
+        assert float(np.asarray(out._value)[0]) == 11.0
+
+    def test_tensordot_flat_axes_list(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((3, 4)).astype(np.float32)
+        b = rng.random((3, 4)).astype(np.float32)
+        out = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                               axes=[0, 1])
+        np.testing.assert_allclose(float(np.asarray(out._value)),
+                                   (a * b).sum(), rtol=1e-5)
