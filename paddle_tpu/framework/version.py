@@ -1,0 +1,89 @@
+"""Program version stamping + op version registry.
+
+Reference parity: `framework/version.cc` (program artifacts carry the
+framework version that wrote them; loaders check compatibility) and
+`framework/op_version_registry.h` (per-op semantic version + checkpoints
+describing each behavior change, so converters can upgrade old programs).
+
+TPU-native use: `paddle_tpu.jit.save` artifacts embed
+{framework_version, op_versions}; load warns/raises on incompatible
+semantic changes instead of silently misreading old modules.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+FRAMEWORK_VERSION = "2.0.0-tpu"
+# artifacts written by versions < this cannot be read (format breaks)
+MIN_COMPATIBLE_VERSION = "2.0.0-tpu"
+
+
+def _ver_tuple(v: str):
+    return tuple(int(x) for x in v.split("-")[0].split("."))
+
+
+def is_compatible(artifact_version: Optional[str]) -> bool:
+    if not artifact_version:
+        return False
+    return _ver_tuple(artifact_version) >= _ver_tuple(MIN_COMPATIBLE_VERSION)
+
+
+class OpCheckpoint:
+    def __init__(self, note: str, version: int):
+        self.note = note
+        self.version = version
+
+
+class OpVersionRegistry:
+    """op name -> ordered checkpoints (op_version_registry.h role)."""
+
+    def __init__(self):
+        self._ops: Dict[str, List[OpCheckpoint]] = {}
+
+    def register(self, op_name: str):
+        self._ops.setdefault(op_name, [])
+        return _OpVersionBuilder(self, op_name)
+
+    def _add(self, op_name: str, note: str):
+        cps = self._ops.setdefault(op_name, [])
+        cps.append(OpCheckpoint(note, len(cps) + 1))
+
+    def version_of(self, op_name: str) -> int:
+        return len(self._ops.get(op_name, []))
+
+    def checkpoints(self, op_name: str) -> List[OpCheckpoint]:
+        return list(self._ops.get(op_name, []))
+
+    def snapshot(self) -> Dict[str, int]:
+        """{op: version} map stamped into saved artifacts."""
+        return {k: len(v) for k, v in self._ops.items()}
+
+    def incompatibilities(self, artifact_ops: Dict[str, int]) -> List[str]:
+        """Ops whose semantics changed since the artifact was written."""
+        out = []
+        for op, ver in (artifact_ops or {}).items():
+            cur = self.version_of(op)
+            if cur > ver:
+                notes = "; ".join(c.note for c in self._ops[op][ver:])
+                out.append(f"{op}: v{ver} -> v{cur} ({notes})")
+        return out
+
+
+class _OpVersionBuilder:
+    def __init__(self, reg: OpVersionRegistry, op_name: str):
+        self._reg = reg
+        self._op = op_name
+
+    def add_checkpoint(self, note: str):
+        self._reg._add(self._op, note)
+        return self
+
+
+GLOBAL_OP_VERSION_REGISTRY = OpVersionRegistry()
+
+# semantic-change history of this framework's own ops (grows over rounds)
+GLOBAL_OP_VERSION_REGISTRY.register("sequence_pad").add_checkpoint(
+    "maxlen smaller than the longest sequence now raises instead of "
+    "silently padding to the true max")
+GLOBAL_OP_VERSION_REGISTRY.register("embedding").add_checkpoint(
+    "sparse=True emits SelectedRows weight gradients")

@@ -69,9 +69,13 @@ def save(layer, path, input_spec=None, **configs):
     np.savez(path + ".pdiparams",
              **{f"p::{n}": np.asarray(a) for n, a in zip(pnames, parrs)},
              **{f"b::{n}": np.asarray(a) for n, a in zip(bnames, barrs)})
+    from ..framework.version import FRAMEWORK_VERSION, GLOBAL_OP_VERSION_REGISTRY
     meta = {"input_specs": [{"shape": list(s.shape), "dtype": np.dtype(s.dtype).name}
                             for s in specs],
-            "param_names": pnames, "buffer_names": bnames}
+            "param_names": pnames, "buffer_names": bnames,
+            # version stamping (framework/version.cc + op_version_registry)
+            "framework_version": FRAMEWORK_VERSION,
+            "op_versions": GLOBAL_OP_VERSION_REGISTRY.snapshot()}
     with open(path + ".pdmodel.json", "w") as f:
         json.dump(meta, f)
     if was_training:
@@ -112,6 +116,16 @@ def load(path, **configs):
         exported = jax.export.deserialize(f.read())
     with open(path + ".pdmodel.json") as f:
         meta = json.load(f)
+    from ..framework.version import (GLOBAL_OP_VERSION_REGISTRY,
+                                     is_compatible)
+    if "framework_version" in meta and not is_compatible(meta["framework_version"]):
+        raise RuntimeError(
+            f"artifact written by incompatible version "
+            f"{meta['framework_version']}")
+    for msg in GLOBAL_OP_VERSION_REGISTRY.incompatibilities(
+            meta.get("op_versions", {})):
+        import warnings
+        warnings.warn(f"op semantics changed since save: {msg}")
     data = np.load(path + ".pdiparams.npz")
     params = [jnp.asarray(data[f"p::{n}"]) for n in meta["param_names"]]
     buffers = [jnp.asarray(data[f"b::{n}"]) for n in meta["buffer_names"]]

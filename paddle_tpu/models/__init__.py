@@ -18,6 +18,6 @@ from .gpt import (  # noqa: F401
 )
 from .yoloe import PPYOLOE, ppyoloe_l, ppyoloe_m, ppyoloe_s  # noqa: F401
 from .small_nets import (  # noqa: F401
-    AlexNet, DenseNet, ShuffleNetV2, SqueezeNet, alexnet, densenet121,
-    shufflenet_v2_x1_0, squeezenet1_1,
+    AlexNet, DenseNet, GoogLeNet, ShuffleNetV2, SqueezeNet, alexnet,
+    densenet121, googlenet, shufflenet_v2_x1_0, squeezenet1_1,
 )

@@ -190,3 +190,80 @@ class DenseNet(nn.Layer):
 
 def densenet121(**kw):
     return DenseNet(layers=(6, 12, 24, 16), **kw)
+
+
+class _Inception(nn.Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(cin, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(cin, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(cin, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                nn.Conv2D(cin, pp, 1), nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b1(x), self.b2(x), self.b3(x),
+                              self.b4(x)], axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = nn.Conv2D(cin, 128, 1)
+        self.act = nn.ReLU()
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.act(self.conv(self.pool(x)))
+        x = self.fc1(x.reshape([x.shape[0], -1]))
+        return self.fc2(self.drop(self.act(x)))
+
+
+class GoogLeNet(nn.Layer):
+    """vision/models/googlenet.py parity (inception v1 + two aux heads;
+    forward returns [out, aux1, aux2] like the reference)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.gap = nn.AdaptiveAvgPool2D(1)
+        self.drop = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+        self.aux1 = _AuxHead(512, num_classes)
+        self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.i3b(self.i3a(self.stem(x)))
+        x = self.i4a(self.pool3(x))
+        a1 = self.aux1(x)
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = self.aux2(x)
+        x = self.i5b(self.i5a(self.pool4(self.i4e(x))))
+        out = self.fc(self.drop(self.gap(x)).reshape([x.shape[0], -1]))
+        return [out, a1, a2]
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)

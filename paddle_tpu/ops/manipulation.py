@@ -485,6 +485,10 @@ def take(x, index, mode="raise", name=None):
                 f"take: index out of range for {n} elements "
                 f"(min {iv.min()}, max {iv.max()})")
     jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    if mode != "wrap":
+        # python-style negative indexing (paddle take contract); under
+        # 'clip' the clamp applies AFTER normalization
+        idx = jnp.where(idx < 0, idx + n, idx)
     return run_op(lambda a: jnp.take(a.reshape(-1), idx, mode=jmode),
                   [x], "take")
 

@@ -423,7 +423,13 @@ def cdist(x, y, p=2.0, name=None, **kw):
     def f(a, b):
         d = a[..., :, None, :] - b[..., None, :, :]
         if p == 2.0:
-            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 1e-30))
+            sq = jnp.sum(d * d, -1)
+            # exact zero self-distance; sqrt grad guarded off-zero only
+            return jnp.where(sq > 0, jnp.sqrt(jnp.maximum(sq, 1e-30)), 0.0)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), -1)  # Chebyshev
+        if p == 0.0:
+            return jnp.sum((d != 0).astype(a.dtype), -1)  # Hamming
         return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
 
     return run_op(f, [x, y], "cdist")

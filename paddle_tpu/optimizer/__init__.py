@@ -1,7 +1,9 @@
 """paddle.optimizer parity namespace."""
 from . import lr  # noqa: F401
 from .optimizer import Optimizer, SGD, Momentum  # noqa: F401
-from .adam import Adam, AdamW, Adamax, Lamb, Adagrad, RMSProp, Adadelta  # noqa: F401
+from .adam import (  # noqa: F401
+    Adadelta, Adagrad, Adam, AdamW, Adamax, Lamb, LarsMomentum, RMSProp,
+)
 
 
 class L2Decay:

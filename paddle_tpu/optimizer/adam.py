@@ -200,3 +200,46 @@ class Adadelta(Optimizer):
         asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * upd * upd
         return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
             {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (layer-wise adaptive rate scaling) momentum.
+
+    Reference parity: `operators/optimizers/lars_momentum_op.cc` /
+    `fluid/optimizer.py` LarsMomentumOptimizer: local_lr = lr *
+    lars_coeff * ||w|| / (||g|| + lars_weight_decay * ||w||), then
+    momentum update with that per-layer lr.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=1e-3,
+                 lars_weight_decay=5e-4, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, lars_weight_decay,
+                         grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._epsilon = epsilon
+        # name-substring exclusion list (reference LarsMomentumOptimizer's
+        # exclude_from_weight_decay; same role as Lamb's exclude fn)
+        self._exclude_wd = list(exclude_from_weight_decay or [])
+
+    def _param_wd(self, p):
+        pname = p.name or ""
+        if any(s in pname for s in self._exclude_wd):
+            return 0.0
+        return self._weight_decay
+
+    def _create_slots(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _apply(self, p, g, slots, *, lr, t, wd):
+        p32, g32 = p.astype(jnp.float32), g.astype(jnp.float32)
+        wn = jnp.sqrt(jnp.sum(p32 * p32))
+        gn = jnp.sqrt(jnp.sum(g32 * g32))
+        local_lr = lr * self._lars_coeff * wn / (
+            gn + wd * wn + self._epsilon)
+        # scalar params (biases/norms): no layer adaptation (reference
+        # excludes them); wn==0 guards fresh zeros too
+        local_lr = jnp.where(wn > 0, local_lr, lr)
+        v = self._momentum * slots["velocity"] + local_lr * (g32 + wd * p32)
+        return (p32 - v).astype(p.dtype), {"velocity": v}

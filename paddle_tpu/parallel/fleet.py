@@ -105,6 +105,21 @@ def distributed_optimizer(optimizer, strategy=None):
         from .meta_optimizers import LocalSGDOptimizer
         cfg = getattr(st, "localsgd_configs", {"k_steps": 4})
         opt = LocalSGDOptimizer(opt, k_steps=cfg.get("k_steps", 4))
+    if st is not None and getattr(st, "dgc", False):
+        from .meta_optimizers import DGCMomentumOptimizer
+        cfg = getattr(st, "dgc_configs", {})
+        # reference usage is distributed_optimizer(Momentum(...)) with
+        # dgc=True: lift the inner momentum into DGC (which IS the
+        # momentum optimizer) so it isn't applied twice
+        momentum = cfg.get("momentum")
+        inner_m = float(getattr(optimizer, "_momentum", 0.0) or 0.0)
+        if momentum is None:
+            momentum = inner_m if inner_m > 0 else 0.9
+        if inner_m > 0:
+            optimizer._momentum = 0.0
+        opt = DGCMomentumOptimizer(
+            opt, momentum=momentum, sparsity=cfg.get("sparsity", 0.999),
+            rampup_begin_step=cfg.get("rampup_begin_step", 0))
     return opt
 
 

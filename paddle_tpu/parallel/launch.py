@@ -26,14 +26,73 @@ def _parse():
     p.add_argument("--nproc_per_node", type=int, default=1)
     p.add_argument("--devices", "--gpus", dest="devices", default=None)
     p.add_argument("--log_dir", default=None)
-    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--run_mode", default=None,
+                   help="collective (default) | ps | elastic")
+    # PS mode (reference launch_ps, fleet/launch.py:416)
+    p.add_argument("--server_num", type=int, default=0)
+    p.add_argument("--worker_num", type=int, default=0)
+    p.add_argument("--servers", default="", help="host:port list for PS")
+    # elastic mode (reference launch_elastic, elastic/__init__.py:48)
+    p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args()
+    args = p.parse_args()
+    if args.run_mode is None:
+        # mode autodetect (reference which_distributed_mode, launch.py:448)
+        args.run_mode = "ps" if (args.server_num or args.servers) else "collective"
+    return args
+
+
+def _launch_ps(args):
+    """Server + trainer process gang (launch_ps role): servers get
+    TRAINING_ROLE=PSERVER and a port; trainers get the endpoint list."""
+    if args.servers:
+        endpoints = [e for e in args.servers.split(",") if e]
+    else:
+        endpoints = [f"127.0.0.1:{8200 + i}" for i in range(args.server_num)]
+    n_workers = args.worker_num or 1
+    procs = []
+
+    def spawn(role, rank, extra):
+        env = dict(os.environ)
+        env["TRAINING_ROLE"] = role
+        env["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(endpoints)
+        env["PADDLE_TRAINERS_NUM"] = str(n_workers)
+        env.update(extra)
+        out = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            out = open(os.path.join(args.log_dir,
+                                    f"{role.lower()}log.{rank}"), "w")
+        return subprocess.Popen(
+            [sys.executable, args.training_script] + args.training_script_args,
+            env=env, stdout=out, stderr=subprocess.STDOUT if out else None)
+
+    for i, ep in enumerate(endpoints):
+        procs.append(spawn("PSERVER", i, {
+            "PADDLE_PORT": ep.rsplit(":", 1)[1], "POD_IP": ep.rsplit(":", 1)[0]}))
+    for r in range(n_workers):
+        procs.append(spawn("TRAINER", r, {"PADDLE_TRAINER_ID": str(r)}))
+    rc = 0
+    # trainers finish -> kill servers (reference behavior)
+    for p in procs[len(endpoints):]:
+        rc |= p.wait()
+    for p in procs[:len(endpoints)]:
+        p.terminate()
+    sys.exit(rc)
 
 
 def launch():
     args = _parse()
+    if args.run_mode == "ps":
+        return _launch_ps(args)
+    if args.run_mode == "elastic":
+        from .elastic import launch_elastic
+        res = launch_elastic(args.training_script,
+                             args.training_script_args,
+                             nprocs=max(args.nproc_per_node, 1),
+                             max_restarts=args.max_restarts)
+        sys.exit(0 if res.success else 1)
     base_env = dict(os.environ)
     base_env["PADDLE_MASTER"] = args.master
     base_env["PADDLE_TRAINERS_NUM"] = str(args.nnodes * args.nproc_per_node)

@@ -191,3 +191,113 @@ class LocalSGDOptimizer:
 
     def clear_grad(self, *a, **kw):
         self.inner_optimizer.clear_grad(*a, **kw)
+
+
+class DGCMomentumOptimizer:
+    """Deep Gradient Compression (reference `operators/dgc_op.cc` +
+    `details/sparse_all_reduce_op_handle.cc`, fleet dgc toggle): keep only
+    the top-k% of gradient values per step; the rest ACCUMULATE locally
+    (with momentum correction) until they grow large enough to send.
+
+    TPU framing: the compressed "send" is the sparsified gradient handed to
+    the wrapped optimizer (and, cross-process, to the injectable allreduce);
+    locality = the residual buffers. rampup_begin_step delays compression
+    (reference warmup).
+    """
+
+    def __init__(self, inner_optimizer, momentum: float = 0.9,
+                 sparsity: float = 0.999, rampup_begin_step: int = 0,
+                 allreduce: Optional[Callable] = None):
+        # DGC IS the momentum optimizer (reference DGCMomentumOptimizer
+        # subclasses Momentum): the inner applier must be momentum-free or
+        # the velocity is applied twice and training diverges
+        if float(getattr(inner_optimizer, "_momentum", 0.0)) > 0.0:
+            raise ValueError(
+                "DGCMomentumOptimizer provides momentum itself; wrap a "
+                "momentum-free optimizer (e.g. SGD) and pass momentum= here")
+        self.inner_optimizer = inner_optimizer
+        self.momentum = float(momentum)
+        self.sparsity = float(sparsity)  # fraction DROPPED (reference: 99.9%)
+        self.rampup_begin_step = int(rampup_begin_step)
+        self._allreduce = allreduce
+        self._u = {}  # momentum-corrected velocity per param
+        self._v = {}  # local accumulation (residual) per param
+        self._steps = 0
+
+    def __getattr__(self, name):
+        if name == "inner_optimizer":
+            raise AttributeError(name)
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        from ..core.selected_rows import SelectedRows
+        self._steps += 1
+        params = [p for p in (self.inner_optimizer._parameter_list or [])
+                  if not p.stop_gradient and p.grad is not None]
+        if self._steps <= self.rampup_begin_step:
+            # warmup: FULL momentum update, no sparsification (reference
+            # DGCMomentumOptimizer is a Momentum subclass — pre-rampup
+            # training is momentum SGD, not plain SGD)
+            for p in params:
+                g = p.grad._value if isinstance(p.grad, Tensor) else p.grad
+                if isinstance(g, SelectedRows):
+                    g = g.to_dense()
+                u = self._u.get(id(p))
+                u = jnp.asarray(g) if u is None else \
+                    self.momentum * u + jnp.asarray(g)
+                self._u[id(p)] = u
+                p.grad = u
+            self.inner_optimizer.step()
+            return
+        for p in params:
+            g = p.grad._value if isinstance(p.grad, Tensor) else p.grad
+            if isinstance(g, SelectedRows):
+                g = g.to_dense()
+            g = jnp.asarray(g)
+            u = self._u.get(id(p))
+            v = self._v.get(id(p))
+            u = g if u is None else self.momentum * u + g  # momentum corr.
+            v = u if v is None else v + u                  # local accumulate
+            flat = v.reshape(-1)
+            k = max(1, int(flat.size * (1.0 - self.sparsity)))
+            thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+            mask = jnp.abs(v) >= thresh
+            send = jnp.where(mask, v, 0.0)
+            if self._allreduce is not None:
+                send = jnp.asarray(self._allreduce(send))
+            p.grad = send.astype(g.dtype)
+            # masked-out values stay in the residual; sent values clear
+            self._v[id(p)] = jnp.where(mask, 0.0, v)
+            self._u[id(p)] = jnp.where(mask, 0.0, u)
+        self.inner_optimizer.step()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, *a, **kw):
+        self.inner_optimizer.clear_grad(*a, **kw)
+
+    def state_dict(self):
+        """Inner state PLUS the residual/velocity buffers: with high
+        sparsity those hold most recent gradient mass — dropping them on
+        resume would change convergence (checkpoint parity)."""
+        plist = self.inner_optimizer._parameter_list or []
+        idx = {id(p): i for i, p in enumerate(plist)}
+        import numpy as np
+        return {"inner": self.inner_optimizer.state_dict(),
+                "dgc_steps": self._steps,
+                "dgc_u": {idx[k]: np.asarray(v) for k, v in self._u.items()
+                          if k in idx},
+                "dgc_v": {idx[k]: np.asarray(v) for k, v in self._v.items()
+                          if k in idx}}
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd["inner"])
+        self._steps = int(sd.get("dgc_steps", 0))
+        plist = self.inner_optimizer._parameter_list or []
+        self._u = {id(plist[int(i)]): jnp.asarray(v)
+                   for i, v in sd.get("dgc_u", {}).items()}
+        self._v = {id(plist[int(i)]): jnp.asarray(v)
+                   for i, v in sd.get("dgc_v", {}).items()}

@@ -40,6 +40,7 @@ class DistributedStrategy:
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         # misc parity toggles (recorded, mapped or no-op on TPU)
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": 0.999}
         self.lamb = False
         self.lars = False
         self.localsgd = False

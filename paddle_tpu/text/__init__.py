@@ -33,3 +33,83 @@ class UCIHousing(Dataset):
 
     def __len__(self):
         return len(self.x)
+
+
+class Imikolov(Dataset):
+    """imikolov ngram LM dataset surface (reference text/datasets/imikolov.py);
+    synthetic ngrams over a Zipf-ish vocab (zero-egress image)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        n = 2000 if mode == "train" else 200
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        vocab = 1000
+        probs = 1.0 / np.arange(1, vocab + 1)
+        probs /= probs.sum()
+        self.window_size = window_size
+        self._grams = rng.choice(vocab, size=(n, window_size), p=probs)
+
+    def __getitem__(self, idx):
+        g = self._grams[idx]
+        return tuple(g[:-1]) + (g[-1],)
+
+    def __len__(self):
+        return len(self._grams)
+
+
+class Movielens(Dataset):
+    """movielens rating surface (reference text/datasets/movielens.py):
+    (user_id, gender, age, job, movie_id, categories, title, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        # disjoint train/test streams (no leakage between splits)
+        rng = np.random.default_rng(rand_seed + (0 if mode == "train" else 1))
+        n = 1800 if mode == "train" else 200
+        self._rows = [(
+            int(rng.integers(1, 500)),        # user id
+            int(rng.integers(0, 2)),          # gender
+            int(rng.integers(1, 7)),          # age bucket
+            int(rng.integers(0, 21)),         # job
+            int(rng.integers(1, 800)),        # movie id
+            rng.integers(0, 18, 3).tolist(),  # category ids
+            rng.integers(0, 5000, 4).tolist(),  # title word ids
+            float(rng.integers(1, 6)),        # rating
+        ) for _ in range(n)]
+
+    def __getitem__(self, idx):
+        return self._rows[idx]
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class Conll05st(Dataset):
+    """conll05 SRL surface (reference text/datasets/conll05.py, 9-column
+    layout): word ids, 5 predicate-context windows (ctx_n2..ctx_p2),
+    predicate ids, mark, label ids (synthetic)."""
+
+    def __init__(self, data_file=None, word_dict_file=None, mode="train",
+                 download=True):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 500 if mode == "train" else 50
+        self._rows = []
+        for _ in range(n):
+            ln = int(rng.integers(5, 30))
+            words = rng.integers(0, 5000, ln)
+            pred = int(rng.integers(0, ln))
+            mark = np.zeros(ln, np.int64)
+            mark[pred] = 1
+            labels = rng.integers(0, 67, ln)
+            # predicate context windows: words at pred-2 .. pred+2,
+            # clamped at the sentence edges, broadcast over the sequence
+            ctx = [np.full(ln, words[min(max(pred + off, 0), ln - 1)])
+                   for off in (-2, -1, 0, 1, 2)]
+            self._rows.append((words, *ctx, np.full(ln, words[pred]),
+                               mark, labels))
+
+    def __getitem__(self, idx):
+        return self._rows[idx]
+
+    def __len__(self):
+        return len(self._rows)

@@ -5,6 +5,6 @@ from ...models import (  # noqa: F401
     wide_resnet50_2, wide_resnet101_2,
 )
 from ...models import (  # noqa: F401
-    AlexNet, DenseNet, ShuffleNetV2, SqueezeNet, alexnet, densenet121,
-    shufflenet_v2_x1_0, squeezenet1_1,
+    AlexNet, DenseNet, GoogLeNet, ShuffleNetV2, SqueezeNet, alexnet,
+    densenet121, googlenet, shufflenet_v2_x1_0, squeezenet1_1,
 )

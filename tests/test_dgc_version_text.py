@@ -1,0 +1,238 @@
+"""DGC compression, LARS, op-version registry, text dataset breadth."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel.meta_optimizers import DGCMomentumOptimizer
+
+
+def _data(n=128, din=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, din)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int64)
+    return x, y
+
+
+class TestDGC:
+    def test_sparsified_grad_and_residual_accumulation(self):
+        paddle.seed(0)
+        net = nn.Linear(16, 4)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.0)  # freeze weights
+        opt = DGCMomentumOptimizer(inner, momentum=0.0, sparsity=0.9)
+        x, y = _data()
+        ce = nn.CrossEntropyLoss()
+        loss = ce(net(paddle.to_tensor(x[:32])), paddle.to_tensor(y[:32]))
+        loss.backward()
+        opt.step()
+        g = np.asarray(net.weight.grad)
+        nz = (g != 0).sum()
+        assert nz <= int(g.size * 0.1) + 1, nz  # only top-10% survive
+        # dropped values live in the residual and eventually get sent
+        resid = np.asarray(opt._v[id(net.weight)])
+        assert (resid != 0).sum() >= g.size - nz - 4
+
+    def test_training_converges_under_compression(self):
+        paddle.seed(0)
+        net = nn.Linear(16, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.2)
+        opt = DGCMomentumOptimizer(inner, sparsity=0.75)
+        x, y = _data()
+        ce = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(12):
+            for i in range(0, 128, 32):
+                loss = ce(net(paddle.to_tensor(x[i:i+32])),
+                          paddle.to_tensor(y[i:i+32]))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+    def test_rampup_delays_compression(self):
+        paddle.seed(0)
+        net = nn.Linear(8, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.0)
+        opt = DGCMomentumOptimizer(inner, sparsity=0.9, rampup_begin_step=2)
+        x, y = _data(din=8)
+        ce = nn.CrossEntropyLoss()
+        loss = ce(net(paddle.to_tensor(x[:16])), paddle.to_tensor(y[:16]))
+        loss.backward()
+        opt.step()  # step 1: warmup, grad untouched
+        g = np.asarray(net.weight.grad)
+        assert (g != 0).sum() > g.size * 0.5
+
+    def test_rejects_momentum_inner(self):
+        # DGC IS the momentum optimizer: stacking would double-apply it
+        net = nn.Linear(4, 2)
+        with pytest.raises(ValueError, match="momentum"):
+            DGCMomentumOptimizer(paddle.optimizer.Momentum(
+                parameters=net.parameters(), momentum=0.9))
+
+    def test_state_dict_roundtrip_preserves_residuals(self):
+        paddle.seed(0)
+        net = nn.Linear(8, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.1)
+        opt = DGCMomentumOptimizer(inner, sparsity=0.9)
+        x, y = _data(din=8)
+        ce = nn.CrossEntropyLoss()
+        loss = ce(net(paddle.to_tensor(x[:16])), paddle.to_tensor(y[:16]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        sd = opt.state_dict()
+        assert sd["dgc_steps"] == 1 and len(sd["dgc_v"]) > 0
+        inner2 = paddle.optimizer.SGD(parameters=net.parameters(),
+                                      learning_rate=0.1)
+        opt2 = DGCMomentumOptimizer(inner2, sparsity=0.9)
+        opt2.set_state_dict(sd)
+        assert opt2._steps == 1
+        k = id(net.weight)
+        np.testing.assert_array_equal(np.asarray(opt2._v[k]),
+                                      np.asarray(opt._v[k]))
+
+    def test_fleet_dgc_toggle(self):
+        from paddle_tpu.parallel import fleet, strategy
+        st = strategy.DistributedStrategy()
+        st.dgc = True
+        fleet.init(is_collective=True, strategy=st)
+        net = nn.Linear(4, 2)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(parameters=net.parameters(),
+                                 learning_rate=0.1), st)
+        assert isinstance(opt, DGCMomentumOptimizer)
+
+
+class TestLars:
+    def test_converges_and_scales_lr_by_layer(self):
+        paddle.seed(0)
+        net = nn.Linear(16, 2)
+        opt = paddle.optimizer.LarsMomentum(
+            parameters=net.parameters(), learning_rate=0.5, momentum=0.9)
+        x, y = _data()
+        ce = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(10):
+            for i in range(0, 128, 32):
+                loss = ce(net(paddle.to_tensor(x[i:i+32])),
+                          paddle.to_tensor(y[i:i+32]))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+class TestOpVersionRegistry:
+    def test_registry_and_artifact_check(self):
+        from paddle_tpu.framework.version import (FRAMEWORK_VERSION,
+                                                  OpVersionRegistry,
+                                                  is_compatible)
+        reg = OpVersionRegistry()
+        reg.register("my_op").add_checkpoint("change A").add_checkpoint("change B")
+        assert reg.version_of("my_op") == 2
+        assert reg.version_of("unknown") == 0
+        # artifact written when my_op was at v1: flagged with the v2 note
+        bad = reg.incompatibilities({"my_op": 1})
+        assert len(bad) == 1 and "change B" in bad[0]
+        assert reg.incompatibilities({"my_op": 2}) == []
+        assert is_compatible(FRAMEWORK_VERSION)
+        assert not is_compatible("1.0.0")
+        assert not is_compatible(None)
+
+    def test_jit_artifact_carries_version(self, tmp_path):
+        import json
+        from paddle_tpu.jit import InputSpec, save
+        net = nn.Linear(4, 2)
+        net.eval()
+        p = str(tmp_path / "m")
+        save(net, p, input_spec=[InputSpec([1, 4], "float32")])
+        with open(p + ".pdmodel.json") as f:
+            meta = json.load(f)
+        assert meta["framework_version"]
+        assert "sequence_pad" in meta["op_versions"]
+
+    def test_incompatible_artifact_rejected(self, tmp_path):
+        import json
+        from paddle_tpu.jit import InputSpec, load, save
+        net = nn.Linear(4, 2)
+        net.eval()
+        p = str(tmp_path / "m")
+        save(net, p, input_spec=[InputSpec([1, 4], "float32")])
+        with open(p + ".pdmodel.json") as f:
+            meta = json.load(f)
+        meta["framework_version"] = "1.0.0"
+        with open(p + ".pdmodel.json", "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(RuntimeError, match="incompatible"):
+            load(p)
+
+
+class TestTextDatasets:
+    def test_imikolov(self):
+        from paddle_tpu.text import Imikolov
+        ds = Imikolov(window_size=5)
+        assert len(ds) == 2000
+        item = ds[0]
+        assert len(item) == 5
+
+    def test_movielens(self):
+        from paddle_tpu.text import Movielens
+        tr, te = Movielens(mode="train"), Movielens(mode="test")
+        assert len(tr) == 1800 and len(te) == 200
+        row = tr[0]
+        assert len(row) == 8 and 1.0 <= row[-1] <= 5.0
+
+    def test_conll05(self):
+        from paddle_tpu.text import Conll05st
+        ds = Conll05st()
+        row = ds[0]
+        assert len(row) == 9  # words + 5 ctx windows + pred + mark + label
+        words, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark, labels = row
+        assert all(len(c) == len(words) for c in (c_n2, c_n1, c_0, c_p1, c_p2))
+        assert len(pred) == len(mark) == len(labels) == len(words)
+        assert mark.sum() == 1
+        assert (c_0 == pred).all()  # center window IS the predicate
+
+    def test_movielens_splits_disjoint_streams(self):
+        from paddle_tpu.text import Movielens
+        tr, te = Movielens(mode="train"), Movielens(mode="test")
+        assert tr[0] != te[0]  # not the same generated row
+
+
+class TestDGCFleetMomentumLift:
+    def test_momentum_lifted_from_inner(self):
+        from paddle_tpu.parallel import fleet, strategy
+        st = strategy.DistributedStrategy()
+        st.dgc = True
+        fleet.init(is_collective=True, strategy=st)
+        net = nn.Linear(4, 2)
+        inner = paddle.optimizer.Momentum(parameters=net.parameters(),
+                                          learning_rate=0.1, momentum=0.7)
+        opt = fleet.distributed_optimizer(inner, st)
+        assert isinstance(opt, DGCMomentumOptimizer)
+        assert opt.momentum == 0.7
+        assert inner._momentum == 0.0  # not applied twice
+
+    def test_warmup_uses_momentum(self):
+        # pre-rampup: velocity accumulates (momentum SGD, not plain SGD)
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.0)
+        opt = DGCMomentumOptimizer(inner, momentum=0.5, rampup_begin_step=10)
+        x, y = _data(din=4)
+        ce = nn.CrossEntropyLoss()
+        for _ in range(2):
+            loss = ce(net(paddle.to_tensor(x[:8])), paddle.to_tensor(y[:8]))
+            loss.backward()
+            opt.step()
+            g2 = np.asarray(net.weight.grad)
+            opt.clear_grad()
+        u = np.asarray(opt._u[id(net.weight)])
+        np.testing.assert_allclose(u, g2, rtol=1e-6)  # grad IS the velocity

@@ -1,0 +1,46 @@
+"""Launcher PS mode: server + trainer gang end-to-end through the CLI
+(reference launch_ps / TestDistBase subprocess technique)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+
+def test_launch_ps_mode(tmp_path):
+    script = tmp_path / "ps_job.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, %r)
+        from paddle_tpu.parallel import fleet
+
+        role = os.environ["TRAINING_ROLE"]
+        if role == "PSERVER":
+            srv = fleet.init_server(port=int(os.environ["PADDLE_PORT"]))
+            srv.add_sparse_table("emb", dim=4, lr=0.5)
+            fleet.run_server(block=True)  # killed by the launcher
+        else:
+            import numpy as np
+            time.sleep(0.5)  # let the server bind
+            client = fleet.init_worker()
+            client.register_sparse_dim("emb", 4)
+            before = client.pull_sparse("emb", [1, 2]).copy()
+            client.push_sparse("emb", [1, 2], np.ones((2, 4), np.float32))
+            after = client.pull_sparse("emb", [1, 2])
+            assert abs((before - after) - 0.5).max() < 1e-5, (before, after)
+            fleet.stop_worker()
+            print("TRAINER_OK")
+    """ % os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))))
+    log_dir = str(tmp_path / "logs")
+    p = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.parallel.launch",
+         "--server_num", "1", "--worker_num", "1",
+         "--log_dir", log_dir, str(script)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    trainer_log = open(os.path.join(log_dir, "trainerlog.0")).read()
+    assert p.returncode == 0, (p.stdout, p.stderr, trainer_log)
+    assert "TRAINER_OK" in trainer_log, trainer_log

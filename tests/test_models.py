@@ -196,3 +196,11 @@ class TestSmallNets:
         net.eval()
         out = net(paddle.to_tensor(np.zeros((1, 3, 224, 224), np.float32)))
         assert out.shape == [1, 10]
+
+    def test_googlenet(self):
+        net = models.googlenet(num_classes=10)
+        n = sum(int(np.prod(p.shape)) for p in net.parameters())
+        assert 9_000_000 < n < 14_000_000  # inception v1 + 2 aux heads
+        net.eval()
+        out, a1, a2 = net(paddle.to_tensor(np.zeros((1, 3, 224, 224), np.float32)))
+        assert out.shape == [1, 10] and a1.shape == [1, 10] and a2.shape == [1, 10]

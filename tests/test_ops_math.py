@@ -177,3 +177,20 @@ class TestRound2BreadthOps:
                                axes=[0, 1])
         np.testing.assert_allclose(float(np.asarray(out._value)),
                                    (a * b).sum(), rtol=1e-5)
+
+    def test_take_negative_indices(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        out = paddle.take(x, paddle.to_tensor(np.array([-1, -12])))
+        np.testing.assert_array_equal(np.asarray(out._value), [11.0, 0.0])
+        with pytest.raises(IndexError):
+            paddle.take(x, paddle.to_tensor(np.array([-13])))
+
+    def test_cdist_inf_zero_and_self(self):
+        x = paddle.to_tensor(np.array([[0., 0.], [3., 4.]], np.float32))
+        y = paddle.to_tensor(np.array([[1., 7.]], np.float32))
+        inf = np.asarray(paddle.cdist(x, y, p=float("inf"))._value)
+        np.testing.assert_allclose(inf[:, 0], [7.0, 3.0])
+        ham = np.asarray(paddle.cdist(x, y, p=0.0)._value)
+        np.testing.assert_allclose(ham[:, 0], [2.0, 2.0])
+        self_d = np.asarray(paddle.cdist(x, x)._value)
+        assert self_d[0, 0] == 0.0 and self_d[1, 1] == 0.0  # exact zeros
