@@ -140,7 +140,8 @@ def bench_resnet50_infer(backend):
             run(n)
             return time.perf_counter() - t0
 
-        n_steps, reps = (20, 5) if backend == "tpu" else (3, 2)
+        n_steps, reps = (60, 7) if backend == "tpu" else (3, 2)
+        run_sync(n_steps)  # one full-span warmup before the timed reps
         rates = []
         for _ in range(reps):
             dt = run_sync(n_steps)

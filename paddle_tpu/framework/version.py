@@ -25,7 +25,10 @@ def _ver_tuple(v: str):
 def is_compatible(artifact_version: Optional[str]) -> bool:
     if not artifact_version:
         return False
-    return _ver_tuple(artifact_version) >= _ver_tuple(MIN_COMPATIBLE_VERSION)
+    try:
+        return _ver_tuple(artifact_version) >= _ver_tuple(MIN_COMPATIBLE_VERSION)
+    except ValueError:  # malformed/foreign version string -> incompatible
+        return False
 
 
 class OpCheckpoint:

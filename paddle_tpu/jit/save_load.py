@@ -92,10 +92,15 @@ class TranslatedLayer:
         self._buffers = buffers
         self._meta = meta
         self.training = False
+        # Exported.call rebuilds its calling convention per invocation;
+        # jitting it once puts repeat predictions on XLA's fast C++
+        # dispatch path (the predictor hot loop)
+        self._jitted_call = jax.jit(
+            lambda params, buffers, *a: exported.call(params, buffers, *a))
 
     def __call__(self, *args):
         arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-        out = self._exported.call(self._params, self._buffers, *arrs)
+        out = self._jitted_call(self._params, self._buffers, *arrs)
         if isinstance(out, (list, tuple)):
             return [Tensor(o) for o in out]
         return Tensor(out)

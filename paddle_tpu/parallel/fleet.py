@@ -116,7 +116,11 @@ def distributed_optimizer(optimizer, strategy=None):
         if momentum is None:
             momentum = inner_m if inner_m > 0 else 0.9
         if inner_m > 0:
+            # DGC owns momentum now; drop any fused update already traced
+            # with the old coefficient (it's baked into the jit, and the
+            # cache key doesn't include it)
             optimizer._momentum = 0.0
+            getattr(optimizer, "_jit_cache", {}).clear()
         opt = DGCMomentumOptimizer(
             opt, momentum=momentum, sparsity=cfg.get("sparsity", 0.999),
             rampup_begin_step=cfg.get("rampup_begin_step", 0))

@@ -39,7 +39,8 @@ def _parse():
     args = p.parse_args()
     if args.run_mode is None:
         # mode autodetect (reference which_distributed_mode, launch.py:448)
-        args.run_mode = "ps" if (args.server_num or args.servers) else "collective"
+        args.run_mode = "ps" if (args.server_num or args.servers
+                                  or args.worker_num) else "collective"
     return args
 
 

@@ -217,7 +217,13 @@ class DGCMomentumOptimizer:
                 "momentum-free optimizer (e.g. SGD) and pass momentum= here")
         self.inner_optimizer = inner_optimizer
         self.momentum = float(momentum)
-        self.sparsity = float(sparsity)  # fraction DROPPED (reference: 99.9%)
+        # fraction DROPPED. The reference config format is a RAMP
+        # (list[float], e.g. [0.75, 0.9375, 0.984, 0.996, 0.999]) applied
+        # over post-warmup steps; a scalar means a constant ramp of one.
+        if isinstance(sparsity, (list, tuple)):
+            self._sparsity_ramp = [float(s) for s in sparsity] or [0.999]
+        else:
+            self._sparsity_ramp = [float(sparsity)]
         self.rampup_begin_step = int(rampup_begin_step)
         self._allreduce = allreduce
         self._u = {}  # momentum-corrected velocity per param
@@ -235,16 +241,19 @@ class DGCMomentumOptimizer:
         params = [p for p in (self.inner_optimizer._parameter_list or [])
                   if not p.stop_gradient and p.grad is not None]
         if self._steps <= self.rampup_begin_step:
-            # warmup: FULL momentum update, no sparsification (reference
-            # DGCMomentumOptimizer is a Momentum subclass — pre-rampup
-            # training is momentum SGD, not plain SGD)
+            # warmup: dense allreduce of the RAW gradient + FULL momentum
+            # update, no sparsification (reference DGCMomentumOptimizer is
+            # a Momentum subclass and allreduces dense pre-rampup — ranks
+            # must not desync during warmup)
             for p in params:
                 g = p.grad._value if isinstance(p.grad, Tensor) else p.grad
                 if isinstance(g, SelectedRows):
                     g = g.to_dense()
+                g = jnp.asarray(g)
+                if self._allreduce is not None:
+                    g = jnp.asarray(self._allreduce(g))
                 u = self._u.get(id(p))
-                u = jnp.asarray(g) if u is None else \
-                    self.momentum * u + jnp.asarray(g)
+                u = g if u is None else self.momentum * u + g
                 self._u[id(p)] = u
                 p.grad = u
             self.inner_optimizer.step()
@@ -259,7 +268,9 @@ class DGCMomentumOptimizer:
             u = g if u is None else self.momentum * u + g  # momentum corr.
             v = u if v is None else v + u                  # local accumulate
             flat = v.reshape(-1)
-            k = max(1, int(flat.size * (1.0 - self.sparsity)))
+            ramp_i = min(self._steps - self.rampup_begin_step - 1,
+                         len(self._sparsity_ramp) - 1)
+            k = max(1, int(flat.size * (1.0 - self._sparsity_ramp[ramp_i])))
             thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
             mask = jnp.abs(v) >= thresh
             send = jnp.where(mask, v, 0.0)

@@ -220,7 +220,9 @@ class TestDGCFleetMomentumLift:
         assert inner._momentum == 0.0  # not applied twice
 
     def test_warmup_uses_momentum(self):
-        # pre-rampup: velocity accumulates (momentum SGD, not plain SGD)
+        # pre-rampup: velocity accumulates (momentum SGD, not plain SGD).
+        # lr=0 keeps weights fixed, so both steps see the SAME raw grad g0
+        # and after two steps u must be 0.5*g0 + g0 = 1.5*g0.
         paddle.seed(0)
         net = nn.Linear(4, 2)
         inner = paddle.optimizer.SGD(parameters=net.parameters(),
@@ -228,11 +230,48 @@ class TestDGCFleetMomentumLift:
         opt = DGCMomentumOptimizer(inner, momentum=0.5, rampup_begin_step=10)
         x, y = _data(din=4)
         ce = nn.CrossEntropyLoss()
+        g0 = None
         for _ in range(2):
             loss = ce(net(paddle.to_tensor(x[:8])), paddle.to_tensor(y[:8]))
             loss.backward()
+            if g0 is None:
+                g0 = np.asarray(net.weight.grad).copy()  # BEFORE step()
             opt.step()
-            g2 = np.asarray(net.weight.grad)
             opt.clear_grad()
         u = np.asarray(opt._u[id(net.weight)])
-        np.testing.assert_allclose(u, g2, rtol=1e-6)  # grad IS the velocity
+        np.testing.assert_allclose(u, 1.5 * g0, rtol=1e-5)
+
+    def test_warmup_allreduces_dense(self):
+        # pre-rampup multi-rank: raw grads must still go through the
+        # injected allreduce or ranks desync during warmup
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.1)
+        calls = []
+        opt = DGCMomentumOptimizer(inner, rampup_begin_step=5,
+                                   allreduce=lambda g: (calls.append(1), g)[1])
+        x, y = _data(din=4)
+        ce = nn.CrossEntropyLoss()
+        loss = ce(net(paddle.to_tensor(x[:8])), paddle.to_tensor(y[:8]))
+        loss.backward()
+        opt.step()
+        assert len(calls) == len(list(net.parameters()))
+
+    def test_reference_list_sparsity_ramp(self):
+        paddle.seed(0)
+        net = nn.Linear(16, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.0)
+        opt = DGCMomentumOptimizer(inner, momentum=0.0,
+                                   sparsity=[0.5, 0.9])  # reference format
+        x, y = _data()
+        ce = nn.CrossEntropyLoss()
+        for expected_keep in (0.5, 0.1):
+            loss = ce(net(paddle.to_tensor(x[:32])), paddle.to_tensor(y[:32]))
+            loss.backward()
+            opt.step()
+            g = np.asarray(net.weight.grad)
+            nz = (g != 0).sum()
+            assert nz <= int(g.size * expected_keep) + 2, (expected_keep, nz)
+            opt.clear_grad()

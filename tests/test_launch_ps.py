@@ -41,6 +41,7 @@ def test_launch_ps_mode(tmp_path):
         capture_output=True, text=True, timeout=120,
         cwd=os.path.join(os.path.dirname(__file__), ".."),
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
-    trainer_log = open(os.path.join(log_dir, "trainerlog.0")).read()
+    tl_path = os.path.join(log_dir, "trainerlog.0")
+    trainer_log = open(tl_path).read() if os.path.exists(tl_path) else "<no log>"
     assert p.returncode == 0, (p.stdout, p.stderr, trainer_log)
     assert "TRAINER_OK" in trainer_log, trainer_log
