@@ -6,9 +6,13 @@ Built from `csrc/` on first import (g++ -O2 -shared), cached under
   - stats monitor (platform/monitor.cc STAT_ADD parity)
   - threadpool batch assembler + aligned host buffers (buffered_reader /
     DataLoader-worker hot loop)
+  - C inference API client (predict_capi.cpp) and AES-128-CTR model
+    crypto (crypto.cpp) — these two are native-ONLY (no python fallback;
+    framework.crypto raises a clear error without a toolchain)
 
-Everything has a pure-python fallback, so the package works even where the
-toolchain is unavailable; `available()` reports which path is active.
+The store/monitor/assembler components have pure-python fallbacks, so the
+core package works even where the toolchain is unavailable; `available()`
+reports which path is active.
 """
 from __future__ import annotations
 
@@ -28,7 +32,8 @@ _lock = threading.Lock()
 
 def _sources():
     return [os.path.join(_CSRC, f)
-            for f in ("tcpstore.cpp", "runtime.cpp", "predict_capi.cpp")]
+            for f in ("tcpstore.cpp", "runtime.cpp", "predict_capi.cpp",
+                      "crypto.cpp")]
 
 
 def _src_hash() -> str:

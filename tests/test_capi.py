@@ -20,7 +20,7 @@ class PD_Tensor(ctypes.Structure):
 @pytest.fixture(scope="module")
 def capi():
     lib = _native._load()
-    if lib is None:
+    if not lib:  # _load() returns False when the toolchain is absent
         pytest.skip("native toolchain unavailable")
     lib.PD_PredictorCreate.restype = ctypes.c_void_p
     lib.PD_PredictorCreate.argtypes = [ctypes.c_char_p, ctypes.c_int]
