@@ -53,6 +53,7 @@ from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
 from . import distributed  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
+from .hapi.dynamic_flops import flops  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401  (paddle.nn.Layer also reachable)
 

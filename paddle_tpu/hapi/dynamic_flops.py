@@ -1,0 +1,79 @@
+"""paddle.flops — per-layer FLOPs estimation.
+
+Reference parity: `python/paddle/hapi/dynamic_flops.py` (`paddle.flops`:
+forward hooks count multiply-adds per supported layer; prints a table and
+returns the total).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+
+def _numel(shape):
+    return int(np.prod(shape)) if shape else 1
+
+
+def _count(layer, x, y):
+    """FLOPs for one forward call of `layer` (x: first input, y: output)."""
+    from ..nn.layer.conv import _ConvNd
+    out_e = _numel(y.shape)
+    if isinstance(layer, _ConvNd):  # covers Conv*D AND Conv*DTranspose
+        # MACs per output element = Cin/groups * prod(K) for both
+        # orientations (transpose weights are [Cin, Cout/g, K...])
+        kk = _numel(layer.kernel_size)
+        kin = (layer.in_channels // layer.groups) * kk
+        return 2 * kin * out_e
+    if isinstance(layer, nn.Linear):
+        return 2 * layer.weight.shape[0] * out_e
+    if isinstance(layer, (nn.BatchNorm1D, nn.BatchNorm2D, nn.LayerNorm)):
+        return 2 * out_e
+    if type(layer).__name__.endswith(("Pool2D", "Pool1D", "Pool3D")):
+        return _numel(x.shape)
+    if isinstance(layer, (nn.ReLU, nn.GELU, nn.Sigmoid)):
+        return out_e
+    return 0
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
+    """Count forward FLOPs of `net` on a dummy input of `input_size`
+    (paddle.flops parity). custom_ops: {LayerType: fn(layer, x, y) -> int}."""
+    counts = []
+    hooks = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, output):
+            x = inputs[0] if inputs else None
+            y = output[0] if isinstance(output, (list, tuple)) else output
+            if not isinstance(y, Tensor):
+                return
+            fn = (custom_ops or {}).get(type(lyr))
+            n = fn(lyr, x, y) if fn else _count(lyr, x, y)
+            if n:
+                counts.append((type(lyr).__name__, n))
+        return hook
+
+    seen = set()
+    for lyr in net.sublayers(include_self=True):
+        # leaves only, ONE hook per object: a weight-shared layer appears
+        # once per registration but must count once per forward call
+        if not lyr._sub_layers and id(lyr) not in seen:
+            seen.add(id(lyr))
+            hooks.append(lyr.register_forward_post_hook(make_hook(lyr)))
+    was_training = net.training
+    net.eval()
+    try:
+        net(Tensor(np.zeros(input_size, np.float32)))
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    total = sum(n for _, n in counts)
+    if print_detail:
+        for name, n in counts:
+            print(f"{name:<24}{n:>16,}")
+        print(f"{'Total FLOPs':<24}{total:>16,}")
+    return total

@@ -1,0 +1,156 @@
+"""Filesystem wrappers: LocalFS + HDFS client surface.
+
+Reference parity: `paddle/fluid/framework/io/fs.cc` / python
+`fluid/incubate/fleet/utils/fs.py` (LocalFS, HDFSClient with
+ls_dir/is_file/mkdirs/delete/mv/upload/download) — used by distributed
+checkpointing and dataset ingestion.
+
+TPU-native note: checkpoints here are local/NFS paths (sharded_io);
+HDFSClient keeps the API shape and shells out to a configured `hadoop`
+binary when one exists, so PS-era ingest scripts port unchanged on hosts
+that have the client installed.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Tuple
+
+
+class LocalFS:
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        """(dirs, files) — reference LocalFS::ls_dir split."""
+        if not os.path.exists(path):
+            return [], []
+        entries = sorted(os.listdir(path))
+        dirs = [e for e in entries if os.path.isdir(os.path.join(path, e))]
+        files = [e for e in entries if not os.path.isdir(os.path.join(path, e))]
+        return dirs, files
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def is_file(self, path) -> bool:
+        return os.path.isfile(path)
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(dst)
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+
+class HDFSClient:
+    """HDFS surface over the `hadoop fs` CLI (fs.cc shells out the same
+    way); raises a clear error when no hadoop binary is configured."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else shutil.which("hadoop")
+        self._configs = configs or {}
+
+    def _run_raw(self, *args):
+        """CompletedProcess from `hadoop fs <args>`; infra failures (no
+        binary, hang) become RuntimeError uniformly."""
+        if not self._hadoop:
+            raise RuntimeError(
+                "HDFSClient: no hadoop binary found — set hadoop_home or "
+                "install the client (LocalFS covers local checkpoints)")
+        cfg = []
+        for k, v in self._configs.items():
+            cfg += ["-D", f"{k}={v}"]
+        try:
+            return subprocess.run([self._hadoop, "fs"] + cfg + list(args),
+                                  capture_output=True, text=True, timeout=300)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                f"HDFSClient: hadoop binary not runnable: {self._hadoop}"
+            ) from e
+        except subprocess.TimeoutExpired as e:
+            raise RuntimeError(
+                f"HDFSClient: hadoop fs {' '.join(args)} timed out") from e
+
+    def _run(self, *args) -> str:
+        r = self._run_raw(*args)
+        if r.returncode != 0:
+            raise RuntimeError(f"hadoop fs {' '.join(args)}: {r.stderr[:400]}")
+        return r.stdout
+
+    def _test(self, flag, path) -> bool:
+        # `-test` exits 1 for "no" — every OTHER failure (auth, namenode
+        # down) must propagate, not read as "path absent"
+        r = self._run_raw("-test", flag, path)
+        if r.returncode == 0:
+            return True
+        if r.returncode == 1 and not r.stderr.strip():
+            return False
+        raise RuntimeError(f"hadoop fs -test {flag}: {r.stderr[:400]}")
+
+    def is_exist(self, path) -> bool:
+        return self._test("-e", path)
+
+    def is_file(self, path) -> bool:
+        return self._test("-f", path)
+
+    def is_dir(self, path) -> bool:
+        return self._test("-d", path)
+
+    def ls_dir(self, path):
+        """(dirs, files) as BASENAMES — same contract as LocalFS.ls_dir
+        (split on the 8th field so names with spaces survive)."""
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split(None, 7)
+            if len(parts) < 8 or parts[0].startswith("Found"):
+                continue
+            name = os.path.basename(parts[7].rstrip("/"))
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if not exist_ok and self.is_exist(path):
+            raise FileExistsError(path)
+        self._run("-touchz", path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)

@@ -275,3 +275,34 @@ class TestDGCFleetMomentumLift:
             nz = (g != 0).sum()
             assert nz <= int(g.size * expected_keep) + 2, (expected_keep, nz)
             opt.clear_grad()
+
+
+class TestFS:
+    def test_localfs_surface(self, tmp_path):
+        from paddle_tpu.utils.fs import LocalFS
+        fs = LocalFS()
+        d = tmp_path / "a"
+        fs.mkdirs(str(d / "sub"))
+        fs.touch(str(d / "f.txt"))
+        dirs, files = fs.ls_dir(str(d))
+        assert dirs == ["sub"] and files == ["f.txt"]
+        assert fs.is_file(str(d / "f.txt")) and fs.is_dir(str(d / "sub"))
+        fs.mv(str(d / "f.txt"), str(d / "g.txt"))
+        assert not fs.is_exist(str(d / "f.txt"))
+        with pytest.raises(FileExistsError):
+            fs.mv(str(d / "g.txt"), str(d / "sub"), overwrite=False)
+        fs.upload(str(d / "g.txt"), str(tmp_path / "up.txt"))
+        assert fs.is_file(str(tmp_path / "up.txt"))
+        fs.delete(str(d))
+        assert not fs.is_exist(str(d))
+
+    def test_hdfs_without_client_raises_clearly(self):
+        from paddle_tpu.utils.fs import HDFSClient
+        c = HDFSClient(hadoop_home="/nonexistent")
+        import os
+        if os.path.exists("/nonexistent/bin/hadoop"):
+            pytest.skip("unexpected hadoop install")
+        with pytest.raises(RuntimeError):
+            c.mkdirs("/tmp/x")
+        with pytest.raises(RuntimeError):
+            c.is_exist("/anything")  # infra failure must NOT read as absent

@@ -204,3 +204,35 @@ class TestSmallNets:
         net.eval()
         out, a1, a2 = net(paddle.to_tensor(np.zeros((1, 3, 224, 224), np.float32)))
         assert out.shape == [1, 10] and a1.shape == [1, 10] and a2.shape == [1, 10]
+
+
+class TestFlops:
+    def test_resnet18_flops_close_to_published(self):
+        # ResNet-18 @224: ~1.82 GFLOPs (2x MACs) published
+        net = models.resnet18()
+        g = paddle.flops(net, (1, 3, 224, 224))
+        assert 3.2e9 < g < 4.2e9, g  # 2*MACs convention ~3.6e9
+
+    def test_linear_flops_exact(self):
+        import paddle_tpu.nn as nn
+        net = nn.Linear(8, 4)
+        assert paddle.flops(net, (2, 8)) == 2 * 8 * 4 * 2  # 2*in*out*batch
+
+    def test_custom_ops_hook(self):
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+        n = paddle.flops(net, (1, 4),
+                         custom_ops={nn.ReLU: lambda l, x, y: 1000})
+        assert n == 2 * 4 * 4 + 1000
+
+    def test_transpose_conv_counted(self):
+        import paddle_tpu.nn as nn
+        net = nn.Conv2DTranspose(3, 8, 3)
+        n = paddle.flops(net, (1, 3, 8, 8))
+        assert n > 0  # decoders/GANs must not read as 0 FLOPs
+
+    def test_shared_layer_counts_per_call_not_per_registration(self):
+        import paddle_tpu.nn as nn
+        shared = nn.Linear(4, 4)
+        net = nn.Sequential(shared, shared)
+        assert paddle.flops(net, (1, 4)) == 2 * (2 * 4 * 4)  # 2 calls x 32
