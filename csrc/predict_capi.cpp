@@ -150,6 +150,10 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
   if (status != 0) {
     uint32_t len = 0;
     if (!recv_exact(p->fd, &len, 4)) return 2;
+    if (len > (64u << 10)) {  // cap: corrupt length must not drive alloc
+      p->last_error = "implausible error-message length";
+      return 2;
+    }
     std::vector<char> msg(len);
     if (!recv_exact(p->fd, msg.data(), len)) return 2;
     p->last_error.assign(msg.data(), len);

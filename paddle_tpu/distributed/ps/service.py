@@ -26,6 +26,10 @@ import numpy as np
 from .table import DenseTable, SparseTable
 
 _HDR = struct.Struct("<B16sqq")  # cmd, table name (padded), n, dim
+# payload plausibility caps (the header fields are client-controlled)
+_MAX_PAYLOAD_ROWS = 1 << 24      # 16M ids per request
+_MAX_PAYLOAD_DIM = 1 << 16       # 64K embedding width
+_MAX_PAYLOAD_ELEMS = 1 << 28     # 256M f32 elems ≈ 1 GiB
 _LEN = struct.Struct("<q")
 CMD_PULL_SPARSE = 1
 CMD_PUSH_SPARSE = 2
@@ -143,6 +147,16 @@ class PsServer:
                 hdr = _recv_exact(conn, _HDR.size)
                 cmd, name, n, dim = _HDR.unpack(hdr)
                 name = name.rstrip(b"\0").decode()
+                # bound the (client-controlled) payload size before any
+                # allocation: a corrupt/hostile header must produce an
+                # error frame + connection drop, not a multi-GB buffer or
+                # a dead handler thread
+                if not (0 <= n <= _MAX_PAYLOAD_ROWS
+                        and 0 <= dim <= _MAX_PAYLOAD_DIM
+                        and n * max(dim, 1) <= _MAX_PAYLOAD_ELEMS):
+                    _send_err(conn, f"ps: implausible header n={n} "
+                                    f"dim={dim}")
+                    return
                 # read the FULL request payload before processing so an
                 # error reply leaves the stream in sync for the next request
                 ids = grads = None

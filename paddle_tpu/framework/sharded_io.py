@@ -25,6 +25,17 @@ import numpy as np
 import jax
 
 
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype from a manifest string, resolving extension dtypes
+    (bfloat16, float8_*) through ml_dtypes — np.dtype('bfloat16') alone
+    raises TypeError on stock numpy."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def save_sharded(state: Dict[str, object], dirname: str,
                  process_index: Optional[int] = None):
     """Write this process's addressable shards of every array in `state`
@@ -72,11 +83,15 @@ def load_sharded(dirname: str, shardings: Optional[Dict] = None,
         proc = os.path.basename(mpath)[len("manifest-p"):-len(".json")]
         blobs = np.load(os.path.join(dirname, f"shards-p{proc}.npz"))
         for name, meta in manifest["arrays"].items():
+            want = _np_dtype(meta["dtype"])
             if name not in arrays:
-                arrays[name] = np.zeros(meta["shape"],
-                                        np.dtype(meta["dtype"]))
+                arrays[name] = np.zeros(meta["shape"], want)
             for sh in meta.get("shards", []):
                 data = blobs[sh["key"]]
+                if data.dtype != want:
+                    # npz stores ml_dtypes (bfloat16, …) as raw void bytes
+                    # ('|V2'); re-view with the manifest dtype.
+                    data = np.ascontiguousarray(data).view(want)
                 if sh["index"] is None:
                     arrays[name] = data
                 else:
@@ -139,3 +154,8 @@ class AutoCheckpoint:
             if os.path.isdir(prev):
                 import shutil
                 shutil.rmtree(prev, ignore_errors=True)
+            elif os.path.isfile(prev):  # save_fn may write one file per snap
+                try:
+                    os.remove(prev)
+                except OSError:
+                    pass

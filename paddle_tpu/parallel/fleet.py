@@ -95,6 +95,17 @@ def distributed_optimizer(optimizer, strategy=None):
     API (`fleet.utils.recompute`) applied at model level."""
     from .hybrid_optimizer import HybridParallelOptimizer
     st = strategy or _FLEET["strategy"]
+    dgc_inner_m = 0.0
+    if st is not None and getattr(st, "dgc", False):
+        # DGC lifts momentum out of the inner optimizer; work on a shallow
+        # copy so the caller's object (and its state_dict) is untouched
+        dgc_inner_m = float(getattr(optimizer, "_momentum", 0.0) or 0.0)
+        if dgc_inner_m > 0:
+            import copy
+            optimizer = copy.copy(optimizer)
+            optimizer._momentum = 0.0
+            if hasattr(optimizer, "_jit_cache"):
+                optimizer._jit_cache = {}
     opt = HybridParallelOptimizer(optimizer, get_hybrid_group(), st)
     if st is not None and getattr(st, "gradient_merge", False):
         from .meta_optimizers import GradientMergeOptimizer
@@ -112,15 +123,8 @@ def distributed_optimizer(optimizer, strategy=None):
         # dgc=True: lift the inner momentum into DGC (which IS the
         # momentum optimizer) so it isn't applied twice
         momentum = cfg.get("momentum")
-        inner_m = float(getattr(optimizer, "_momentum", 0.0) or 0.0)
         if momentum is None:
-            momentum = inner_m if inner_m > 0 else 0.9
-        if inner_m > 0:
-            # DGC owns momentum now; drop any fused update already traced
-            # with the old coefficient (it's baked into the jit, and the
-            # cache key doesn't include it)
-            optimizer._momentum = 0.0
-            getattr(optimizer, "_jit_cache", {}).clear()
+            momentum = dgc_inner_m if dgc_inner_m > 0 else 0.9
         opt = DGCMomentumOptimizer(
             opt, momentum=momentum, sparsity=cfg.get("sparsity", 0.999),
             rampup_begin_step=cfg.get("rampup_begin_step", 0))

@@ -217,7 +217,14 @@ class TestDGCFleetMomentumLift:
         opt = fleet.distributed_optimizer(inner, st)
         assert isinstance(opt, DGCMomentumOptimizer)
         assert opt.momentum == 0.7
-        assert inner._momentum == 0.0  # not applied twice
+        # the caller's optimizer object is NOT mutated (advisor finding) —
+        # DGC works on a momentum-free copy so momentum isn't applied twice
+        assert inner._momentum == 0.7
+        chain = opt
+        while "_momentum" not in getattr(chain, "__dict__", {}):
+            chain = chain.__dict__.get("inner_optimizer") \
+                or chain.__dict__.get("_inner_opt")
+        assert chain._momentum == 0.0 and chain is not inner
 
     def test_warmup_uses_momentum(self):
         # pre-rampup: velocity accumulates (momentum SGD, not plain SGD).

@@ -26,6 +26,17 @@ class TestShardedCheckpoint:
         # re-placed with the requested sharding
         assert got["w"].sharding.shard_shape(got["w"].shape) == (4, 2)
 
+    def test_bfloat16_roundtrip(self, tmp_path):
+        # npz stores ml_dtypes bf16 as raw '|V2' bytes; load must re-view
+        # with the manifest dtype (primary TPU param dtype)
+        import jax.numpy as jnp
+        x = jnp.arange(16, dtype=jnp.bfloat16).reshape(4, 4) * 0.25
+        save_sharded({"w": x}, str(tmp_path))
+        out = load_sharded(str(tmp_path))
+        assert str(out["w"].dtype) == "bfloat16"
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(out["w"], np.float32))
+
     def test_missing_checkpoint_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError):
             load_sharded(str(tmp_path / "nope"))
