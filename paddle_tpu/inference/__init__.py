@@ -83,7 +83,11 @@ class PredictorTensor:
         self._is_input = is_input
 
     def copy_from_cpu(self, arr):
-        self._pred._feeds[self.name] = jnp.asarray(np.asarray(arr))
+        a = jnp.asarray(np.asarray(arr))
+        # cast once at feed time, not in every run() (predictor hot loop)
+        if self._pred._bf16 and jnp.issubdtype(a.dtype, jnp.floating):
+            a = a.astype(jnp.bfloat16)
+        self._pred._feeds[self.name] = a
 
     def reshape(self, shape):
         pass
@@ -115,9 +119,12 @@ class Predictor:
                     f"Cannot open model file {path}.pdmodel\n"
                     "  [Hint] save the model with paddle_tpu.jit.save first.")
             self._translated = jload(path)
-            n_in = len(self._translated._meta["input_specs"])
-            self._input_names = [f"input_{i}" for i in range(n_in)]
-            self._bf16 = cfg.precision() in ("float16", "bfloat16", "half")
+            specs = self._translated._meta["input_specs"]
+            self._input_names = [f"input_{i}" for i in range(len(specs))]
+            # an artifact exported with save(precision="bfloat16") needs bf16
+            # feeds regardless of what the Config says
+            self._bf16 = (cfg.precision() in ("float16", "bfloat16", "half")
+                          or any(s.get("dtype") == "bfloat16" for s in specs))
         else:
             layer = config_or_layer
             layer.eval()
@@ -148,7 +155,9 @@ class Predictor:
         else:
             arrs = [self._feeds[n] for n in self._input_names]
         if self._bf16:
-            arrs = [a.astype(jnp.bfloat16) if jnp.issubdtype(a.dtype, jnp.floating) else a
+            arrs = [a.astype(jnp.bfloat16)
+                    if jnp.issubdtype(a.dtype, jnp.floating)
+                    and a.dtype != jnp.bfloat16 else a
                     for a in arrs]
         if self._translated is not None:
             out = self._translated(*arrs)

@@ -48,6 +48,26 @@ def save(layer, path, input_spec=None, **configs):
     parrs = [trainable[n]._value for n in pnames]
     barrs = [frozen[n]._value for n in bnames]
 
+    # Inference precision is decided at export, the TPU-native analog of the
+    # reference predictor rebuilding a TRT/mkldnn engine per precision mode
+    # (paddle_analysis_config.h precision_mode): params and float inputs are
+    # cast so XLA keeps every conv/matmul on the bf16 MXU path.
+    precision = configs.pop("precision", None)
+    if precision not in (None, "float32", "bfloat16", "float16", "half",
+                         "bf16", "fp16"):
+        raise ValueError(f"unsupported save precision {precision!r}; "
+                         "use 'float32' or 'bfloat16'")
+    if precision in ("bfloat16", "float16", "half", "bf16", "fp16"):
+        cast = jnp.bfloat16  # fp16 maps to bf16 on TPU (same MXU path)
+        parrs = [a.astype(cast) if jnp.issubdtype(a.dtype, jnp.floating) else a
+                 for a in parrs]
+        barrs = [a.astype(cast) if jnp.issubdtype(a.dtype, jnp.floating) else a
+                 for a in barrs]
+        specs = [InputSpec(s.shape, "bfloat16" if np.issubdtype(np.dtype(s.dtype),
+                                                                np.floating) else s.dtype,
+                           getattr(s, "name", None))
+                 for s in specs]
+
     from .functional import functional_call
 
     def pure(params, buffers, *inputs):
@@ -73,6 +93,9 @@ def save(layer, path, input_spec=None, **configs):
     meta = {"input_specs": [{"shape": list(s.shape), "dtype": np.dtype(s.dtype).name}
                             for s in specs],
             "param_names": pnames, "buffer_names": bnames,
+            # npz stores bf16 as raw void ('|V2'); dtypes let load re-view
+            "param_dtypes": [np.dtype(a.dtype).name for a in parrs],
+            "buffer_dtypes": [np.dtype(a.dtype).name for a in barrs],
             # version stamping (framework/version.cc + op_version_registry)
             "framework_version": FRAMEWORK_VERSION,
             "op_versions": GLOBAL_OP_VERSION_REGISTRY.snapshot()}
@@ -132,6 +155,15 @@ def load(path, **configs):
         import warnings
         warnings.warn(f"op semantics changed since save: {msg}")
     data = np.load(path + ".pdiparams.npz")
-    params = [jnp.asarray(data[f"p::{n}"]) for n in meta["param_names"]]
-    buffers = [jnp.asarray(data[f"b::{n}"]) for n in meta["buffer_names"]]
+
+    def _blob(key, dtype_name):
+        a = data[key]
+        if dtype_name and a.dtype != np.dtype(dtype_name):
+            a = a.view(np.dtype(dtype_name))
+        return jnp.asarray(a)
+
+    pdt = meta.get("param_dtypes") or [None] * len(meta["param_names"])
+    bdt = meta.get("buffer_dtypes") or [None] * len(meta["buffer_names"])
+    params = [_blob(f"p::{n}", d) for n, d in zip(meta["param_names"], pdt)]
+    buffers = [_blob(f"b::{n}", d) for n, d in zip(meta["buffer_names"], bdt)]
     return TranslatedLayer(exported, params, buffers, meta)
