@@ -91,11 +91,14 @@ def bench_ernie_train(backend):
     sps, spread = _median_rate(run, n_steps, reps, batch)
 
     # train matmul FLOPs/sample ~= 6*N_matmul*S + 3*L*4*S^2*H (PaLM-style)
+    # + the weight-tied MLM head (6*S*H*V: its [V,H] weight is the embedding
+    # table, excluded from n_matmul, but its 3 matmuls are ~25% of the work)
     h = base.embeddings.word_embeddings.weight.shape[1]
     nlayers = len(base.layers)
     n_matmul = sum(int(np.prod(p.shape)) for p in net.parameters()
                    if len(p.shape) == 2 and p.shape[0] != vocab)
-    flops_sample = 6 * n_matmul * seqlen + 3 * nlayers * 4 * seqlen ** 2 * h
+    flops_sample = (6 * n_matmul * seqlen + 3 * nlayers * 4 * seqlen ** 2 * h
+                    + 6 * seqlen * h * vocab)
     mfu = sps * flops_sample / PEAK_FLOPS if backend == "tpu" else 0.0
     return {"samples_per_sec": round(sps, 2), "spread": round(spread, 3),
             "mfu": round(mfu, 4), "batch": batch, "seqlen": seqlen}

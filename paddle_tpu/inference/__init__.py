@@ -121,10 +121,12 @@ class Predictor:
             self._translated = jload(path)
             specs = self._translated._meta["input_specs"]
             self._input_names = [f"input_{i}" for i in range(len(specs))]
-            # an artifact exported with save(precision="bfloat16") needs bf16
-            # feeds regardless of what the Config says
-            self._bf16 = (cfg.precision() in ("float16", "bfloat16", "half")
-                          or any(s.get("dtype") == "bfloat16" for s in specs))
+            # the artifact's exported signature decides the feed dtype: a
+            # bf16-saved model needs bf16 feeds even if the Config is silent,
+            # and a fp32-saved model must NOT have its feeds cast no matter
+            # what precision the Config asks for (the StableHLO signature is
+            # fixed at save time; precision is an export-time choice here)
+            self._bf16 = any(s.get("dtype") == "bfloat16" for s in specs)
         else:
             layer = config_or_layer
             layer.eval()

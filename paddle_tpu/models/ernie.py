@@ -174,10 +174,15 @@ class ErnieForPretraining(nn.Layer):
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         seq, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
         h = self.transform_norm(self.transform_act(self.transform(seq)))
-        # weight-tied MLM logits against the (possibly vocab-sharded) embedding
+        # weight-tied MLM logits against the (possibly vocab-sharded) embedding.
+        # Flatten to 2D first: a batched [B,S,H]x[V,H]^T dot picks a
+        # {1,2,0} output layout that costs a full-logits relayout copy
+        # (250MB at vocab 30k) before the loss consumes it.
         from ..ops.math import matmul
         w = self.ernie.embeddings.word_embeddings.weight
-        logits = matmul(h, w, transpose_y=True)
+        b, s = h.shape[0], h.shape[1]
+        logits = matmul(h.reshape([-1, h.shape[-1]]), w, transpose_y=True)
+        logits = logits.reshape([b, s, logits.shape[-1]])
         return logits, self.nsp(pooled)
 
 
