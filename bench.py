@@ -222,13 +222,15 @@ def bench_flash_attention(backend):
     if backend != "tpu":
         return {"skipped": "needs real chip"}
     bh, s, d = 12, 8192, 64  # GPT/ERNIE-base head config at long context
-    q = jnp.asarray(np.random.rand(bh, s, d).astype(np.float32) * 0.1)
-    k = jnp.asarray(np.random.rand(bh, s, d).astype(np.float32) * 0.1)
-    v = jnp.asarray(np.random.rand(bh, s, d).astype(np.float32) * 0.1)
+    # bf16 inputs: the training dtype, and what keeps the kernel's dots on
+    # the full-rate MXU path
+    q = jnp.asarray(np.random.rand(bh, s, d).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+    k = jnp.asarray(np.random.rand(bh, s, d).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+    v = jnp.asarray(np.random.rand(bh, s, d).astype(np.float32) * 0.1).astype(jnp.bfloat16)
 
     def make(fn):
         def loss(a, b, c):
-            return (fn(a, b, c) ** 2).sum()
+            return (fn(a, b, c).astype(jnp.float32) ** 2).sum()
         g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
         def run(n):
@@ -239,15 +241,23 @@ def bench_flash_attention(backend):
         return run
 
     flash = make(lambda a, b, c: _flash_core(a, b, c, True, 512, 512, False))
-    ref = make(lambda a, b, c: _reference_bhsd(a, b, c, True))
+    # baseline = the FASTER fused-XLA variant at this size: upcasting to
+    # f32 before the einsums (21 steps/s) beats native-bf16 dots (2.7 —
+    # the autodiff-saved extra bf16 copy of the 3.2GB score matrix thrashes
+    # HBM); comparing against the strongest baseline keeps speedup honest
+    ref = make(lambda a, b, c: _reference_bhsd(
+        a.astype(jnp.float32), b.astype(jnp.float32),
+        c.astype(jnp.float32), True).astype(a.dtype))
     results = {}
-    for name, run in (("flash", flash), ("xla_ref", ref)):
-        _sync(run(1))
+    # spans long enough that the ~0.1s tunnel sync RTT stays <10% of the
+    # timed region (the flash step is ~7.4ms on device)
+    for name, run, n in (("flash", flash, 150), ("xla_ref", ref, 60)):
+        _sync(run(2))
         rates = []
         for _ in range(3):
             t0 = time.perf_counter()
-            _sync(run(5))
-            rates.append(5 / (time.perf_counter() - t0))
+            _sync(run(n))
+            rates.append(n / (time.perf_counter() - t0))
         results[name] = statistics.median(rates)
     # fwd 4*S^2*D matmul flops per bh slice, halved for causal; bwd ~2.5x
     flops_step = 3.5 * 4 * s * s * d * bh * 0.5
@@ -255,7 +265,13 @@ def bench_flash_attention(backend):
             "xla_steps_per_sec": round(results["xla_ref"], 2),
             "flash_speedup": round(results["flash"] / results["xla_ref"], 3),
             "flash_mfu": round(results["flash"] * flops_step / PEAK_FLOPS, 4),
-            "seq": s}
+            "seq": s,
+            # roofline: at head_dim 64 every qk^T/pv/dq dot leaves half the
+            # 128-lane MXU contraction/output dim idle, capping the nominal
+            # MFU ceiling near 0.5 for this head geometry; the kernel runs
+            # at ~45% of that d64 ceiling (device step 7.4ms: fwd 2.0,
+            # dq 2.1, dkv 3.1 per profiler)
+            "roofline": "d64 halves MXU-> ceiling ~0.5 nominal MFU"}
 
 
 def main():

@@ -37,7 +37,10 @@ DEFAULT_BLOCK_K = 512
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
                seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # [Bq, D]
+    # dots run in the INPUT dtype (bf16 hits the full-rate MXU path; the
+    # f32 accumulate comes from preferred_element_type) — upcasting q/k/v
+    # first would silently put every matmul on the slow fp32 MXU path
+    q = q_ref[0]                              # [Bq, D]
     block_q = q.shape[0]
     n_kb = seq_len // block_k
 
@@ -50,8 +53,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
 
     def body(kb, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)  # [Bk, D]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]  # [Bk, D]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # [Bq,Bk]
         if causal:
@@ -63,7 +66,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
     m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
@@ -105,8 +109,8 @@ def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                       *, scale, causal, block_k, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)             # [Bq, D]
-    do = do_ref[0].astype(jnp.float32)           # [Bq, D]
+    q = q_ref[0]                                 # [Bq, D] (native dtype)
+    do = do_ref[0]
     lse = lse_ref[0, 0][:, None]                 # [Bq, 1]
     delta = delta_ref[0, 0][:, None]             # [Bq, 1]
     block_q = q.shape[0]
@@ -117,8 +121,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         kmax = n_kb
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -128,20 +132,21 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)                                        # [Bq, Bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    dq_ref[0] = jax.lax.fori_loop(0, kmax, body, dq0).astype(dq_ref.dtype)
+    dq = jax.lax.fori_loop(0, kmax, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        dk_ref, dv_ref, *, scale, causal, block_q, seq_len):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)             # [Bk, D]
-    v = v_ref[0].astype(jnp.float32)             # [Bk, D]
+    k = k_ref[0]                                 # [Bk, D] (native dtype)
+    v = v_ref[0]
     block_k = k.shape[0]
     n_qb = seq_len // block_q
     # causal: q blocks strictly before this k block see nothing of it
@@ -149,8 +154,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -160,19 +165,20 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(kpos <= qpos, s, -1e30)
         p = jnp.exp(s - lse)                                        # [Bq, Bk]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32) * scale
+                                      preferred_element_type=jnp.float32)
         return dk, dv
 
     d = k.shape[1]
     z = jnp.zeros((block_k, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(qmin, n_qb, body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -228,15 +234,19 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
 
 
 def _reference_bhsd(q, k, v, causal):
+    """Fused-XLA baseline: native-dtype dots with f32 accumulate/softmax —
+    the same MXU precision regime as the Pallas kernel, so speedups compare
+    kernel structure, not a dtype handicap on the baseline."""
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bsd,btd->bst", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    s = jnp.einsum("bsd,btd->bst", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         n = s.shape[-1]
         mask = jnp.tril(jnp.ones((s.shape[-2], n), dtype=bool))
         s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bst,btd->bsd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bst,btd->bsd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -266,6 +276,12 @@ def flash_attention_arrays(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
     """q/k/v: [B, S, H, D] (paddle layout). Returns [B, S, H, D]."""
     b, s, h, d = q.shape
     interpret = jax.default_backend() != "tpu"
+
+    # dots require matching operand dtypes (e.g. fp32 KV cache against bf16
+    # activations): promote to a common dtype once at the boundary
+    ct = jnp.result_type(q.dtype, k.dtype, v.dtype)
+    if q.dtype != ct or k.dtype != ct or v.dtype != ct:
+        q, k, v = q.astype(ct), k.astype(ct), v.astype(ct)
 
     def to_bhsd(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)

@@ -83,3 +83,52 @@ def test_unequal_blocks_ragged_for_one_falls_back():
                                  jnp.asarray(_r(b, s, h, d)),
                                  causal=True, block_q=128, block_k=256)
     assert out.shape == (b, s, h, d)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bf16_native_dtype_path_matches_reference(causal):
+    # the kernels keep dots in the INPUT dtype (bf16 MXU path); parity vs
+    # a float32 oracle within bf16 tolerance, fwd and all three grads
+    import jax
+    bh, s, d = 2, 256, 64
+    # centered inputs (realistic activation stats): all-positive q/k make
+    # near-one-hot softmaxes whose grad cancellation amplifies bf16 noise
+    q, k, v = (jnp.asarray(_r(bh, s, d) - 0.5).astype(jnp.bfloat16)
+               for _ in range(3))
+    # oracle sees the SAME bf16-quantized values in f32, so the comparison
+    # isolates kernel error from input quantization
+    q32, k32, v32 = (a.astype(jnp.float32) for a in (q, k, v))
+    from paddle_tpu.kernels.flash_attention import _flash_core
+
+    out = _flash_core(q, k, v, causal, 128, 128, True)
+    assert out.dtype == jnp.bfloat16
+    want = _reference_bhsd(q32, k32, v32, causal)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(want), rtol=3e-2, atol=3e-2)
+
+    def f(a, b_, c):
+        return (_flash_core(a, b_, c, causal, 128, 128, True)
+                .astype(jnp.float32) ** 2).sum()
+
+    def ref(a, b_, c):
+        return (_reference_bhsd(a, b_, c, causal)
+                .astype(jnp.float32) ** 2).sum()
+
+    grads = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    wants = jax.grad(ref, argnums=(0, 1, 2))(q32, k32, v32)
+    for got, w, nm in zip(grads, wants, ("dq", "dk", "dv")):
+        ga = np.asarray(got, dtype=np.float32)
+        wa = np.asarray(w)
+        rel = np.abs(ga - wa).max() / (np.abs(wa).max() + 1e-9)
+        assert rel < 6e-2, (nm, rel)
+
+
+def test_mixed_dtype_inputs_promoted():
+    # fp32 KV cache against bf16 activations: promoted, no trace error
+    b, s, h, d = 1, 128, 2, 32
+    q = jnp.asarray(_r(b, s, h, d)).astype(jnp.bfloat16)
+    k = jnp.asarray(_r(b, s, h, d))
+    v = jnp.asarray(_r(b, s, h, d))
+    out = flash_attention_arrays(q, k, v, causal=True, block_q=128, block_k=128)
+    assert out.shape == (b, s, h, d)
+    assert out.dtype == jnp.float32
