@@ -93,7 +93,10 @@ class PredictorTensor:
         pass
 
     def copy_to_cpu(self):
-        return np.asarray(self._pred._results[self.name])
+        a = np.asarray(self._pred._results[self.name])
+        if a.dtype == np.dtype("bfloat16"):
+            a = a.astype(np.float32)  # bf16 artifacts read back as fp32
+        return a
 
     def share_external_data(self, tensor):
         self._pred._feeds[self.name] = tensor._value if isinstance(tensor, Tensor) else tensor
@@ -171,12 +174,16 @@ class Predictor:
             with no_grad():
                 out = self._fn(*[Tensor(a) for a in arrs])
         outs = out if isinstance(out, (list, tuple)) else [out]
-        outs = [o._value.astype(jnp.float32) if jnp.issubdtype(o._value.dtype, jnp.bfloat16)
-                else o._value for o in outs]
+        # keep raw (possibly bf16) device arrays: the fp32 view happens
+        # lazily in copy_to_cpu, so the hot loop issues exactly ONE device
+        # dispatch per run() (matters on high-latency dispatch paths)
+        outs = [o._value for o in outs]
         self._output_names = [f"output_{i}" for i in range(len(outs))]
         self._results = dict(zip(self._output_names, outs))
         if inputs is not None:
-            return [Tensor(o) for o in outs]
+            return [Tensor(o.astype(jnp.float32)
+                           if jnp.issubdtype(o.dtype, jnp.bfloat16) else o)
+                    for o in outs]
         return None
 
     # ZeroCopyRun parity

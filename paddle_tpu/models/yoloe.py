@@ -4,6 +4,9 @@ Reference parity: PP-YOLOE as served through Paddle Inference in the
 reference ecosystem (CSPRepResNet backbone + PAN neck + ET-head, simplified
 to the inference-relevant compute graph: RepVGG-style blocks fold to single
 convs at deploy time, which is what the XLA program sees anyway).
+
+`data_format="NHWC"` puts channels on the TPU lane dimension (same deploy
+layout rationale as models/resnet.py).
 """
 from __future__ import annotations
 
@@ -12,11 +15,13 @@ from ..ops.manipulation import concat
 
 
 class ConvBNAct(nn.Layer):
-    def __init__(self, in_c, out_c, k=3, stride=1, groups=1, act="silu"):
+    def __init__(self, in_c, out_c, k=3, stride=1, groups=1, act="silu",
+                 data_format="NCHW"):
         super().__init__()
         self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=(k - 1) // 2,
-                              groups=groups, bias_attr=False)
-        self.bn = nn.BatchNorm2D(out_c)
+                              groups=groups, bias_attr=False,
+                              data_format=data_format)
+        self.bn = nn.BatchNorm2D(out_c, data_format=data_format)
         self.act = nn.Silu() if act else None
 
     def forward(self, x):
@@ -25,34 +30,39 @@ class ConvBNAct(nn.Layer):
 
 
 class CSPResStage(nn.Layer):
-    def __init__(self, in_c, out_c, n_blocks, stride=2):
+    def __init__(self, in_c, out_c, n_blocks, stride=2, data_format="NCHW"):
         super().__init__()
-        self.down = ConvBNAct(in_c, out_c, 3, stride=stride)
+        df = data_format
+        self.down = ConvBNAct(in_c, out_c, 3, stride=stride, data_format=df)
         mid = out_c // 2
-        self.conv1 = ConvBNAct(out_c, mid, 1)
-        self.conv2 = ConvBNAct(out_c, mid, 1)
+        self.conv1 = ConvBNAct(out_c, mid, 1, data_format=df)
+        self.conv2 = ConvBNAct(out_c, mid, 1, data_format=df)
         self.blocks = nn.Sequential(*[
-            nn.Sequential(ConvBNAct(mid, mid, 3), ConvBNAct(mid, mid, 3))
+            nn.Sequential(ConvBNAct(mid, mid, 3, data_format=df),
+                          ConvBNAct(mid, mid, 3, data_format=df))
             for _ in range(n_blocks)])
-        self.fuse = ConvBNAct(out_c, out_c, 1)
+        self.fuse = ConvBNAct(out_c, out_c, 1, data_format=df)
+        self._cat_axis = -1 if df == "NHWC" else 1
 
     def forward(self, x):
         x = self.down(x)
         a = self.conv1(x)
         b = self.blocks(self.conv2(x))
-        return self.fuse(concat([a, b], axis=1))
+        return self.fuse(concat([a, b], axis=self._cat_axis))
 
 
 class PPYOLOEBackbone(nn.Layer):
-    def __init__(self, width_mult=0.5, depth_mult=0.33):
+    def __init__(self, width_mult=0.5, depth_mult=0.33, data_format="NCHW"):
         super().__init__()
+        df = data_format
         w = lambda c: max(8, int(c * width_mult))
         d = lambda n: max(1, round(n * depth_mult))
-        self.stem = nn.Sequential(ConvBNAct(3, w(32), 3, stride=2),
-                                  ConvBNAct(w(32), w(64), 3, stride=2))
-        self.stage1 = CSPResStage(w(64), w(128), d(3))
-        self.stage2 = CSPResStage(w(128), w(256), d(6))
-        self.stage3 = CSPResStage(w(256), w(512), d(3))
+        self.stem = nn.Sequential(ConvBNAct(3, w(32), 3, stride=2, data_format=df),
+                                  ConvBNAct(w(32), w(64), 3, stride=2,
+                                            data_format=df))
+        self.stage1 = CSPResStage(w(64), w(128), d(3), data_format=df)
+        self.stage2 = CSPResStage(w(128), w(256), d(6), data_format=df)
+        self.stage3 = CSPResStage(w(256), w(512), d(3), data_format=df)
         self.out_channels = [w(128), w(256), w(512)]
 
     def forward(self, x):
@@ -64,20 +74,25 @@ class PPYOLOEBackbone(nn.Layer):
 
 
 class PPYOLOEHead(nn.Layer):
-    def __init__(self, in_channels, num_classes=80, num_anchors=1):
+    def __init__(self, in_channels, num_classes=80, num_anchors=1,
+                 data_format="NCHW"):
         super().__init__()
         self.heads = nn.LayerList([
-            nn.Conv2D(c, num_anchors * (5 + num_classes), 1) for c in in_channels])
+            nn.Conv2D(c, num_anchors * (5 + num_classes), 1,
+                      data_format=data_format) for c in in_channels])
 
     def forward(self, feats):
         return [h(f) for h, f in zip(self.heads, feats)]
 
 
 class PPYOLOE(nn.Layer):
-    def __init__(self, num_classes=80, width_mult=0.5, depth_mult=0.33):
+    def __init__(self, num_classes=80, width_mult=0.5, depth_mult=0.33,
+                 data_format="NCHW"):
         super().__init__()
-        self.backbone = PPYOLOEBackbone(width_mult, depth_mult)
-        self.head = PPYOLOEHead(self.backbone.out_channels, num_classes)
+        self.backbone = PPYOLOEBackbone(width_mult, depth_mult,
+                                        data_format=data_format)
+        self.head = PPYOLOEHead(self.backbone.out_channels, num_classes,
+                                data_format=data_format)
 
     def forward(self, x):
         return self.head(self.backbone(x))
