@@ -98,6 +98,11 @@ class PredictorTensor:
             a = a.astype(np.float32)  # bf16 artifacts read back as fp32
         return a
 
+    def device_value(self):
+        """Zero-copy device array of this output (no host transfer, no
+        dtype view) — the TPU-native ZeroCopyTensor read path."""
+        return self._pred._results[self.name]
+
     def share_external_data(self, tensor):
         self._pred._feeds[self.name] = tensor._value if isinstance(tensor, Tensor) else tensor
 

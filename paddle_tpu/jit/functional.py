@@ -65,3 +65,21 @@ def functional_call(layer, param_names, param_arrays, buffer_names, buffer_array
     return jax.tree_util.tree_map(
         lambda t: t._value if isinstance(t, Tensor) else t, out,
         is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def amp_functional_call(model, pnames, ps, bnames, buffers, inputs, amp_dtype):
+    """functional_call under O1 autocast when amp_dtype is set.
+
+    Casts floating params to amp_dtype AND enables the autocast state for
+    the trace — white-list ops (matmul/conv) then cast fp32 activations
+    down too; casting params alone would let one fp32 input promote the
+    whole graph back to fp32. Shared by TrainStep and SPMDTrainStep.
+    """
+    if amp_dtype is None:
+        return functional_call(model, pnames, ps, bnames, buffers, *inputs)
+    import jax.numpy as jnp
+    ps = [p.astype(amp_dtype)
+          if jnp.issubdtype(p.dtype, jnp.floating) else p for p in ps]
+    from ..amp.state import auto_cast
+    with auto_cast(enable=True, dtype=amp_dtype):
+        return functional_call(model, pnames, ps, bnames, buffers, *inputs)

@@ -55,11 +55,9 @@ class TrainStep:
             rnd.push_trace_key(step_key)
             try:
                 def fwd(ps):
-                    if amp_dtype is not None:
-                        ps = [p.astype(amp_dtype)
-                              if jnp.issubdtype(p.dtype, jnp.floating) else p
-                              for p in ps]
-                    out = functional_call(model, pnames, ps, bnames, buffers, *inputs)
+                    from .functional import amp_functional_call
+                    out = amp_functional_call(model, pnames, ps, bnames,
+                                              buffers, inputs, amp_dtype)
                     outs = [Tensor(o) for o in out] if isinstance(out, (list, tuple)) \
                         else [Tensor(out)]
                     loss = loss_fn(*outs, *[Tensor(l) for l in labels])

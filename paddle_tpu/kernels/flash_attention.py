@@ -275,6 +275,11 @@ def flash_attention_arrays(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
                            block_k=DEFAULT_BLOCK_K):
     """q/k/v: [B, S, H, D] (paddle layout). Returns [B, S, H, D]."""
     b, s, h, d = q.shape
+    if k.shape[1] != s or v.shape[1] != s:
+        raise ValueError(
+            f"flash_attention requires q/k/v to share seq_len; got q={s}, "
+            f"k={k.shape[1]}, v={v.shape[1]} (cross-length attention takes "
+            "the fused path)")
     interpret = jax.default_backend() != "tpu"
 
     # dots require matching operand dtypes (e.g. fp32 KV cache against bf16

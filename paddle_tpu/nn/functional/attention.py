@@ -34,8 +34,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     # MXU dots win from 1k up (2.2x at 1k, 2.7x at 2k, 5.7x at 8k); fp32
     # inputs keep the old 4k crossover (fp32 MXU dots were only at parity
     # there). The Pallas kernel also keeps memory O(S).
-    _flash_min_seq = 1024 if q._value.dtype == jnp.bfloat16 else 4096
+    # threshold keyed on the PROMOTED dtype: bf16 q against an fp32 KV
+    # cache runs fp32 dots inside the kernel (operands are promoted at the
+    # flash boundary), where the old 4k crossover still applies
+    _promoted = jnp.result_type(q._value.dtype, k._value.dtype, v._value.dtype)
+    _flash_min_seq = 1024 if _promoted == jnp.bfloat16 else 4096
     if mask_arr is None and dropout_p == 0.0 and seq_len >= _flash_min_seq \
+            and k.shape[1] == seq_len and v.shape[1] == seq_len \
             and head_dim in (64, 128, 256):
         try:
             import jax as _j
