@@ -22,6 +22,27 @@ from ..core.tensor import Tensor
 from .functional import functional_call, split_state
 
 
+def raise_nonfinite(bad, pnames, context):
+    """Decode the in-program finite flags ([P+1] or [n_steps, P+1]) and
+    raise naming the offending grads (reference per-op abort,
+    operator.cc:1171). No-op when the check wasn't traced (bad is None).
+    Callers must have committed params/slots/step state FIRST — the jit
+    call donated the old buffers."""
+    if bad is None:
+        return
+    import numpy as np_
+    flags_arr = np_.asarray(bad)
+    if flags_arr.ndim == 2:              # scan: [n_steps, P+1] -> any step
+        flags_arr = flags_arr.any(axis=0)
+    if not flags_arr.any():
+        return
+    names = ["loss" if i == 0 else f"grad of {pnames[i - 1]}"
+             for i in np_.nonzero(flags_arr)[0]]
+    raise FloatingPointError(
+        f"NaN/Inf detected in {context} "
+        f"(FLAGS_check_nan_inf=True): {', '.join(names)}")
+
+
 class TrainStep:
     def __init__(self, model, loss_fn: Callable, optimizer, amp_dtype=None,
                  donate: bool = True, mesh=None, in_shardings=None,
@@ -39,6 +60,13 @@ class TrainStep:
         self._n_model_inputs = n_model_inputs
 
     def _build(self):
+        from ..core import flags as _flags
+        # FLAGS_check_nan_inf for the COMPILED hot loop (operator.cc:1171
+        # role): the per-op eager scan can't see inside a jitted step, so
+        # the finite-check is traced INTO the executable — one fused
+        # [P+1]-flag reduction over loss+grads, read back on host only in
+        # debug mode. Flag is captured at build time (first step).
+        self._nan_check = bool(_flags.flag("check_nan_inf"))
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
         trainable, frozen = split_state(model)
         self._pnames, self._bnames = list(trainable), list(frozen)
@@ -66,7 +94,12 @@ class TrainStep:
                 loss, grads = jax.value_and_grad(fwd)(params)
                 new_params, new_slots = optimizer.functional_update(
                     params, grads, slots, lr, t, params_meta=ptensors)
-                return new_params, new_slots, loss
+                if self._nan_check:
+                    bad = jnp.stack(
+                        [~jnp.isfinite(loss)]
+                        + [~jnp.all(jnp.isfinite(g)) for g in grads])
+                    return new_params, new_slots, loss, bad
+                return new_params, new_slots, loss, None
             finally:
                 rnd.pop_trace_key()
 
@@ -74,9 +107,9 @@ class TrainStep:
             # rng advance + step counter live IN the program: zero per-step
             # host->device scalar traffic (matters on remote/tunnel targets)
             step_key, carry_key = jax.random.split(rng_key)
-            new_params, new_slots, loss = one_step(
+            new_params, new_slots, loss, bad = one_step(
                 params, slots, buffers, step_key, lr, t, inputs, labels)
-            return new_params, new_slots, loss, carry_key, t + 1.0
+            return new_params, new_slots, loss, carry_key, t + 1.0, bad
 
         def pure_scan(params, slots, buffers, rng_key, lr, t, inputs, labels):
             # Device-side training loop: N steps inside ONE executable via
@@ -89,13 +122,13 @@ class TrainStep:
                 params, slots, key, t = carry
                 ins, labs = xs
                 step_key, key = jax.random.split(key)
-                new_params, new_slots, loss = one_step(
+                new_params, new_slots, loss, bad = one_step(
                     params, slots, buffers, step_key, lr, t, ins, labs)
-                return (new_params, new_slots, key, t + 1.0), loss
+                return (new_params, new_slots, key, t + 1.0), (loss, bad)
 
-            (params, slots, key, t), losses = jax.lax.scan(
+            (params, slots, key, t), (losses, bads) = jax.lax.scan(
                 body, (params, slots, rng_key, t), (list(inputs), list(labels)))
-            return params, slots, losses, key, t
+            return params, slots, losses, key, t, bads
 
         donate = (0, 1, 3, 5) if self._donate else ()
         self._jitted = jax.jit(pure, donate_argnums=donate)
@@ -128,12 +161,17 @@ class TrainStep:
         model output(s) — close labels into loss_fn or pass them as model inputs.
         """
         params, buffers, inputs, labels = self._prepare(batch)
-        new_params, self._slots, loss, self._key, self._t_arr = self._jitted(
-            params, self._slots, buffers, self._key, self._lr_arr,
-            self._t_arr, inputs, labels)
+        new_params, self._slots, loss, self._key, self._t_arr, bad = \
+            self._jitted(params, self._slots, buffers, self._key,
+                         self._lr_arr, self._t_arr, inputs, labels)
+        # commit ALL state before any debug raise: the old param buffers
+        # were DONATED to the jit call, so bailing out early would leave
+        # every tensor pointing at a deleted buffer (and slots/step_count
+        # desynced)
         for tns, v in zip(self._ptensors, new_params):
             tns._value = v
         self.optimizer._step_count += 1
+        raise_nonfinite(bad, self._pnames, "jitted train step")
         return Tensor(loss)
 
     def run(self, *batch):
@@ -145,10 +183,12 @@ class TrainStep:
         """
         params, buffers, inputs, labels = self._prepare(batch)
         n_steps = int(inputs[0].shape[0]) if inputs else int(labels[0].shape[0])
-        new_params, self._slots, losses, self._key, self._t_arr = \
+        new_params, self._slots, losses, self._key, self._t_arr, bads = \
             self._jitted_scan(params, self._slots, buffers, self._key,
                               self._lr_arr, self._t_arr, inputs, labels)
+        # commit before the debug raise (donated buffers — see __call__)
         for tns, v in zip(self._ptensors, new_params):
             tns._value = v
         self.optimizer._step_count += n_steps
+        raise_nonfinite(bads, self._pnames, "jitted train step")
         return Tensor(losses)

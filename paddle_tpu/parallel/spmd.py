@@ -112,6 +112,11 @@ class SPMDTrainStep:
         pnames, bnames = self._pnames, self._bnames
         amp_dtype = self.amp_dtype
         mesh = self.mesh
+        # jitted-path FLAGS_check_nan_inf (see jit/train_step.py): finite
+        # flags traced into the SPMD executable, captured at build time
+        from ..core import flags as _flags
+        nan_check = bool(_flags.flag("check_nan_inf"))
+        self._nan_check = nan_check
 
         pspecs = [self._param_spec(p) for p in ptensors]
         sspecs = [{k: self._slot_spec(p, ps) for k in s}
@@ -140,7 +145,12 @@ class SPMDTrainStep:
                 loss, grads = jax.value_and_grad(fwd)(params)
                 new_params, new_slots = optimizer.functional_update(
                     params, grads, slots, lr, t, params_meta=ptensors)
-                return new_params, new_slots, loss
+                if nan_check:
+                    bad = jnp.stack(
+                        [~jnp.isfinite(loss)]
+                        + [~jnp.all(jnp.isfinite(g)) for g in grads])
+                    return new_params, new_slots, loss, bad
+                return new_params, new_slots, loss, None
             finally:
                 rnd.pop_trace_key()
 
@@ -154,7 +164,8 @@ class SPMDTrainStep:
                  [ns(s) for s in in_batch_specs])
         out_sh = ([ns(s) for s in pspecs],
                   [{k: ns(v) for k, v in d.items()} for d in sspecs],
-                  ns(P()))
+                  ns(P()),
+                  ns(P()) if nan_check else None)
         donate = (0, 1) if self._donate else ()
         self._jitted = jax.jit(pure, in_shardings=in_sh, out_shardings=out_sh,
                                donate_argnums=donate)
@@ -179,9 +190,12 @@ class SPMDTrainStep:
         key = rnd.default_generator().next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.optimizer._step_count + 1, jnp.float32)
-        new_params, self._slots, loss = self._jitted(params, self._slots, buffers,
-                                                     key, lr, t, arrs)
+        new_params, self._slots, loss, bad = self._jitted(
+            params, self._slots, buffers, key, lr, t, arrs)
+        # commit before the debug raise — old buffers were donated
         for n, v in zip(self._pnames, new_params):
             trainable[n]._value = v
         self.optimizer._step_count += 1
+        from ..jit.train_step import raise_nonfinite
+        raise_nonfinite(bad, self._pnames, "jitted SPMD train step")
         return Tensor(loss)

@@ -1,0 +1,97 @@
+"""FLAGS_check_nan_inf inside the COMPILED train step.
+
+Reference parity: the executor-side scan (`operator.cc:1171`,
+`details/nan_inf_utils_detail.cc:314`) also covers the fused hot path; the
+eager per-op scan in ops/_dispatch.py cannot see inside a jitted step, so
+TrainStep/SPMDTrainStep trace a finite-check over loss+grads into the
+executable and raise on host with the offending parameter's name.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.parallel import HybridCommunicateGroup, SPMDTrainStep
+
+
+def _net_and_batch(poison=False):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    if poison:
+        w = net[0].weight
+        arr = np.asarray(w._value).copy()
+        arr[0, 0] = np.nan
+        w._value = paddle.to_tensor(arr)._value
+    x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 4, (4,)).astype("int64"))
+    return net, x, y
+
+
+@pytest.fixture
+def nan_flag():
+    old = _flags.flag("check_nan_inf")
+    _flags.set_flags({"check_nan_inf": True})
+    yield
+    _flags.set_flags({"check_nan_inf": old})
+
+
+class TestJittedNanCheck:
+    def test_poisoned_weight_raises_with_param_name(self, nan_flag):
+        net, x, y = _net_and_batch(poison=True)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+        step = TrainStep(net, nn.CrossEntropyLoss(), opt, n_model_inputs=1)
+        with pytest.raises(FloatingPointError, match="check_nan_inf"):
+            step(x, y)
+
+    def test_error_names_the_bad_grad(self, nan_flag):
+        net, x, y = _net_and_batch(poison=True)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+        step = TrainStep(net, nn.CrossEntropyLoss(), opt, n_model_inputs=1)
+        with pytest.raises(FloatingPointError, match="loss|grad of"):
+            step(x, y)
+
+    def test_scan_run_path_raises(self, nan_flag):
+        net, x, y = _net_and_batch(poison=True)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+        step = TrainStep(net, nn.CrossEntropyLoss(), opt, n_model_inputs=1)
+        xs = paddle.to_tensor(np.random.rand(3, 4, 8).astype("float32"))
+        ys = paddle.to_tensor(np.random.randint(0, 4, (3, 4)).astype("int64"))
+        with pytest.raises(FloatingPointError, match="check_nan_inf"):
+            step.run(xs, ys)
+
+    def test_clean_weights_pass_and_flag_off_is_free(self, nan_flag):
+        net, x, y = _net_and_batch(poison=False)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+        step = TrainStep(net, nn.CrossEntropyLoss(), opt, n_model_inputs=1)
+        loss = step(x, y)
+        assert np.isfinite(float(loss))
+        # flag off: no bad-flags output traced at all
+        _flags.set_flags({"check_nan_inf": False})
+        net2, x2, y2 = _net_and_batch(poison=False)
+        opt2 = paddle.optimizer.SGD(parameters=net2.parameters(), learning_rate=0.1)
+        step2 = TrainStep(net2, nn.CrossEntropyLoss(), opt2, n_model_inputs=1)
+        step2(x2, y2)
+        assert step2._nan_check is False
+
+    def test_params_survive_the_raise_despite_donation(self, nan_flag):
+        # the jit call donates old param buffers; the raise must happen
+        # AFTER committing new_params or every tensor dangles
+        net, x, y = _net_and_batch(poison=True)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+        step = TrainStep(net, nn.CrossEntropyLoss(), opt, n_model_inputs=1)
+        with pytest.raises(FloatingPointError):
+            step(x, y)
+        for p in net.parameters():          # readable, not deleted
+            np.asarray(p._value)
+        assert step.optimizer._step_count == 1  # state not desynced
+
+    def test_spmd_step_raises(self, nan_flag):
+        net, x, y = _net_and_batch(poison=True)
+        hcg = HybridCommunicateGroup(hybrid_configs={"dp_degree": 2})
+        opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+        step = SPMDTrainStep(net, nn.CrossEntropyLoss(), opt,
+                             mesh=hcg.get_mesh(), donate=False)
+        with pytest.raises(FloatingPointError, match="check_nan_inf"):
+            step(x, y)
