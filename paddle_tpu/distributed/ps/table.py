@@ -43,7 +43,72 @@ class _AdagradRule:
         return w
 
 
-_RULES = {"sgd": _SGDRule, "adagrad": _AdagradRule}
+class _AdamRule:
+    """Dense/sparse adam with per-row moments and per-row step counter
+    (adam_op.h dense path / common_sparse_table adam accessor)."""
+
+    def __init__(self, lr=0.01, beta1=0.9, beta2=0.999, eps=1e-8):
+        self.lr, self.b1, self.b2, self.eps = lr, beta1, beta2, eps
+
+    def slots(self, dim):
+        return {"m": np.zeros(dim, np.float32),
+                "v": np.zeros(dim, np.float32),
+                "t": np.zeros((), np.float32)}
+
+    def apply(self, w, g, slots):
+        slots["t"] += 1.0
+        t = float(slots["t"])
+        slots["m"][...] = self.b1 * slots["m"] + (1 - self.b1) * g
+        slots["v"][...] = self.b2 * slots["v"] + (1 - self.b2) * g * g
+        mhat = slots["m"] / (1 - self.b1 ** t)
+        vhat = slots["v"] / (1 - self.b2 ** t)
+        w -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return w
+
+
+# lazy adam IS per-row adam on a sparse table: moments advance only when a
+# row receives a gradient (reference lazy_mode; common_sparse_table.cc:1) —
+# the SparseTable's per-key slot storage gives that behavior for free
+_RULES = {"sgd": _SGDRule, "adagrad": _AdagradRule, "adam": _AdamRule,
+          "lazy_adam": _AdamRule}
+
+
+class CtrAccessor:
+    """Show/click statistics + eviction scoring per sparse row.
+
+    Reference parity: `paddle/fluid/distributed/ps/table/ctr_accessor.cc`
+    (CtrCommonAccessor): every row carries decayed show/click counters; the
+    shrink pass evicts rows whose score falls below a threshold or that
+    have not been seen for `ttl_days` decay cycles.
+    """
+
+    def __init__(self, show_decay_rate=0.98, click_coeff=8.0,
+                 delete_threshold=0.8, ttl_days=30):
+        self.show_decay_rate = show_decay_rate
+        self.click_coeff = click_coeff
+        self.delete_threshold = delete_threshold
+        self.ttl_days = ttl_days
+
+    def fresh(self):
+        return {"show": 0.0, "click": 0.0, "unseen_days": 0.0}
+
+    def on_show_click(self, stat, show, click):
+        stat["show"] += float(show)
+        stat["click"] += float(click)
+        stat["unseen_days"] = 0.0
+
+    def decay(self, stat):
+        """One decay cycle (reference UpdateTimeDecay, daily)."""
+        stat["show"] *= self.show_decay_rate
+        stat["click"] *= self.show_decay_rate
+        stat["unseen_days"] += 1.0
+
+    def score(self, stat):
+        return stat["show"] + self.click_coeff * stat["click"]
+
+    def should_evict(self, stat):
+        return (self.score(stat) < self.delete_threshold
+                or stat["unseen_days"] > self.ttl_days)
 
 
 class DenseTable:
@@ -79,7 +144,8 @@ class SparseTable:
     """id -> embedding-row hash table with lazy row init and per-row
     optimizer slots (common_sparse_table role)."""
 
-    def __init__(self, dim, optimizer="sgd", lr=0.01, init_std=0.01, seed=0):
+    def __init__(self, dim, optimizer="sgd", lr=0.01, init_std=0.01, seed=0,
+                 accessor=None, **accessor_kw):
         self.dim = dim
         self._lock = threading.Lock()
         self._rows: Dict[int, np.ndarray] = {}
@@ -87,6 +153,15 @@ class SparseTable:
         self._rule = _RULES[optimizer](lr=lr)
         self._init_std = init_std
         self._rng = np.random.default_rng(seed)
+        # accessor="ctr": per-row show/click stats + decay/shrink eviction
+        if accessor not in (None, "ctr"):
+            raise TypeError(f"unknown accessor {accessor!r}")
+        if accessor is None and accessor_kw:
+            raise TypeError(
+                f"unexpected keyword arguments {sorted(accessor_kw)} "
+                "(accessor options need accessor='ctr')")
+        self._accessor = CtrAccessor(**accessor_kw) if accessor == "ctr" else None
+        self._stats: Dict[int, dict] = {}
 
     def _row(self, key: int) -> np.ndarray:
         r = self._rows.get(key)
@@ -94,7 +169,52 @@ class SparseTable:
             r = self._rng.normal(0, self._init_std, self.dim).astype(np.float32)
             self._rows[key] = r
             self._slots[key] = self._rule.slots(self.dim)
+            if self._accessor is not None:
+                self._stats[key] = self._accessor.fresh()
         return r
+
+    # ---- CTR accessor surface (ctr_accessor.cc role) ----
+    def push_show_click(self, ids, shows, clicks):
+        if self._accessor is None:
+            raise ValueError("table has no ctr accessor")
+        ids = np.asarray(ids).reshape(-1)
+        shows = np.asarray(shows).reshape(-1)
+        clicks = np.asarray(clicks).reshape(-1)
+        with self._lock:
+            for i, s, c in zip(ids, shows, clicks):
+                self._row(int(i))
+                self._accessor.on_show_click(self._stats[int(i)], s, c)
+
+    def decay(self):
+        """One show/click decay cycle over every row (daily shrink prep)."""
+        if self._accessor is None:
+            raise ValueError("table has no ctr accessor")
+        with self._lock:
+            for st in self._stats.values():
+                self._accessor.decay(st)
+
+    def _on_evict(self, key):
+        """Hook for subclasses tracking rows outside _rows (SSD tier)."""
+
+    def shrink(self):
+        """Evict rows below the score threshold or past their TTL
+        (reference Table::Shrink). Returns number of evicted rows."""
+        if self._accessor is None:
+            raise ValueError("table has no ctr accessor")
+        with self._lock:
+            dead = [k for k, st in self._stats.items()
+                    if self._accessor.should_evict(st)]
+            for k in dead:
+                self._rows.pop(k, None)
+                self._slots.pop(k, None)
+                self._stats.pop(k, None)
+                self._on_evict(k)
+            return len(dead)
+
+    def row_stat(self, key: int) -> Optional[dict]:
+        with self._lock:
+            st = self._stats.get(int(key))
+            return dict(st) if st is not None else None
 
     def pull(self, ids) -> np.ndarray:
         ids = np.asarray(ids).reshape(-1)
@@ -122,30 +242,168 @@ class SparseTable:
     def state(self):
         return {"rows": self._rows, "slots": self._slots}
 
+    _STAT_FIELDS = ("show", "click", "unseen_days")
+
+    def _iter_all_rows(self):
+        """(key, row, slots, stat) for every row the table owns — the SSD
+        tier overrides this to include spilled rows."""
+        for k in self._rows:
+            yield k, self._rows[k], self._slots[k], self._stats.get(k)
+
     def save(self, path):
-        # rows AND per-row optimizer slots round-trip (reference sparse
-        # tables persist accessor state alongside embeddings)
+        # rows, per-row optimizer slots AND accessor stats round-trip
+        # (reference sparse tables persist accessor state with embeddings)
         with self._lock:
-            keys = np.asarray(list(self._rows), np.int64)
-            vals = np.stack([self._rows[int(k)] for k in keys]) if len(keys) \
+            items = list(self._iter_all_rows())
+            keys = np.asarray([k for k, *_ in items], np.int64)
+            vals = np.stack([r for _, r, _, _ in items]) if items \
                 else np.zeros((0, self.dim), np.float32)
             slot_arrays = {}
             for sname in self._rule.slots(self.dim):
                 slot_arrays["slot_" + sname] = np.stack(
-                    [self._slots[int(k)][sname] for k in keys]) if len(keys) \
+                    [s[sname] for _, _, s, _ in items]) if items \
                     else np.zeros((0, self.dim), np.float32)
+            if self._accessor is not None:
+                for f in self._STAT_FIELDS:
+                    slot_arrays["stat_" + f] = np.asarray(
+                        [(st or self._accessor.fresh())[f]
+                         for _, _, _, st in items], np.float32)
         np.savez(path, keys=keys, vals=vals, **slot_arrays)
 
     def load(self, path):
         data = np.load(path if path.endswith(".npz") else path + ".npz")
         snames = [f[5:] for f in data.files if f.startswith("slot_")]
+        has_stats = "stat_show" in data.files
         # decompress each npz member ONCE; store per-row copies so a row
         # update can't pin the whole backing array
         keys, vals = data["keys"], data["vals"]
         slot_data = {s: data["slot_" + s] for s in snames}
+        stat_data = {f: data["stat_" + f] for f in self._STAT_FIELDS} \
+            if has_stats else None
         with self._lock:
             for i, k in enumerate(keys):
                 k = int(k)
                 self._rows[k] = np.array(vals[i], np.float32)
                 self._slots[k] = {s: np.array(slot_data[s][i])
                                   for s in snames} or self._rule.slots(self.dim)
+                if self._accessor is not None:
+                    self._stats[k] = (
+                        {f: float(stat_data[f][i]) for f in self._STAT_FIELDS}
+                        if stat_data is not None else self._accessor.fresh())
+                self._on_load_row(k)
+
+    def _on_load_row(self, key):
+        """Hook: SSD tier registers loaded rows in its LRU and spills."""
+
+
+class SSDSparseTable(SparseTable):
+    """Sparse table with a bounded in-memory working set; cold rows spill
+    to an on-disk key-value store and reload transparently on access.
+
+    Reference parity: `paddle/fluid/distributed/ps/table/ssd_sparse_table.h`
+    (RocksDB-backed sparse tier for embedding tables larger than RAM). The
+    disk store here is a stdlib dbm database holding pickled (row, slots,
+    stat) triples; eviction is LRU over the in-memory dict.
+    """
+
+    def __init__(self, dim, path, cache_rows=100000, **kw):
+        super().__init__(dim, **kw)
+        import dbm
+        import os as _os
+        _os.makedirs(_os.path.dirname(_os.path.abspath(path)) or ".",
+                     exist_ok=True)
+        self._db = dbm.open(path, "c")
+        self._cache_rows = int(cache_rows)
+        self._lru: Dict[int, None] = {}  # insertion-ordered LRU
+
+    def _touch(self, key):
+        self._lru.pop(key, None)
+        self._lru[key] = None
+
+    def _spill_if_needed(self):
+        import pickle
+        while len(self._rows) > self._cache_rows and self._lru:
+            cold = next(iter(self._lru))
+            self._lru.pop(cold)
+            if cold not in self._rows:  # evicted by shrink since touched
+                continue
+            blob = pickle.dumps((self._rows.pop(cold),
+                                 self._slots.pop(cold),
+                                 self._stats.pop(cold, None)))
+            self._db[str(cold).encode()] = blob
+
+    def _row(self, key: int) -> np.ndarray:
+        r = self._rows.get(key)
+        if r is None:
+            import pickle
+            blob = self._db.get(str(key).encode())
+            if blob is not None:
+                row, slots, stat = pickle.loads(blob)
+                self._rows[key] = row
+                self._slots[key] = slots
+                if stat is not None:
+                    self._stats[key] = stat
+                del self._db[str(key).encode()]
+                r = row
+            else:
+                r = super()._row(key)
+        self._touch(key)
+        self._spill_if_needed()
+        return r
+
+    def __len__(self):
+        # resident + spilled
+        return len(self._rows) + len(self._db)
+
+    @property
+    def resident_rows(self):
+        return len(self._rows)
+
+    # ---- hooks keeping the LRU/disk tiers consistent with the base ----
+    def _on_evict(self, key):
+        self._lru.pop(key, None)
+        k = str(key).encode()
+        if k in self._db:
+            del self._db[k]
+
+    def _on_load_row(self, key):
+        self._touch(key)
+        self._spill_if_needed()
+
+    def _iter_all_rows(self):
+        import pickle
+        yield from super()._iter_all_rows()
+        for kb in self._db.keys():
+            row, slots, stat = pickle.loads(self._db[kb])
+            yield int(kb.decode()), row, slots, stat
+
+    def decay(self):
+        """Decay covers SPILLED rows too (rewrites their stat on disk)."""
+        super().decay()
+        if self._accessor is None:
+            return
+        import pickle
+        with self._lock:
+            for kb in list(self._db.keys()):
+                row, slots, stat = pickle.loads(self._db[kb])
+                if stat is not None:
+                    self._accessor.decay(stat)
+                    self._db[kb] = pickle.dumps((row, slots, stat))
+
+    def shrink(self):
+        """Shrink walks the disk tier as well — the coldest rows are
+        exactly the ones most likely to be spilled."""
+        n = super().shrink()
+        if self._accessor is None:
+            return n
+        import pickle
+        with self._lock:
+            for kb in list(self._db.keys()):
+                _, _, stat = pickle.loads(self._db[kb])
+                if stat is not None and self._accessor.should_evict(stat):
+                    del self._db[kb]
+                    n += 1
+        return n
+
+    def close(self):
+        self._db.close()
