@@ -14,5 +14,5 @@ from .interface import shard_tensor, shard_op  # noqa: F401
 from .completion import Completer  # noqa: F401
 from .reshard import reshard  # noqa: F401
 from .cost_model import ClusterInfo, PlanCost, train_step_cost  # noqa: F401
-from .planner import ParallelPlan, Planner  # noqa: F401
+from .planner import Mapper, ParallelPlan, Partitioner, Planner  # noqa: F401
 from .engine import Engine  # noqa: F401

@@ -1,26 +1,50 @@
 """Roofline cost model for parallel-plan selection.
 
 Reference parity: `python/paddle/distributed/auto_parallel/cost_model.py`
-(per-op compute/comm cost estimation driving the Planner).
+(per-op compute/comm cost estimation driving the Planner) and
+`cluster.py` (hardware topology the mapper consumes).
 
 TPU-native: costs come from the scaling-book roofline — compute time =
-FLOPs / (chips x peak), collective time = bytes x collective-factor / ICI
-bandwidth. Numbers are v5e-class defaults and overridable per cluster.
+FLOPs / (chips x peak), collective time = bytes x collective-factor /
+per-axis bandwidth. The cluster knows its physical ICI mesh; a logical
+axis whose span exceeds the ICI domain pays DCN bandwidth instead.
+Numbers are v5e-class defaults and overridable per cluster.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Tuple
 
 
 @dataclass
 class ClusterInfo:
-    """Per-chip peak + interconnect figures (v5e-ish defaults)."""
+    """Per-chip peak + interconnect topology (v5e-ish defaults).
+
+    `ici_mesh` is the physical torus shape of one ICI domain (a v5e-8
+    slice is 4x2); logical mesh axes laid over it ride ICI, anything
+    spanning more chips than the domain crosses DCN (reference
+    `auto_parallel/cluster.py` models the same machine/link split).
+    """
     peak_flops: float = 1.97e14      # bf16 FLOPs/s per chip
     ici_bandwidth: float = 4.5e10    # bytes/s per link direction
     dcn_bandwidth: float = 2.5e9     # bytes/s per host
     hbm_bytes: float = 1.6e10        # 16 GB per chip
     hbm_bandwidth: float = 8.2e11    # bytes/s
     collective_latency: float = 1e-5  # fixed per-collective launch/hop cost
+    ici_mesh: Tuple[int, ...] = (4, 2)
+
+    @property
+    def ici_domain(self) -> int:
+        n = 1
+        for d in self.ici_mesh:
+            n *= d
+        return n
+
+    def axis_bandwidth(self, span: int) -> float:
+        """Bandwidth available to a logical mesh axis of `span` devices:
+        inside one ICI domain it rides ICI links; a larger span must hop
+        hosts over DCN, which then bounds the whole collective."""
+        return self.ici_bandwidth if span <= self.ici_domain else self.dcn_bandwidth
 
 
 # collective time factors over a ring of n participants (scaling-book):
@@ -41,6 +65,10 @@ def alltoall_time(nbytes, n, bw):
     return 0.0 if n <= 1 else (n - 1) / n * nbytes / bw
 
 
+def p2p_time(nbytes, bw):
+    return nbytes / bw
+
+
 def compute_time(flops, n_chips, cluster: ClusterInfo, mfu=0.4):
     """Wall estimate for `flops` spread over `n_chips` at a realistic MFU."""
     return flops / (n_chips * cluster.peak_flops * mfu)
@@ -51,39 +79,71 @@ class PlanCost:
     compute: float
     comm: float
     memory_per_chip: float
+    bubble: float = 0.0
 
     @property
     def total(self):
-        return self.compute + self.comm
+        return self.compute + self.comm + self.bubble
 
 
 def train_step_cost(param_bytes, flops_per_step, act_bytes_per_layer,
                     n_layers, dp, mp, cluster: ClusterInfo,
-                    sharding_stage=0) -> PlanCost:
-    """Cost one hybrid dp x mp training step.
+                    sharding_stage=0, pp=1, sp=1,
+                    micro_batches=None) -> PlanCost:
+    """Cost one hybrid dp x mp x pp x sp training step.
 
-    - dp axis: gradient all-reduce of the param shard each step;
+    - dp axis: bucketed gradient all-reduce of the param shard;
     - mp axis: 2 activation all-reduces per layer fwd + 2 bwd (megatron
-      pattern, mp_layers.py) of the per-chip activation bytes;
-    - memory: params + grads + adam slots (3x params f32-equiv) per chip,
-      divided by mp (tensor shards) and, for ZeRO stages, by dp on slots.
+      pattern, mp_layers.py);
+    - pp axis: 1F1B bubble (pp-1)/micro of ideal compute + boundary
+      activation p2p per micro-batch;
+    - sp axis: ring-attention k/v rotation — (sp-1) block sends of the
+      per-chip activation shard per layer, fwd and bwd;
+    - memory: params split over mp x pp; grads+adam slots additionally
+      over dp for ZeRO stages; activations split over sp (the reason sp
+      exists) and mp.
+    Each axis pays the bandwidth its SPAN can actually get from the
+    physical topology (ICI inside the domain, DCN beyond it).
     """
-    n = dp * mp
+    n = dp * mp * pp * sp
     lat = cluster.collective_latency
-    shard_param = param_bytes / mp
-    # dp grad allreduce is bucketed (one fused collective); mp pays
-    # 4 x n_layers separate activation allreduces, each with launch latency
-    comm = allreduce_time(shard_param, dp, cluster.ici_bandwidth) \
+    layers_per_stage = max(n_layers // max(pp, 1), 1)
+    shard_param = param_bytes / (mp * pp)
+
+    # The Mapper lays axes out mp-innermost (dp, pp, sp, mp): an axis's
+    # PHYSICAL reach is its span times every inner axis's span, so the
+    # outer axes cross the ICI domain first — price each by that reach,
+    # not by its own size alone.
+    reach_mp = mp
+    reach_sp = sp * mp
+    reach_pp = pp * sp * mp
+    reach_dp = n
+    comm = allreduce_time(shard_param, dp, cluster.axis_bandwidth(reach_dp)) \
         + (lat if dp > 1 else 0.0)
     if mp > 1:
-        comm += 4 * n_layers * (
-            allreduce_time(act_bytes_per_layer / mp, mp, cluster.ici_bandwidth)
-            + lat)
+        bw_mp = cluster.axis_bandwidth(reach_mp)
+        comm += 4 * layers_per_stage * (
+            allreduce_time(act_bytes_per_layer / (mp * sp), mp, bw_mp) + lat)
+    if sp > 1:
+        # ring attention: each of sp-1 steps sends the local K and V block
+        bw_sp = cluster.axis_bandwidth(reach_sp)
+        per_block = act_bytes_per_layer / sp
+        comm += 3 * layers_per_stage * (sp - 1) * (
+            2 * p2p_time(per_block, bw_sp) + lat)  # fwd + ~2x bwd => 3x
+    micro = micro_batches or max(2 * pp, 1)
     comp = compute_time(flops_per_step, n, cluster)
+    bubble = comp * (pp - 1) / micro if pp > 1 else 0.0
+    if pp > 1:
+        bw_pp = cluster.axis_bandwidth(reach_pp)
+        comm += (pp - 1) * micro * (
+            p2p_time(act_bytes_per_layer / (mp * sp), bw_pp) + lat)
+
     states = 3.0  # grads + adam m/v, in param-bytes units
     if sharding_stage >= 1:
         states = 1.0 + 2.0 / max(dp, 1)
     if sharding_stage >= 2:
         states = 1.0 / max(dp, 1) + 2.0 / max(dp, 1)
-    mem = shard_param * (1.0 + states)
-    return PlanCost(compute=comp, comm=comm, memory_per_chip=mem)
+    mem = shard_param * (1.0 + states) \
+        + layers_per_stage * act_bytes_per_layer / (mp * sp)
+    return PlanCost(compute=comp, comm=comm, memory_per_chip=mem,
+                    bubble=bubble)

@@ -41,7 +41,13 @@ class Engine:
     # ---- planning ----
     def prepare(self, batch_size: int, seq_len: int = 1,
                 plan: Optional[ParallelPlan] = None, amp_dtype=None):
-        self.plan = plan or Planner(self.n_devices, self.cluster).plan(
+        # Engine executes via SPMDTrainStep, whose axes are dp/mp/sharding:
+        # the auto-search is restricted to that executable subspace (pp/sp
+        # plans are for HybridCommunicateGroup-driven engines — picking one
+        # here would run pp*sp redundant replicas while the cost model
+        # credits a speedup)
+        self.plan = plan or Planner(self.n_devices, self.cluster,
+                                    max_pp=1, enable_sp=False).plan(
             self.model, batch_size, seq_len)
         axes = dict(self.plan.mesh_shape)
         if self.plan.sharding_stage > 0:
@@ -49,6 +55,13 @@ class Engine:
             # applies slot/param sharding to it
             axes = {"sharding": axes.pop("dp"), **axes}
         axes = {k: v for k, v in axes.items() if v > 1} or {"dp": 1}
+        # Mapper ordering: heaviest talker (mp) innermost = adjacent devices
+        # on the physical mesh; 'sharding' ranks like 'dp' (outermost)
+        from .planner import Mapper
+        rank = {a: i for i, a in enumerate(Mapper.ORDER)}
+        axes = dict(sorted(axes.items(),
+                           key=lambda kv: rank.get(
+                               "dp" if kv[0] == "sharding" else kv[0], 0)))
         self.mesh = create_mesh(axes)
         if self.plan.mp > 1:
             self._annotate_mp()

@@ -1,19 +1,23 @@
-"""Planner — pick a dp x mp x sharding plan for a model on N devices.
+"""Planner — pick a dp x mp x pp x sp plan for a model on N devices.
 
 Reference parity: `python/paddle/distributed/auto_parallel/planner.py`
-(search over partitioned programs scored by the cost model; the mapper
-assigns ranks to hardware).
+(search over partitioned programs scored by the cost model),
+`partitioner.py` (apply the chosen distribution to the program) and
+`mapper.py` (assign logical ranks to physical hardware).
 
-TPU-native: the search space is mesh factorizations (dp, mp) of the chip
-count plus a ZeRO stage; each candidate is scored with the roofline cost
-model and infeasible ones (HBM overflow) are discarded. Deterministic and
-cheap — no program partitioning is needed because GSPMD does the actual
-slicing from the chosen mesh + annotations.
+TPU-native: the search space is mesh factorizations (dp, mp, pp, sp) of
+the chip count plus a ZeRO stage; each candidate is scored with the
+topology-aware roofline cost model and infeasible ones (HBM overflow)
+are discarded. The Partitioner emits GSPMD-level artifacts (mesh shape,
+param specs, pipeline stage split) instead of a rewritten ProgramDesc —
+XLA does the actual slicing. The Mapper orders logical axes onto the
+physical ICI mesh so the most communication-intensive axis gets the
+nearest neighbors.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -26,10 +30,17 @@ class ParallelPlan:
     mp: int
     sharding_stage: int
     cost: PlanCost
+    pp: int = 1
+    sp: int = 1
     mesh_shape: dict = field(default_factory=dict)
 
     def __post_init__(self):
-        self.mesh_shape = {"dp": self.dp, "mp": self.mp}
+        # 'dp' is ALWAYS present (consumers rename it to 'sharding' for
+        # ZeRO); other axes appear only when >1
+        self.mesh_shape = {"dp": self.dp}
+        self.mesh_shape.update({k: v for k, v in
+                                (("mp", self.mp), ("pp", self.pp),
+                                 ("sp", self.sp)) if v > 1})
 
 
 def _divisors(n):
@@ -37,9 +48,12 @@ def _divisors(n):
 
 
 class Planner:
-    def __init__(self, n_devices: int, cluster: Optional[ClusterInfo] = None):
+    def __init__(self, n_devices: int, cluster: Optional[ClusterInfo] = None,
+                 max_pp: int = 8, enable_sp: bool = True):
         self.n_devices = n_devices
         self.cluster = cluster or ClusterInfo()
+        self.max_pp = max_pp
+        self.enable_sp = enable_sp
 
     def model_stats(self, model, batch_size: int, seq_len: int = 1):
         """(param_bytes, flops_per_step, act_bytes_per_layer, n_layers)
@@ -55,28 +69,122 @@ class Planner:
         act_bytes = 2.0 * tokens * hidden  # bf16 activations
         return param_bytes, flops, act_bytes, n_layers
 
-    def candidates(self, param_bytes, flops, act_bytes, n_layers) -> List[ParallelPlan]:
+    def candidates(self, param_bytes, flops, act_bytes, n_layers,
+                   seq_len: int = 1) -> List[ParallelPlan]:
         out = []
-        for mp in _divisors(self.n_devices):
-            dp = self.n_devices // mp
-            for stage in (0, 1, 2):
-                if stage > 0 and dp == 1:
+        n = self.n_devices
+        for mp in _divisors(n):
+            for pp in _divisors(n // mp):
+                if pp > min(self.max_pp, n_layers):
                     continue
-                c = train_step_cost(param_bytes, flops, act_bytes, n_layers,
-                                    dp, mp, self.cluster, sharding_stage=stage)
-                if c.memory_per_chip <= self.cluster.hbm_bytes:
-                    out.append(ParallelPlan(dp, mp, stage, c))
+                for sp in _divisors(n // (mp * pp)):
+                    if sp > 1 and (not self.enable_sp or seq_len < 2 * sp):
+                        continue
+                    dp = n // (mp * pp * sp)
+                    for stage in (0, 1, 2):
+                        if stage > 0 and dp == 1:
+                            continue
+                        if stage > 0 and pp > 1:
+                            continue  # ZeRO+pp composition not searched
+                        c = train_step_cost(param_bytes, flops, act_bytes,
+                                            n_layers, dp, mp, self.cluster,
+                                            sharding_stage=stage, pp=pp,
+                                            sp=sp)
+                        if c.memory_per_chip <= self.cluster.hbm_bytes:
+                            out.append(ParallelPlan(dp, mp, stage, c,
+                                                    pp=pp, sp=sp))
         return out
 
     def plan(self, model=None, batch_size: int = 1, seq_len: int = 1,
              stats=None) -> ParallelPlan:
-        """Best feasible plan (min step time; ties -> smaller mp, then
-        smaller sharding stage — less comm machinery for equal speed)."""
+        """Best feasible plan (min step time; ties -> fewer exotic axes:
+        smaller mp, then pp, then sp, then sharding stage)."""
         if stats is None:
             stats = self.model_stats(model, batch_size, seq_len)
-        cands = self.candidates(*stats)
+        cands = self.candidates(*stats, seq_len=seq_len)
         if not cands:
             raise RuntimeError(
-                "no feasible plan: model exceeds HBM at every dp x mp x "
-                "sharding candidate")
-        return min(cands, key=lambda p: (p.cost.total, p.mp, p.sharding_stage))
+                "no feasible plan: model exceeds HBM at every "
+                "dp x mp x pp x sp x sharding candidate")
+        return min(cands, key=lambda p: (p.cost.total, p.mp, p.pp, p.sp,
+                                         p.sharding_stage))
+
+
+class Partitioner:
+    """Turn a ParallelPlan into GSPMD-level artifacts for a concrete model.
+
+    Reference parity: `auto_parallel/partitioner.py` rewrites the serial
+    program into a distributed one; here the 'program' is the (mesh,
+    annotations) pair GSPMD consumes plus a contiguous pipeline-stage
+    split of the layer list.
+    """
+
+    def __init__(self, plan: ParallelPlan):
+        self.plan = plan
+
+    def stage_split(self, n_layers: int) -> List[int]:
+        """stage index per layer — contiguous groups whose sizes differ by
+        at most one, so NO stage is ever empty (pp <= n_layers)."""
+        pp = max(self.plan.pp, 1)
+        return [min(i * pp // n_layers, pp - 1) for i in range(n_layers)]
+
+    def param_specs(self, shapes) -> List[tuple]:
+        """PartitionSpecs for an ordered parameter list under the plan:
+        consecutive 2D matmul weights alternate column-parallel then
+        row-parallel (megatron pairing — one all-reduce per pair instead
+        of an activation reshard between every matmul; same policy as
+        Engine._annotate_mp). Everything else replicates."""
+        out = []
+        col = True
+        for shape in shapes:
+            if self.plan.mp > 1 and len(shape) == 2:
+                out.append((None, "mp") if col else ("mp", None))
+                col = not col
+            else:
+                out.append(tuple(None for _ in shape))
+        return out
+
+    def partition(self, model):
+        """(mesh_shape, {param_name: spec}, stage_of_layer) for the model."""
+        names, shapes = [], []
+        for name, p in model.named_parameters():
+            names.append(name)
+            shapes.append(tuple(p.shape))
+        specs: Dict[str, tuple] = dict(zip(names, self.param_specs(shapes)))
+        try:
+            n_layers = len(model.layers)
+        except (AttributeError, TypeError):
+            n_layers = sum(1 for _ in model.children())
+        return self.plan.mesh_shape, specs, self.stage_split(max(n_layers, 1))
+
+
+class Mapper:
+    """Order logical mesh axes onto the physical device mesh.
+
+    Reference parity: `auto_parallel/mapper.py` maps ranks to machines by
+    comm volume. Here: jax mesh axes are laid out so the LAST axis gets
+    adjacent devices (best locality on the ICI torus); we therefore order
+    axes by descending per-step communication intensity — mp (per-layer
+    activation allreduces) > sp (ring p2p per layer) > pp (per-micro p2p)
+    > dp (one bucketed grad allreduce) — so the heaviest talker sits on
+    neighboring chips.
+    """
+
+    ORDER = ("dp", "pp", "sp", "mp")  # least -> most comm-intensive
+
+    def __init__(self, cluster: Optional[ClusterInfo] = None):
+        self.cluster = cluster or ClusterInfo()
+
+    def axis_order(self, mesh_shape: Dict[str, int]) -> List[str]:
+        return [a for a in self.ORDER if mesh_shape.get(a, 1) >= 1
+                and a in mesh_shape]
+
+    def device_mesh(self, mesh_shape: Dict[str, int]):
+        """A jax Mesh with axes ordered for ICI locality."""
+        import jax
+        from jax.sharding import Mesh
+        names = self.axis_order(mesh_shape)
+        sizes = [mesh_shape[a] for a in names]
+        n = int(np.prod(sizes)) if sizes else 1
+        devs = np.asarray(jax.devices()[:n]).reshape(sizes or (1,))
+        return Mesh(devs, tuple(names) or ("dp",))
