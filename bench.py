@@ -173,6 +173,24 @@ def bench_resnet50_infer(backend):
     return out
 
 
+def bench_resnet50_infer_int8(backend):
+    """Weight-only int8 ResNet-50 through the Predictor: int8 params live
+    in HBM, per-channel dequant to bf16 fuses into each conv (export-time
+    quantization; mkldnn_quantizer/TRT-int8 role)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+
+    if backend != "tpu":
+        return {"skipped": "needs real chip"}
+    batch = 128
+    paddle.seed(0)
+    net = models.resnet50(data_format="NHWC")
+    med, spread = _predictor_rate(net, (batch, 224, 224, 3), 200, 3,
+                                  precision="int8")
+    return {"imgs_per_sec": round(med, 2), "spread": round(spread, 3),
+            "batch": batch, "precision": "int8-weight-only"}
+
+
 def bench_lenet_dispatch(backend):
     """Imperative (eager, per-op dispatch) fwd+bwd+step latency — the
     reference dygraph hot loop (SURVEY §3.2)."""
@@ -413,6 +431,7 @@ def main():
     ernie = bench_ernie_train(backend)
     flash = bench_flash_attention(backend)
     extra = {"resnet50_infer": bench_resnet50_infer(backend),
+             "resnet50_infer_int8": bench_resnet50_infer_int8(backend),
              "lenet_dispatch": bench_lenet_dispatch(backend),
              f"flash_attn_{flash.get('seq', 'na')}": flash,
              "yoloe_infer": bench_yoloe_infer(backend),

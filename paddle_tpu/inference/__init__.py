@@ -25,6 +25,7 @@ class Config:
         self._device = "tpu"
         self._precision = "float32"
         self._memory_optim = True
+        self._quant = False
         self._options = {}  # recorded knobs: TPU-mapped or explicit N/A
 
     # paddle API spellings
@@ -54,6 +55,20 @@ class Config:
         # TRT subgraphs ⇒ XLA whole-graph; the precision hint IS honored
         self._precision = precision_mode if isinstance(precision_mode, str) else "float16"
         self._options["trt"] = f"mapped-to-XLA (precision={self._precision})"
+        if self._precision == "int8":
+            # the standard paddle int8 spelling routes through the same
+            # quant verification as enable_quant()
+            self.enable_quant()
+
+    def enable_quant(self, bits=8):
+        """Serve a weight-only int8 artifact (mkldnn_quantizer/TRT-int8
+        role): the artifact must have been exported with
+        jit.save(..., precision='int8') — quantization is an export-time
+        transform here, the predictor verifies and runs it."""
+        if bits != 8:
+            raise ValueError("only int8 weight-only quantization is supported")
+        self._options["quant"] = "int8-weight-only"
+        self._quant = True
 
     def switch_use_feed_fetch_ops(self, flag):
         self._options["feed_fetch_ops"] = bool(flag)  # zero-copy either way
@@ -127,6 +142,14 @@ class Predictor:
                     f"Cannot open model file {path}.pdmodel\n"
                     "  [Hint] save the model with paddle_tpu.jit.save first.")
             self._translated = jload(path)
+            meta = self._translated._meta
+            is_int8 = (meta.get("precision") == "int8"
+                       or bool(meta.get("quantized")))
+            if getattr(cfg, "_quant", False) and not is_int8:
+                from ..core.enforce import InvalidArgumentError
+                raise InvalidArgumentError(
+                    "Config.enable_quant() requires an int8 artifact\n"
+                    "  [Hint] re-export with jit.save(..., precision='int8')")
             specs = self._translated._meta["input_specs"]
             self._input_names = [f"input_{i}" for i in range(len(specs))]
             # the artifact's exported signature decides the feed dtype: a

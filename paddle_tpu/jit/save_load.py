@@ -54,10 +54,13 @@ def save(layer, path, input_spec=None, **configs):
     # cast so XLA keeps every conv/matmul on the bf16 MXU path.
     precision = configs.pop("precision", None)
     if precision not in (None, "float32", "bfloat16", "float16", "half",
-                         "bf16", "fp16"):
+                         "bf16", "fp16", "int8", "int8_weight_only"):
         raise ValueError(f"unsupported save precision {precision!r}; "
-                         "use 'float32' or 'bfloat16'")
-    if precision in ("bfloat16", "float16", "half", "bf16", "fp16"):
+                         "use 'float32', 'bfloat16' or 'int8' (weight-only)")
+    quantized_names = []
+    orig_parrs = list(parrs)  # pre-cast values: int8 quantizes from fp32
+    if precision in ("bfloat16", "float16", "half", "bf16", "fp16",
+                     "int8", "int8_weight_only"):
         cast = jnp.bfloat16  # fp16 maps to bf16 on TPU (same MXU path)
         parrs = [a.astype(cast) if jnp.issubdtype(a.dtype, jnp.floating) else a
                  for a in parrs]
@@ -67,12 +70,58 @@ def save(layer, path, input_spec=None, **configs):
                                                                 np.floating) else s.dtype,
                            getattr(s, "name", None))
                  for s in specs]
+    qmask = [False] * len(parrs)
+    if precision in ("int8", "int8_weight_only"):
+        # weight-only int8: matmul/conv weights become int8 ARGUMENTS of the
+        # exported program with per-channel scales appended to the buffer
+        # list; dequant to bf16 happens INSIDE the trace, which XLA fuses
+        # into the consumer — int8 is what sits in HBM. TPU-native stand-in
+        # for the reference's TRT/mkldnn int8 engines
+        # (inference/api/mkldnn_quantizer.cc role).
+        from ..quantization import channel_quant
+        scales = []
+        new_parrs = []
+        for i, (n, a, orig) in enumerate(zip(pnames, parrs, orig_parrs)):
+            if a.ndim >= 2 and jnp.issubdtype(a.dtype, jnp.floating):
+                # quantize the ORIGINAL (pre-bf16-cast) values: double
+                # rounding through bf16 would waste int8 grid accuracy
+                q, scale = channel_quant(np.asarray(orig, dtype=np.float32))
+                new_parrs.append(jnp.asarray(q))
+                scales.append(jnp.asarray(scale))
+                qmask[i] = True
+                quantized_names.append(n)
+            else:
+                new_parrs.append(a)
+        parrs = new_parrs
+        n_model_buffers = len(barrs)
+        barrs = list(barrs) + scales
+        bnames = bnames + [f"__scale__{n}" for n in quantized_names]
 
     from .functional import functional_call
 
-    def pure(params, buffers, *inputs):
-        out = functional_call(model, pnames, params, bnames, buffers, *inputs)
-        return out
+    if quantized_names:
+        model_bnames = bnames[:n_model_buffers]
+
+        def pure(params, buffers, *inputs):
+            real_b = list(buffers[:n_model_buffers])
+            sc = list(buffers[n_model_buffers:])
+            ps, si = [], 0
+            for flag, p in zip(qmask, params):
+                if flag:
+                    # dequant in-trace: XLA fuses this into the matmul/conv
+                    # reading the weight, so HBM keeps the int8 bytes
+                    ps.append(p.astype(jnp.bfloat16)
+                              * sc[si].astype(jnp.bfloat16))
+                    si += 1
+                else:
+                    ps.append(p)
+            return functional_call(model, pnames, ps, model_bnames, real_b,
+                                   *inputs)
+    else:
+        def pure(params, buffers, *inputs):
+            out = functional_call(model, pnames, params, bnames, buffers,
+                                  *inputs)
+            return out
 
     arg_specs = (
         [jax.ShapeDtypeStruct(tuple(1 if d == -1 else d for d in s.shape), s.dtype)
@@ -96,6 +145,12 @@ def save(layer, path, input_spec=None, **configs):
             # npz stores bf16 as raw void ('|V2'); dtypes let load re-view
             "param_dtypes": [np.dtype(a.dtype).name for a in parrs],
             "buffer_dtypes": [np.dtype(a.dtype).name for a in barrs],
+            # weight-only int8 artifacts list their quantized params;
+            # "precision" distinguishes an int8 EXPORT with zero
+            # quantizable tensors from a non-int8 artifact
+            "quantized": quantized_names,
+            "precision": ("int8" if precision in ("int8", "int8_weight_only")
+                          else (precision or "float32")),
             # version stamping (framework/version.cc + op_version_registry)
             "framework_version": FRAMEWORK_VERSION,
             "op_versions": GLOBAL_OP_VERSION_REGISTRY.snapshot()}

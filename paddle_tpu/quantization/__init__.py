@@ -180,6 +180,22 @@ class PTQ:
 
 
 # ---- true int8 storage (weight-only deployment) ----
+def channel_quant(w: np.ndarray, bits: int = 8
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel symmetric int quantization of one weight array:
+    (q int8, scale f32 broadcastable). Channel axis = out-features (axis 1
+    for [in, out] linears, axis 0 for OIHW convs). Single source of truth
+    for the int8 grid — jit.save's weight-only export uses it too."""
+    w = np.asarray(w, dtype=np.float32)
+    qmin, qmax = _qrange(bits)
+    ch_axis = 1 if w.ndim == 2 else 0
+    axes = tuple(i for i in range(w.ndim) if i != ch_axis)
+    scale = np.maximum(np.abs(w).max(axis=axes, keepdims=True) / qmax,
+                       1e-9).astype(np.float32)
+    q = np.clip(np.round(w / scale), qmin, qmax).astype(np.int8)
+    return q, scale
+
+
 def quantize_weights(model: nn.Layer, bits: int = 8
                      ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
     """Per-channel symmetric int8 of every 2-D+ weight: name -> (q, scale).
@@ -187,16 +203,11 @@ def quantize_weights(model: nn.Layer, bits: int = 8
     impact is visible immediately); the returned dict is the artifact to
     ship (int8 HBM footprint)."""
     out = {}
-    qmin, qmax = _qrange(bits)
     for name, p in model.named_parameters():
         if len(p.shape) < 2:
             continue
-        w = np.asarray(p._value)
-        ch_axis = 1 if len(p.shape) == 2 else 0
-        axes = tuple(i for i in range(w.ndim) if i != ch_axis)
-        scale = np.maximum(np.abs(w).max(axis=axes, keepdims=True) / qmax, 1e-9)
-        q = np.clip(np.round(w / scale), qmin, qmax).astype(np.int8)
-        out[name] = (q, scale.astype(np.float32))
+        q, scale = channel_quant(np.asarray(p._value), bits)
+        out[name] = (q, scale)
         p._value = jnp.asarray(q.astype(np.float32) * scale)
     return out
 

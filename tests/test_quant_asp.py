@@ -229,3 +229,67 @@ class TestWrapperOptimizers:
         ma.apply()  # must not clobber the backup with averaged weights
         ma.restore()
         np.testing.assert_array_equal(np.asarray(net.fc1.weight._value), train_w)
+
+
+class TestQuantPredictor:
+    """Quantization wired into the inference Predictor (VERDICT r2 #8:
+    mkldnn_quantizer.cc / TRT-int8 role, export-time on TPU)."""
+
+    def _save(self, tmp_path, precision=None):
+        import os
+        import paddle_tpu as paddle
+        from paddle_tpu import models
+        from paddle_tpu.jit import InputSpec, save
+        paddle.seed(0)
+        net = models.LeNet(num_classes=10)
+        net.eval()
+        p = str(tmp_path / f"m_{precision or 'fp32'}")
+        kw = {"precision": precision} if precision else {}
+        save(net, p, input_spec=[InputSpec([4, 1, 28, 28], "float32")], **kw)
+        return p, os.path.getsize(p + ".pdiparams.npz")
+
+    def test_int8_predictor_runs_close_to_fp32(self, tmp_path):
+        import numpy as np
+        from paddle_tpu.inference import Config, create_predictor
+        p32, sz32 = self._save(tmp_path)
+        p8, sz8 = self._save(tmp_path, "int8")
+        assert sz8 < sz32 * 0.45, (sz8, sz32)  # int8 + scales vs fp32
+
+        x = np.random.RandomState(0).rand(4, 1, 28, 28).astype("float32")
+
+        def run(path, quant=False):
+            cfg = Config(path)
+            if quant:
+                cfg.enable_quant()
+            pred = create_predictor(cfg)
+            h = pred.get_input_handle(pred.get_input_names()[0])
+            h.copy_from_cpu(x)
+            pred.run()
+            return pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+
+        ref = run(p32)
+        got = run(p8, quant=True)
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.08, rel  # weight-only int8 accuracy delta
+
+    def test_int8_artifact_params_are_int8(self, tmp_path):
+        import numpy as np
+        from paddle_tpu.jit import load
+        p8, _ = self._save(tmp_path, "int8")
+        tl = load(p8)
+        qnames = tl._meta["quantized"]
+        assert qnames, "no quantized params recorded"
+        by_name = dict(zip(tl._meta["param_names"], tl._params))
+        for n in qnames:
+            assert by_name[n].dtype == np.int8, (n, by_name[n].dtype)
+        # scales shipped as extra buffers
+        assert any(b.startswith("__scale__") for b in tl._meta["buffer_names"])
+
+    def test_enable_quant_on_fp32_artifact_raises(self, tmp_path):
+        import pytest as _pytest
+        from paddle_tpu.inference import Config, create_predictor
+        p32, _ = self._save(tmp_path)
+        cfg = Config(p32)
+        cfg.enable_quant()
+        with _pytest.raises(Exception, match="int8 artifact"):
+            create_predictor(cfg)
