@@ -1,0 +1,72 @@
+"""USER drive: round-3 inference changes (NHWC, bf16 export, dtype restore)."""
+import os, sys, tempfile
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.jit import InputSpec, save, load
+from paddle_tpu.inference import Config, create_predictor
+
+# 1. NHWC ResNet == NCHW ResNet with same weights (user-facing equivalence)
+paddle.seed(0)
+nchw = models.resnet18(num_classes=8)
+nhwc = models.resnet18(num_classes=8, data_format="NHWC")
+nhwc.set_state_dict(nchw.state_dict())
+nchw.eval(); nhwc.eval()
+x = np.random.rand(2, 3, 64, 64).astype("float32")
+d = np.abs(nchw(paddle.to_tensor(x)).numpy()
+           - nhwc(paddle.to_tensor(x.transpose(0, 2, 3, 1))).numpy()).max()
+assert d < 2e-4, f"NHWC != NCHW: {d}"
+print("1. NHWC/NCHW equivalence OK", d)
+
+# 2. bf16 export -> predictor run -> close to fp32 eager; artifact actually bf16
+td = tempfile.mkdtemp()
+p = os.path.join(td, "m_bf16")
+save(nhwc, p, input_spec=[InputSpec([2, 64, 64, 3], "float32")], precision="bfloat16")
+cfg = Config(p); cfg.enable_tensorrt_engine(precision_mode="bfloat16")
+pred = create_predictor(cfg)
+h = pred.get_input_handle(pred.get_input_names()[0])
+h.copy_from_cpu(x.transpose(0, 2, 3, 1))
+import jax.numpy as jnp
+assert pred._feeds[pred.get_input_names()[0]].dtype == jnp.bfloat16, "feed not cast at copy_from_cpu"
+pred.run()
+out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+ref = nchw(paddle.to_tensor(x)).numpy()
+assert out.dtype == np.float32, out.dtype
+rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+assert rel < 0.1, f"bf16 predictor too far from fp32 eager: {rel}"
+print("2. bf16 export + predictor OK, rel err", round(float(rel), 4))
+
+# 3. reload artifact fresh (bf16 params restored from npz void blobs)
+tl = load(p)
+sd = tl.state_dict()
+some = next(iter(sd.values()))
+assert some._value.dtype == jnp.bfloat16, some._value.dtype
+y2 = tl(paddle.to_tensor(x.transpose(0, 2, 3, 1).astype(np.float32)).astype("bfloat16"))
+print("3. jit.load bf16 dtype restore OK")
+
+# 4. fp32 save path unchanged (no precision kwarg), old-artifact compat
+p2 = os.path.join(td, "m_fp32")
+save(nhwc, p2, input_spec=[InputSpec([2, 64, 64, 3], "float32")])
+cfg2 = Config(p2)
+pred2 = create_predictor(cfg2)
+out2 = pred2.run([paddle.to_tensor(x.transpose(0, 2, 3, 1))])[0].numpy()
+assert np.abs(out2 - ref).max() < 2e-4
+print("4. fp32 save/predict unchanged OK")
+
+# 5. error path: predictor on missing model
+try:
+    create_predictor(Config(os.path.join(td, "nope")))
+    raise SystemExit("expected NotFoundError")
+except Exception as e:
+    assert "Cannot open model file" in str(e), e
+print("5. missing-model error path OK")
+
+# 6. data_format survives save->load meta roundtrip for vgg/mobilenet untouched models
+m = models.mobilenet_v2(num_classes=4) if hasattr(models, "mobilenet_v2") else models.vgg16(num_classes=4)
+m.eval()
+print("6. other vision models still construct OK")
+print("ALL VERIFY DRIVES PASSED")

@@ -1,0 +1,70 @@
+"""USER drive: a CTR-serving-style workflow over the deepened PS tier."""
+import os, sys, tempfile
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.ps import PsServer, PsClient, Communicator, DistributedEmbedding
+from paddle_tpu.distributed.ps.table import SSDSparseTable
+
+# a realistic CTR loop: 2 servers, adam+ctr sparse table, show/click feed,
+# nightly decay+shrink, then an SSD-spill table holding more rows than RAM cap
+servers = [PsServer() for _ in range(2)]
+tables = []
+for s in servers:
+    tables.append(s.add_sparse_table("ctr", dim=8, optimizer="adam", lr=0.05,
+                                     accessor="ctr", delete_threshold=0.5,
+                                     ttl_days=7))
+    s.run()
+client = PsClient([f"{s.host}:{s.port}" for s in servers])
+client.register_sparse_dim("ctr", 8)
+comm = Communicator(client)
+emb = DistributedEmbedding(client, "ctr", dim=8, communicator=comm)
+paddle.seed(0)
+head = nn.Linear(16, 2)
+opt = paddle.optimizer.Adam(parameters=head.parameters(), learning_rate=0.05)
+ce = nn.CrossEntropyLoss()
+rng = np.random.default_rng(0)
+ids = rng.integers(0, 100, (32, 2))
+y = paddle.to_tensor((ids.sum(1) % 2).astype(np.int32))
+losses = []
+for _ in range(12):
+    e = emb(paddle.to_tensor(ids))
+    loss = ce(head(e.reshape([32, 16])), y)
+    loss.backward(); opt.step(); opt.clear_grad(); comm.flush()
+    losses.append(float(loss))
+assert losses[-1] < losses[0] * 0.8, losses
+print("1. CTR train through adam PS descends:", round(losses[0], 3), "->", round(losses[-1], 3))
+
+# show/click stats + nightly maintenance on the server tables
+for t in tables:
+    seen = list(t._rows)[:5]
+    if seen:
+        t.push_show_click(seen, [5.0] * len(seen), [1.0] * len(seen))
+n_before = sum(len(t) for t in tables)
+for t in tables:
+    for _ in range(8):       # 8 decay cycles > ttl 7 for never-re-seen rows
+        t.decay()
+evicted = sum(t.shrink() for t in tables)
+assert evicted > 0
+print(f"2. nightly decay+shrink evicted {evicted}/{n_before} rows")
+comm.stop(); client.close()
+for s in servers:
+    s.stop()
+
+# SSD-spill tier
+td = tempfile.mkdtemp()
+t = SSDSparseTable(dim=4, path=os.path.join(td, "big"), cache_rows=8,
+                   optimizer="lazy_adam", lr=0.1, seed=2)
+all_ids = list(range(50))
+rows = t.pull(all_ids)
+assert t.resident_rows <= 8 and len(t) == 50
+t.push(all_ids[:3], np.ones((3, 4), np.float32))
+rows2 = t.pull(all_ids)
+assert not np.allclose(rows2[:3], rows[:3]) and np.allclose(rows2[10:], rows[10:])
+print("3. SSD spill table: 50 rows, <=8 resident, updates correct across spill")
+t.close()
+print("ALL VERIFY DRIVES PASSED")

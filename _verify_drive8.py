@@ -1,0 +1,45 @@
+"""USER drive: a capacity-planning session with the auto-parallel planner."""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.auto_parallel import (ClusterInfo, Mapper,
+                                                  Partitioner, Planner)
+from paddle_tpu import models
+
+# 1. plan a REAL model (titan-geometry 4-layer proxy) on a 16-chip cluster
+net = models.ErnieModel(vocab_size=1000, hidden_size=512, num_hidden_layers=4,
+                        num_attention_heads=8, intermediate_size=2048)
+planner = Planner(16, ClusterInfo(ici_mesh=(4, 4)))
+plan = planner.plan(net, batch_size=8, seq_len=4096)
+print("1. plan for 16 chips:", plan.mesh_shape, "stage", plan.sharding_stage,
+      f"est step {plan.cost.total*1e3:.2f}ms mem {plan.cost.memory_per_chip/1e9:.2f}GB")
+assert plan.dp * plan.mp * plan.pp * plan.sp == 16
+
+# 2. a long-context config must surface sp candidates
+cands = planner.candidates(*planner.model_stats(net, 2, 131072), seq_len=131072)
+assert any(c.sp > 1 for c in cands), "no sp candidates at 128k seq"
+print("2. sp candidates exist at 128k seq:",
+      sorted({(c.dp, c.mp, c.pp, c.sp) for c in cands if c.sp > 1})[:4])
+
+# 3. DCN-crossing axes cost more
+small_dom = ClusterInfo(ici_mesh=(2, 2))
+p_ici = Planner(4, small_dom).plan(net, batch_size=8, seq_len=1024)
+p_dcn = Planner(16, small_dom).plan(net, batch_size=8, seq_len=1024)
+print("3. 4-chip (all-ICI) vs 16-chip (DCN) plans:", p_ici.mesh_shape, p_dcn.mesh_shape)
+assert p_dcn.mp <= small_dom.ici_domain  # heavy axis stays in-domain
+
+# 4. Partitioner artifacts feed a jax mesh via the Mapper
+part = Partitioner(plan)
+mesh_shape, specs, stages = part.partition(net)
+assert len(stages) >= 1 and len(specs) == len(list(net.named_parameters()))
+mapper = Mapper()
+mesh_shape8 = {"dp": 2, "mp": 2, "sp": 2}
+mesh = mapper.device_mesh(mesh_shape8)
+assert mesh.axis_names[-1] == "mp" and mesh.devices.size == 8
+print("4. Partitioner -> Mapper -> jax Mesh:", mesh.axis_names, mesh.devices.shape)
+print("ALL VERIFY DRIVES PASSED")

@@ -132,6 +132,10 @@ class TCPStore:
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1,
                  timeout=30):
+        import threading
+        # one client socket per store object: requests/responses must pair
+        # up, so concurrent use from heartbeat/watcher threads serializes
+        self._io_lock = threading.Lock()
         lib = _load()
         self._lib = lib if lib else None
         self._server = None
@@ -159,14 +163,18 @@ class TCPStore:
         if self._py is not None:
             self._py[key] = data
             return
-        if self._lib.tcpstore_set(self._client, key.encode(), data, len(data)) != 0:
+        with self._io_lock:
+            rc = self._lib.tcpstore_set(self._client, key.encode(), data,
+                                        len(data))
+        if rc != 0:
             raise RuntimeError("TCPStore.set failed")
 
     def get(self, key: str) -> bytes:
         if self._py is not None:
             return self._py[key]
         buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.tcpstore_get(self._client, key.encode(), buf, 1 << 20)
+        with self._io_lock:
+            n = self._lib.tcpstore_get(self._client, key.encode(), buf, 1 << 20)
         if n == -1:
             raise KeyError(key)
         if n < 0:
@@ -177,7 +185,8 @@ class TCPStore:
         if self._py is not None:
             self._py[key] = str(int(self._py.get(key, b"0")) + amount).encode()
             return int(self._py[key])
-        v = self._lib.tcpstore_add(self._client, key.encode(), amount)
+        with self._io_lock:
+            v = self._lib.tcpstore_add(self._client, key.encode(), amount)
         if v == -(2 ** 63):
             raise RuntimeError("TCPStore.add failed")
         return v
@@ -187,7 +196,9 @@ class TCPStore:
         if self._py is not None:
             return
         for k in keys:
-            if self._lib.tcpstore_wait(self._client, k.encode()) != 0:
+            with self._io_lock:
+                rc = self._lib.tcpstore_wait(self._client, k.encode())
+            if rc != 0:
                 raise RuntimeError("TCPStore.wait failed")
 
     def __del__(self):

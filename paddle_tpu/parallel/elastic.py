@@ -86,6 +86,55 @@ class ElasticManager:
                 return []
             time.sleep(interval)
 
+    # -- scale-out (manager.py:215-266 world-size-change role) --
+    def announce_join(self, node_id: str = "") -> int:
+        """A NEW node announces itself to the gang's store; returns its
+        join ticket. The controller absorbs pending tickets at the next
+        re-rendezvous, growing the world size."""
+        seq = self.store.add("elastic:join_seq", 1)
+        self.store.set(f"elastic:join:{seq}",
+                       f"{time.time()!r}:{node_id}")
+        return seq
+
+    def pending_joins(self, absorbed: int = 0) -> List[int]:
+        """Join tickets newer than `absorbed` whose announcement is still
+        fresh (within the lease TTL x 6 — joiners wait for the gang)."""
+        try:
+            # add(0) reads the counter (native add-counters live in their
+            # own namespace; plain get can't see them)
+            seq = int(self.store.add("elastic:join_seq", 0))
+        except Exception:
+            return []
+        now = time.time()
+        out = []
+        for i in range(absorbed + 1, seq + 1):
+            try:
+                raw = self.store.get(f"elastic:join:{i}").decode()
+                ts = float(raw.split(":", 1)[0])
+            except (KeyError, ValueError):
+                continue
+            if now - ts <= self.lease_ttl * 6:
+                out.append(i)
+        return out
+
+    def watch_membership(self, interval: float = 1.0,
+                         max_wait: Optional[float] = None,
+                         absorbed: int = 0):
+        """Block until membership CHANGES either way:
+        ('scale_in', dead_ranks) | ('scale_out', join_tickets) |
+        ('steady', []) on timeout."""
+        start = time.time()
+        while True:
+            dead = self.dead_ranks()
+            if dead:
+                return ("scale_in", dead)
+            joins = self.pending_joins(absorbed)
+            if joins:
+                return ("scale_out", joins)
+            if max_wait is not None and time.time() - start > max_wait:
+                return ("steady", [])
+            time.sleep(interval)
+
 
 class ElasticResult:
     def __init__(self, restarts: int, returncodes: Sequence[int]):
@@ -100,43 +149,62 @@ class ElasticResult:
 def launch_elastic(training_script: str, script_args: Sequence[str] = (),
                    nprocs: int = 2, max_restarts: int = 3,
                    poll_interval: float = 0.2, env: Optional[dict] = None,
-                   timeout: float = 300.0) -> ElasticResult:
-    """Gang launcher with relaunch loop (elastic/__init__.py:48 role).
+                   timeout: float = 300.0, store=None,
+                   max_np: Optional[int] = None) -> ElasticResult:
+    """Gang launcher with relaunch + scale loop (elastic/__init__.py:48 +
+    manager.py:215-266 world-size-change roles).
 
-    Spawns `nprocs` ranks of `training_script`; if ANY rank dies non-zero,
-    the remaining ranks are killed and the whole gang is relaunched (up to
-    `max_restarts` times) with PADDLE_ELASTIC_RESTART_COUNT advanced —
-    collective jobs restart as a unit, matching the reference's collective
-    elastic mode.
+    Spawns `nprocs` ranks of `training_script`. Events:
+    - a rank dying non-zero kills the gang and relaunches it (up to
+      `max_restarts` times) — collective jobs restart as a unit;
+    - with a `store`, a join announcement (ElasticManager.announce_join
+      from a NEW node) triggers a re-rendezvous: the gang is killed and
+      relaunched with world size grown by the pending joins (capped at
+      `max_np`). Scale events do NOT consume the failure budget.
+    Each (re)launch exports the CURRENT world size via
+    PADDLE_TRAINERS_NUM/PADDLE_ELASTIC_NP, so AutoCheckpoint-driven
+    scripts restore their snapshot and resume at the new membership.
     """
     base_env = dict(os.environ if env is None else env)
-    for attempt in range(max_restarts + 1):
+    watcher = ElasticManager(store, rank=-1, world_size=0) if store else None
+    absorbed = 0
+    attempt = 0      # failure count (scale events don't advance it)
+    launches = 0
+    np_now = nprocs
+    procs: List[subprocess.Popen] = []
+    while attempt <= max_restarts:
         procs = []
-        for r in range(nprocs):
+        for r in range(np_now):
             e = dict(base_env)
             e.update({
                 "PADDLE_TRAINER_ID": str(r),
-                "PADDLE_TRAINERS_NUM": str(nprocs),
-                "PADDLE_ELASTIC_RESTART_COUNT": str(attempt),
-                "PADDLE_ELASTIC_NP": str(nprocs),
+                "PADDLE_TRAINERS_NUM": str(np_now),
+                "PADDLE_ELASTIC_RESTART_COUNT": str(launches),
+                "PADDLE_ELASTIC_NP": str(np_now),
             })
             procs.append(subprocess.Popen(
                 [sys.executable, training_script, *map(str, script_args)],
                 env=e))
+        launches += 1
         deadline = time.time() + timeout
-        failed = False
+        outcome = "done"
         while True:
             rcs = [p.poll() for p in procs]
             if any(rc is not None and rc != 0 for rc in rcs):
-                failed = True
+                outcome = "failed"
                 break
             if all(rc == 0 for rc in rcs):
                 break
+            if watcher is not None:
+                joins = watcher.pending_joins(absorbed)
+                if joins and (max_np is None or np_now + len(joins) <= max_np):
+                    outcome = "scale_out"
+                    break
             if time.time() > deadline:
-                failed = True
+                outcome = "failed"
                 break
             time.sleep(poll_interval)
-        if not failed:
+        if outcome == "done":
             return ElasticResult(attempt, [p.returncode for p in procs])
         for p in procs:  # kill the rest of the gang, then relaunch
             if p.poll() is None:
@@ -146,4 +214,12 @@ def launch_elastic(training_script: str, script_args: Sequence[str] = (),
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        if outcome == "scale_out":
+            joins = watcher.pending_joins(absorbed)
+            take = joins if max_np is None else \
+                joins[:max(0, max_np - np_now)]
+            absorbed = max(take or [absorbed])
+            np_now += len(take)
+        else:
+            attempt += 1
     return ElasticResult(max_restarts, [p.returncode for p in procs])

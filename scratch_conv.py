@@ -1,0 +1,52 @@
+"""Micro-bench: conv layout NCHW vs NHWC on representative ResNet-50 shapes."""
+import time, statistics, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+PEAK = 1.97e14
+B = 128
+# (cin, cout, hw, k, stride) representative ResNet-50 convs
+SHAPES = [
+    (3, 64, 224, 7, 2),     # stem
+    (64, 64, 56, 1, 1),
+    (64, 64, 56, 3, 1),
+    (128, 128, 28, 3, 1),
+    (256, 256, 14, 3, 1),
+    (512, 512, 7, 3, 1),
+    (1024, 256, 14, 1, 1),
+]
+
+def bench(cin, cout, hw, k, s, layout):
+    if layout == "NCHW":
+        x = jnp.zeros((B, cin, hw, hw), jnp.bfloat16)
+        dn = ("NCHW", "OIHW", "NCHW")
+        w = jnp.zeros((cout, cin, k, k), jnp.bfloat16)
+    else:
+        x = jnp.zeros((B, hw, hw, cin), jnp.bfloat16)
+        dn = ("NHWC", "HWIO", "NHWC")
+        w = jnp.zeros((k, k, cin, cout), jnp.bfloat16)
+    pad = "SAME"
+    @jax.jit
+    def f(x, w):
+        def body(c, _):
+            o = jax.lax.conv_general_dilated(x, w, (s, s), pad, dimension_numbers=dn)
+            return c + o.reshape(-1)[0].astype(jnp.float32), None
+        c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=20)
+        return c
+    r = f(x, w); r.block_until_ready()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); float(np.asarray(f(x, w))); ts.append(time.perf_counter() - t0)
+    dt = statistics.median(ts) / 20
+    out_hw = hw // s
+    flops = 2 * B * out_hw * out_hw * cout * cin * k * k
+    return dt * 1e3, flops / dt / PEAK
+
+for cin, cout, hw, k, s in SHAPES:
+    r = {}
+    for layout in ("NCHW", "NHWC"):
+        ms, mfu = bench(cin, cout, hw, k, s, layout)
+        r[layout] = (ms, mfu)
+    print(f"c{cin:4d}->{cout:4d} hw{hw:3d} k{k} s{s}: "
+          f"NCHW {r['NCHW'][0]:7.2f}ms mfu={r['NCHW'][1]:.3f} | "
+          f"NHWC {r['NHWC'][0]:7.2f}ms mfu={r['NHWC'][1]:.3f}", flush=True)

@@ -1,0 +1,35 @@
+import time, statistics, sys
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, ".")
+from paddle_tpu.kernels.flash_attention import _flash_core, _reference_bhsd
+
+PEAK = 1.97e14
+rng = np.random.RandomState(0)
+for s in (1024, 2048):
+    bh, d = 128, 64  # titan-ish: b2 x h64
+    q = jnp.asarray(rng.rand(bh, s, d).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+    k, v = q + 0.01, q + 0.02
+    def make(fn):
+        def loss(a, b, c):
+            return (fn(a, b, c).astype(jnp.float32) ** 2).sum()
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        def run(n):
+            out = None
+            for _ in range(n):
+                out = g(q, k, v)
+            return out[0]
+        return run
+    flash = make(lambda a, b, c: _flash_core(a, b, c, False, 512, 512, False))
+    ref = make(lambda a, b, c: _reference_bhsd(
+        a.astype(jnp.float32), b.astype(jnp.float32), c.astype(jnp.float32), False).astype(a.dtype))
+    for name, run in (("flash", flash), ("xla_f32ref", ref)):
+        r = run(2); float(np.asarray(r.reshape(-1)[0]))
+        n = 100
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = run(n); float(np.asarray(r.reshape(-1)[0]))
+            rates.append(n / (time.perf_counter() - t0))
+        med = statistics.median(rates)
+        print(f"s={s} {name}: {med:.1f} steps/s", flush=True)

@@ -1,0 +1,31 @@
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp
+from paddle_tpu.kernels.flash_attention import _flash_core, _reference_bhsd
+
+rng = np.random.RandomState(0)
+bh, s, d = 2, 256, 64
+q = jnp.asarray(rng.rand(bh, s, d).astype("float32") - 0.5).astype(jnp.bfloat16)
+k = jnp.asarray(rng.rand(bh, s, d).astype("float32") - 0.5).astype(jnp.bfloat16)
+v = jnp.asarray(rng.rand(bh, s, d).astype("float32") - 0.5).astype(jnp.bfloat16)
+q32, k32, v32 = (a.astype(jnp.float32) for a in (q, k, v))
+causal = True
+
+def f(a, b_, c):
+    return (_flash_core(a, b_, c, causal, 128, 128, True).astype(jnp.float32) ** 2).sum()
+def ref(a, b_, c):
+    return (_reference_bhsd(a, b_, c, causal).astype(jnp.float32) ** 2).sum()
+
+gk = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+gr32 = jax.grad(ref, argnums=(0, 1, 2))(q32, k32, v32)
+grbf = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+for i, nm in enumerate(("dq", "dk", "dv")):
+    a = np.asarray(gk[i], dtype=np.float32)
+    w32 = np.asarray(gr32[i], dtype=np.float32)
+    wbf = np.asarray(grbf[i], dtype=np.float32)
+    print(nm, "kernel-vs-f32oracle:", np.abs(a - w32).max() / np.abs(w32).max(),
+          " bf16ref-vs-f32oracle:", np.abs(wbf - w32).max() / np.abs(w32).max(),
+          " kernel-vs-bf16ref:", np.abs(a - wbf).max() / np.abs(wbf).max())

@@ -1,0 +1,42 @@
+import sys, threading, time, tempfile, textwrap, pathlib
+sys.path.insert(0, "/root/repo")
+from paddle_tpu._native import TCPStore
+from paddle_tpu.parallel.elastic import ElasticManager, launch_elastic
+
+tmp_path = pathlib.Path(tempfile.mkdtemp())
+script = tmp_path / "train.py"
+script.write_text(textwrap.dedent(f"""
+    import json, os, sys, time
+    sys.path.insert(0, "/root/repo")
+    from paddle_tpu.framework.sharded_io import AutoCheckpoint
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    ws = int(os.environ["PADDLE_TRAINERS_NUM"])
+    launch = int(os.environ["PADDLE_ELASTIC_RESTART_COUNT"])
+    log = open({str(tmp_path)!r} + f"/log_{{rank}}.txt", "a")
+    print(f"START rank{{rank}} ws{{ws}} launch{{launch}}", file=log, flush=True)
+    if rank == 1 and launch == 0:
+        time.sleep(0.4)
+        sys.exit(9)
+    if rank == 0:
+        state = {{}}
+        acp = AutoCheckpoint({str(tmp_path)!r} + "/ckpt",
+            save_fn=lambda p: open(p, "w").write(json.dumps(state)),
+            load_fn=lambda p: state.update(json.loads(open(p).read())))
+        for epoch in acp.train_epoch_range(8):
+            state["epoch"] = epoch
+            print(f"ws{{ws}} epoch{{epoch}}", file=log, flush=True)
+            time.sleep(0.35)
+    else:
+        time.sleep(0.35 * 8)
+    sys.exit(0)
+"""))
+store = TCPStore("127.0.0.1", 0, is_master=True)
+def join_later():
+    time.sleep(2.0)
+    ElasticManager(store, rank=-1, world_size=0).announce_join("n")
+th = threading.Thread(target=join_later); th.start()
+res = launch_elastic(str(script), nprocs=2, max_restarts=2, timeout=120,
+                     store=store, max_np=3)
+th.join()
+print("restarts:", res.restarts, "rcs:", res.returncodes)
+print(open(tmp_path / "log_0.txt").read())

@@ -1,0 +1,32 @@
+import sys
+import numpy as np
+sys.path.insert(0, ".")
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import models
+from paddle_tpu.jit import TrainStep
+
+batch, seqlen = 32, 128
+paddle.seed(0)
+base = models.ernie_base(hidden_dropout_prob=0.0)
+net = models.ErnieForPretraining(base)
+ce = nn.CrossEntropyLoss()
+def loss_fn(logits, nsp_logits, ids, nsp):
+    v = logits.shape[-1]
+    return ce(logits.reshape([-1, v]), ids.reshape([-1])) + ce(nsp_logits, nsp)
+opt = paddle.optimizer.AdamW(parameters=net.parameters(), learning_rate=1e-4)
+step = TrainStep(net, loss_fn, opt, amp_dtype="bfloat16", n_model_inputs=1)
+vocab = base.embeddings.word_embeddings.weight.shape[0]
+n_steps = 20
+ids_all = paddle.to_tensor(np.random.randint(0, vocab, (n_steps, batch, seqlen)).astype(np.int32))
+nsp_all = paddle.to_tensor(np.random.randint(0, 2, (n_steps, batch)).astype(np.int32))
+step._prepare((ids_all, ids_all, nsp_all))
+params = [t._value for t in step._ptensors]
+buffers = [t._value for t in step._btensors]
+lowered = jax.jit(step._jitted_scan.__wrapped__ if hasattr(step._jitted_scan, "__wrapped__") else None)
+# use the jitted object directly
+txt = step._jitted_scan.lower(params, step._slots, buffers, step._key, step._lr_arr,
+                              step._t_arr, [ids_all._value], [ids_all._value, nsp_all._value]).compile().as_text()
+open("_trace/ernie.hlo", "w").write(txt)
+print("hlo size", len(txt))

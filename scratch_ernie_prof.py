@@ -1,0 +1,33 @@
+import sys, time, glob
+import numpy as np
+sys.path.insert(0, ".")
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import models
+from paddle_tpu.jit import TrainStep
+
+batch, seqlen = 32, 128
+paddle.seed(0)
+base = models.ernie_base(hidden_dropout_prob=0.0)
+net = models.ErnieForPretraining(base)
+ce = nn.CrossEntropyLoss()
+
+def loss_fn(logits, nsp_logits, ids, nsp):
+    v = logits.shape[-1]
+    return ce(logits.reshape([-1, v]), ce.__class__ and ids.reshape([-1])) + ce(nsp_logits, nsp)
+
+opt = paddle.optimizer.AdamW(parameters=net.parameters(), learning_rate=1e-4)
+step = TrainStep(net, loss_fn, opt, amp_dtype="bfloat16", n_model_inputs=1)
+vocab = base.embeddings.word_embeddings.weight.shape[0]
+n_steps = 20
+ids_all = paddle.to_tensor(np.random.randint(0, vocab, (n_steps, batch, seqlen)).astype(np.int32))
+nsp_all = paddle.to_tensor(np.random.randint(0, 2, (n_steps, batch)).astype(np.int32))
+losses = step.run(ids_all, ids_all, nsp_all)
+float(np.asarray(losses._value.reshape(-1)[0]))
+import os
+os.makedirs("_trace", exist_ok=True)
+with jax.profiler.trace("_trace"):
+    losses = step.run(ids_all, ids_all, nsp_all)
+    float(np.asarray(losses._value.reshape(-1)[0]))
+print("done")

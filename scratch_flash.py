@@ -1,0 +1,37 @@
+import time, statistics, sys
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, ".")
+from paddle_tpu.kernels.flash_attention import _flash_core, _reference_bhsd
+
+PEAK = 1.97e14
+bh, s, d = 12, 8192, 64
+rng = np.random.RandomState(0)
+dt = jnp.bfloat16 if len(sys.argv) > 1 and sys.argv[1] == "bf16" else jnp.float32
+q = jnp.asarray(rng.rand(bh, s, d).astype(np.float32) * 0.1).astype(dt)
+k = jnp.asarray(rng.rand(bh, s, d).astype(np.float32) * 0.1).astype(dt)
+v = jnp.asarray(rng.rand(bh, s, d).astype(np.float32) * 0.1).astype(dt)
+
+def make(fn):
+    def loss(a, b, c):
+        return (fn(a, b, c).astype(jnp.float32) ** 2).sum()
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    def run(n):
+        out = None
+        for _ in range(n):
+            out = g(q, k, v)
+        return out[0]
+    return run
+
+flash = make(lambda a, b, c: _flash_core(a, b, c, True, 512, 512, False))
+ref = make(lambda a, b, c: _reference_bhsd(a, b, c, True))
+for name, run in (("flash", flash), ("xla_ref", ref)):
+    r = run(1); float(np.asarray(r.reshape(-1)[0]))
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = run(5); float(np.asarray(r.reshape(-1)[0]))
+        rates.append(5 / (time.perf_counter() - t0))
+    med = statistics.median(rates)
+    flops = 3.5 * 4 * s * s * d * bh * 0.5
+    print(f"{name} [{dt.__name__}]: {med:.2f} steps/s mfu={med*flops/PEAK:.4f}")

@@ -1,0 +1,21 @@
+import sys, glob
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, ".")
+from paddle_tpu.kernels.flash_attention import _flash_core
+
+bh, s, d = 12, 8192, 64
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.rand(bh, s, d).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+k = jnp.asarray(rng.rand(bh, s, d).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+v = jnp.asarray(rng.rand(bh, s, d).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+def loss(a, b, c):
+    return (_flash_core(a, b, c, True, 512, 512, False).astype(jnp.float32) ** 2).sum()
+g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+r = g(q, k, v); float(np.asarray(r[0].reshape(-1)[0]))
+import os
+os.makedirs("_trace2", exist_ok=True)
+with jax.profiler.trace("_trace2"):
+    for _ in range(5):
+        r = g(q, k, v)
+    float(np.asarray(r[0].reshape(-1)[0]))

@@ -1,0 +1,37 @@
+import time, statistics
+import numpy as np
+import jax, jax.numpy as jnp
+PEAK = 1.97e14; N = 300
+
+def bench(f, *args, n=N):
+    jf = jax.jit(f)
+    r = jf(*args); float(np.asarray(jax.tree_util.tree_leaves(r)[0].reshape(-1)[0]))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); float(np.asarray(jax.tree_util.tree_leaves(jf(*args))[0].reshape(-1)[0])); ts.append(time.perf_counter() - t0)
+    return statistics.median(ts) / n
+
+# floor: tiny matmul in scan
+x = jnp.zeros((128, 128), jnp.bfloat16)
+def tiny(x):
+    def body(c, _):
+        o = jnp.matmul(x * (1 + c).astype(x.dtype), x)
+        return o.reshape(-1)[0].astype(jnp.float32) * 1e-20, None
+    return jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=N)[0]
+print(f"tiny matmul/iter: {bench(tiny, x)*1e3:.4f} ms")
+
+# chained 1x1 convs: 8 convs per iter, channel 256->256 hw56, feed forward
+B = 128
+xc = jnp.zeros((B, 256, 56, 56), jnp.bfloat16)
+ws = [jnp.zeros((256, 256, 1, 1), jnp.bfloat16) for _ in range(8)]
+def chain(x, *ws):
+    def body(c, _):
+        h = x
+        for w in ws:
+            h = jax.lax.conv_general_dilated(h, w * (1 + c).astype(w.dtype), (1, 1), [(0, 0), (0, 0)],
+                                             dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return h.reshape(-1)[0].astype(jnp.float32) * 1e-20, None
+    return jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=N)[0]
+dt = bench(chain, xc, *ws)
+fl = 8 * 2 * B * 56 * 56 * 256 * 256
+print(f"1x1 c256 hw56 chained x8: {dt/8*1e3:.4f} ms/conv mfu={fl/dt/PEAK:.3f}")

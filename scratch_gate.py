@@ -1,0 +1,24 @@
+import sys
+sys.path.insert(0, ".")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models.ernie import ErnieLayer
+from paddle_tpu.jit import TrainStep
+
+import paddle_tpu.nn.functional.attention as att
+orig = att.scaled_dot_product_attention
+def spy(q, k, v, **kw):
+    print("SDPA q dtype:", q._value.dtype if hasattr(q, "_value") else q.dtype,
+          "shape:", q.shape, flush=True)
+    return orig(q, k, v, **kw)
+att.scaled_dot_product_attention = spy
+# ErnieSelfAttention imports inside forward: from ..nn.functional.attention import ...
+h, ffn, heads, seq, batch = 512, 2048, 8, 2048, 1
+net = ErnieLayer(h, heads, ffn, dropout=0.0)
+x = paddle.to_tensor(np.random.rand(batch, seq, h).astype("float32") * 0.02)
+from paddle_tpu.amp.state import *
+opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.01)
+step = TrainStep(net, lambda o: (o ** 2).mean(), opt, amp_dtype="bfloat16", n_model_inputs=1)
+loss = step(x)
+print("loss", float(loss))

@@ -1,0 +1,36 @@
+import sys
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, ".")
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.jit.functional import functional_call, split_state
+
+paddle.seed(0)
+net = models.resnet50(data_format="NHWC"); net.eval()
+trainable, frozen = split_state(net)
+pnames, bnames = list(trainable), list(frozen)
+dtype = jnp.bfloat16
+p = [trainable[n]._value.astype(dtype) if jnp.issubdtype(trainable[n]._value.dtype, jnp.floating) else trainable[n]._value for n in pnames]
+b = [frozen[n]._value.astype(dtype) if jnp.issubdtype(frozen[n]._value.dtype, jnp.floating) else frozen[n]._value for n in bnames]
+
+def f(x):
+    out = functional_call(net, pnames, p, bnames, b, paddle.Tensor(x))
+    return out._value if hasattr(out, "_value") else out
+
+x = jnp.zeros((128, 224, 224, 3), dtype)
+lowered = jax.jit(f).lower(x)
+comp = lowered.compile()
+hlo = comp.as_text()
+open("/root/repo/_trace/opt.hlo", "w").write(hlo)
+import re
+# print the definition line of the hot fusions
+for name in ["fusion", "fusion.1 ", "fusion.2 ", "fusion.16", "fusion.14", "fusion.39", "fusion.6 ", "fusion.4 ", "fusion.3 ", "fusion.5 ", "copy-done", "copy.1"]:
+    for line in hlo.splitlines():
+        ls = line.strip()
+        if ls.startswith(f"%{name.strip()} =") or ls.startswith(f"{name.strip()} ="):
+            print(line.strip()[:240]); break
+ca = comp.cost_analysis()
+ca = ca[0] if isinstance(ca, list) else ca
+print("XLA flops per step:", ca.get("flops"), "-> per img:", ca.get("flops", 0) / 128 / 1e9, "GFLOP")
+print("bytes accessed:", ca.get("bytes accessed", 0) / 1e9, "GB")

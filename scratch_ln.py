@@ -1,0 +1,49 @@
+import time, statistics
+import numpy as np
+import jax, jax.numpy as jnp
+N = 200
+
+def run(f, args, n=N):
+    r = f(*args); jax.tree_util.tree_map(lambda a: None, r)
+    float(np.asarray(jax.tree_util.tree_leaves(r)[0].reshape(-1)[0]))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); float(np.asarray(jax.tree_util.tree_leaves(f(*args))[0].reshape(-1)[0])); ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.rand(32, 128, 768).astype(np.float32)).astype(jnp.bfloat16)
+g = jnp.asarray(rng.rand(768).astype(np.float32)).astype(jnp.bfloat16)
+b = jnp.asarray(rng.rand(768).astype(np.float32)).astype(jnp.bfloat16)
+
+def make(ln):
+    @jax.jit
+    def f(x, g, b):
+        def body(c, _):
+            def loss(x, g, b):
+                return jnp.sum(ln(x * (1 + c).astype(x.dtype), g, b).astype(jnp.float32) ** 2)
+            l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(x, g, b)
+            return l * 1e-20, None
+        return jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=N)[0]
+    return f
+
+def ln_bf16(x, g, b):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return ((x - m) * jax.lax.rsqrt(v + 1e-5)) * g + b
+
+def ln_f32(x, g, b):
+    x32 = x.astype(jnp.float32)
+    m = jnp.mean(x32, axis=-1, keepdims=True)
+    v = jnp.var(x32, axis=-1, keepdims=True)
+    return (((x32 - m) * jax.lax.rsqrt(v + 1e-5)) * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+# RTT baseline
+@jax.jit
+def empty(x, g, b):
+    return x[0, 0, 0]
+rtt = run(empty, (x, g, b), n=1)
+print(f"rtt {rtt*1e3:.1f}ms")
+for name, ln in (("bf16", ln_bf16), ("f32", ln_f32)):
+    dt = (run(make(ln), (x, g, b)) - rtt) / N
+    print(f"LN fwd+bwd {name}: {dt*1e6:.1f} us")

@@ -1,0 +1,63 @@
+import time, statistics
+import numpy as np
+import jax, jax.numpy as jnp
+PEAK = 1.97e14; B = 128; N = 2000
+RTT_EST = None
+
+def bench(make_body, n=N):
+    @jax.jit
+    def f(args):
+        def body(c, _):
+            o = make_body(args, c)
+            return jnp.sum(o).astype(jnp.float32) * 1e-20, None
+        return jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=n)[0]
+    return f
+
+def run(f, args, n=N):
+    r = f(args); float(np.asarray(r))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); float(np.asarray(f(args))); ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+rng = np.random.RandomState(0)
+
+# measure RTT: empty scan
+f0 = bench(lambda a, c: a * (1 + c).astype(a.dtype), n=1)
+rtt = run(f0, jnp.zeros((1,), jnp.bfloat16), n=1)
+print(f"RTT (empty dispatch+sync): {rtt*1e3:.1f} ms", flush=True)
+
+def conv_case(name, xshape, wshape, stride, pad, dn, flops):
+    x = jnp.asarray(rng.rand(*xshape).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.rand(*wshape).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+    f = bench(lambda a, c: jax.lax.conv_general_dilated(
+        a[0], a[1] * (1 + c).astype(a[1].dtype), stride, pad, dimension_numbers=dn))
+    tot = run(f, (x, w))
+    dt = (tot - rtt) / N
+    print(f"{name}: {dt*1e3:.4f} ms  mfu={flops/dt/PEAK:.3f}", flush=True)
+
+conv_case("stem 7x7s2", (B,3,224,224), (64,3,7,7), (2,2), [(3,3),(3,3)], ("NCHW","OIHW","NCHW"),
+          2*B*112*112*64*3*49)
+conv_case("s2d 4x4s1 ", (B,12,112,112), (64,12,4,4), (1,1), [(2,1),(2,1)], ("NCHW","OIHW","NCHW"),
+          2*B*112*112*64*12*16)
+conv_case("3x3 c64 hw56 ", (B,64,56,56), (64,64,3,3), (1,1), [(1,1),(1,1)], ("NCHW","OIHW","NCHW"),
+          2*B*56*56*64*64*9)
+conv_case("3x3 c256 hw14", (B,256,14,14), (256,256,3,3), (1,1), [(1,1),(1,1)], ("NCHW","OIHW","NCHW"),
+          2*B*14*14*256*256*9)
+conv_case("1x1 c64->64 hw56  ", (B,64,56,56), (64,64,1,1), (1,1), [(0,0),(0,0)], ("NCHW","OIHW","NCHW"),
+          2*B*56*56*64*64)
+conv_case("1x1 c256->64 hw56 ", (B,256,56,56), (64,256,1,1), (1,1), [(0,0),(0,0)], ("NCHW","OIHW","NCHW"),
+          2*B*56*56*256*64)
+conv_case("1x1 c1024->256 h14", (B,1024,14,14), (256,1024,1,1), (1,1), [(0,0),(0,0)], ("NCHW","OIHW","NCHW"),
+          2*B*14*14*1024*256)
+
+# maxpool NCHW vs NHWC (input-add carry; subtract BW cost mentally)
+for name, shape, wdims, sdims, pdims in (
+        ("maxpool NCHW", (B,64,112,112), (1,1,3,3), (1,1,2,2), [(0,0),(0,0),(1,1),(1,1)]),
+        ("maxpool NHWC", (B,112,112,64), (1,3,3,1), (1,2,2,1), [(0,0),(1,1),(1,1),(0,0)])):
+    x = jnp.asarray(rng.rand(*shape).astype(np.float32)).astype(jnp.bfloat16)
+    f = bench(lambda a, c, wd=wdims, sd=sdims, pd=pdims: jax.lax.reduce_window(
+        a * (1 + c).astype(a.dtype), jnp.bfloat16(-1e30), jax.lax.max, wd, sd, pd), n=500)
+    tot = run(f, x, n=500)
+    dt = (tot - rtt) / 500
+    print(f"{name}: {dt*1e3:.4f} ms", flush=True)

@@ -1,0 +1,21 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np, sys
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu import models
+
+paddle.seed(0)
+a = models.resnet18(num_classes=8)
+paddle.seed(0)
+b = models.resnet18(num_classes=8, data_format="NHWC")
+b.set_state_dict(a.state_dict())
+a.eval(); b.eval()
+x = np.random.rand(2, 3, 64, 64).astype("float32")
+ya = a(paddle.to_tensor(x)).numpy()
+yb = b(paddle.to_tensor(x.transpose(0, 2, 3, 1))).numpy()
+print("max diff:", np.abs(ya - yb).max())
+assert np.abs(ya - yb).max() < 2e-4, "NHWC mismatch"
+print("NHWC OK")

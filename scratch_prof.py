@@ -1,0 +1,85 @@
+"""Scratch: dissect ResNet-50 inference perf on the real chip."""
+import time, statistics, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+sys.path.insert(0, ".")
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.jit.functional import functional_call, split_state
+
+PEAK = 1.97e14
+FLOPS_IMG = 4.1e9
+
+paddle.seed(0)
+net = models.resnet50()
+net.eval()
+trainable, frozen = split_state(net)
+pnames, bnames = list(trainable), list(frozen)
+params = [trainable[n]._value for n in pnames]
+buffers = [frozen[n]._value for n in bnames]
+print(f"n params={len(params)} n buffers={len(buffers)}")
+
+def make_fn(dtype):
+    p = [a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a for a in params]
+    b = [a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a for a in buffers]
+    @jax.jit
+    def f(x):
+        out = functional_call(net, pnames, p, bnames, b, paddle.Tensor(x))
+        return out._value if hasattr(out, "_value") else out
+    return f
+
+def timeit(f, x, n=30, reps=3):
+    r = f(x); r.block_until_ready(); float(np.asarray(r.reshape(-1)[0]))
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = f(x)
+        float(np.asarray(r.reshape(-1)[0]))
+        dt = time.perf_counter() - t0
+        rates.append(x.shape[0] * n / dt)
+    med = statistics.median(rates)
+    return med, (max(rates) - min(rates)) / med
+
+import argparse
+ap = argparse.ArgumentParser()
+ap.add_argument("--dtype", default="bfloat16")
+ap.add_argument("--batch", type=int, nargs="+", default=[32])
+ap.add_argument("--scan", action="store_true")
+args = ap.parse_args()
+dtype = getattr(jnp, args.dtype)
+f = make_fn(dtype)
+for bs in args.batch:
+    x = jnp.asarray(np.random.rand(bs, 3, 224, 224).astype(np.float32)).astype(dtype)
+    med, spread = timeit(f, x)
+    print(f"dtype={dtype.__name__} batch={bs}: {med:.0f} img/s  mfu={med*FLOPS_IMG/PEAK:.3f} spread={spread:.3f}", flush=True)
+
+# scan-based: one dispatch per span -> pure device throughput
+def make_scan_fn(dtype, n_inner=30):
+    p = [a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a for a in params]
+    b = [a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a for a in buffers]
+    @jax.jit
+    def f(x):
+        def body(carry, _):
+            out = functional_call(net, pnames, p, bnames, b, paddle.Tensor(x + carry))
+            o = out._value if hasattr(out, "_value") else out
+            return o.reshape(-1)[0].astype(x.dtype) * 0, None
+        c, _ = jax.lax.scan(body, jnp.zeros((), x.dtype), None, length=n_inner)
+        return c
+    return f
+
+if getattr(args, "scan", None):
+    n_inner = 30
+    f = make_scan_fn(dtype, n_inner)
+    for bs in args.batch:
+        x = jnp.asarray(np.random.rand(bs, 3, 224, 224).astype(np.float32)).astype(dtype)
+        r = f(x); r.block_until_ready()
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = f(x); float(np.asarray(r))
+            rates.append(bs * n_inner / (time.perf_counter() - t0))
+        med = statistics.median(rates)
+        spr = (max(rates) - min(rates)) / med
+        print(f"SCAN dtype={dtype.__name__} batch={bs}: {med:.0f} img/s  mfu={med*FLOPS_IMG/PEAK:.3f} spread={spr:.3f}", flush=True)

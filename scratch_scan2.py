@@ -1,0 +1,38 @@
+import time, statistics, sys
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, ".")
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.jit.functional import functional_call, split_state
+
+PEAK = 1.97e14; FLOPS_IMG = 4.1e9
+paddle.seed(0)
+net = models.resnet50(); net.eval()
+trainable, frozen = split_state(net)
+pnames, bnames = list(trainable), list(frozen)
+params = [trainable[n]._value for n in pnames]
+buffers = [frozen[n]._value for n in bnames]
+dtype = jnp.bfloat16
+p = [a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a for a in params]
+b = [a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a for a in buffers]
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+BS = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+
+@jax.jit
+def f(x):
+    def body(c, _):
+        out = functional_call(net, pnames, p, bnames, b, paddle.Tensor(x + c))
+        o = out._value if hasattr(out, "_value") else out
+        return o.reshape(-1)[0].astype(x.dtype) * 0, None
+    c, _ = jax.lax.scan(body, jnp.zeros((), dtype), None, length=N)
+    return c
+
+x = jnp.zeros((BS, 3, 224, 224), dtype)
+r = f(x); r.block_until_ready()
+rates = []
+for _ in range(3):
+    t0 = time.perf_counter(); float(np.asarray(f(x))); dt = time.perf_counter() - t0
+    rates.append(BS * N / dt)
+med = statistics.median(rates)
+print(f"scan N={N} BS={BS}: {med:.0f} img/s mfu={med*FLOPS_IMG/PEAK:.3f} spread={(max(rates)-min(rates))/med:.3f}")

@@ -1,0 +1,62 @@
+import time, statistics, sys
+import numpy as np
+import jax, jax.numpy as jnp
+PEAK = 1.97e14; B = 128; N = 300
+
+def scan_bench(make_op, x, n=N):
+    # carry scalar feeds input multiplicatively -> not constant-foldable
+    @jax.jit
+    def f(x):
+        def body(c, _):
+            o = make_op(x * (1.0 + c).astype(x.dtype))
+            return o.reshape(-1)[0].astype(jnp.float32) * 1e-20, None
+        c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=n)
+        return c
+    r = f(x); r.block_until_ready()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); float(np.asarray(f(x))); ts.append(time.perf_counter() - t0)
+    return statistics.median(ts) / n
+
+w = jnp.zeros((64, 3, 7, 7), jnp.bfloat16)
+x = jnp.zeros((B, 3, 224, 224), jnp.bfloat16)
+dt = scan_bench(lambda a: jax.lax.conv_general_dilated(
+    a, w, (2, 2), [(3, 3), (3, 3)], dimension_numbers=("NCHW", "OIHW", "NCHW")), x)
+flops = 2 * B * 112 * 112 * 64 * 3 * 49
+print(f"stem 7x7s2 NCHW: {dt*1e3:.3f} ms  mfu={flops/dt/PEAK:.3f}")
+
+ws = jnp.zeros((64, 12, 4, 4), jnp.bfloat16)
+xs = jnp.zeros((B, 12, 112, 112), jnp.bfloat16)
+dt = scan_bench(lambda a: jax.lax.conv_general_dilated(
+    a, ws, (1, 1), [(2, 1), (2, 1)], dimension_numbers=("NCHW", "OIHW", "NCHW")), xs)
+flops2 = 2 * B * 112 * 112 * 64 * 12 * 16
+print(f"stem s2d 4x4s1:  {dt*1e3:.3f} ms  mfu={flops2/dt/PEAK:.3f}")
+
+xp = jnp.zeros((B, 64, 112, 112), jnp.bfloat16)
+dt = scan_bench(lambda a: jax.lax.reduce_window(
+    a, jnp.float32(-1e30).astype(jnp.bfloat16), jax.lax.max, (1, 1, 3, 3),
+    (1, 1, 2, 2), [(0, 0), (0, 0), (1, 1), (1, 1)]), xp)
+print(f"maxpool NCHW:    {dt*1e3:.3f} ms")
+
+xpl = jnp.zeros((B, 112, 112, 64), jnp.bfloat16)
+dt = scan_bench(lambda a: jax.lax.reduce_window(
+    a, jnp.float32(-1e30).astype(jnp.bfloat16), jax.lax.max, (1, 3, 3, 1),
+    (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1), (0, 0)]), xpl)
+print(f"maxpool NHWC:    {dt*1e3:.3f} ms")
+
+# representative bottleneck 3x3 at each stage
+for cin, hw in ((64, 56), (128, 28), (256, 14), (512, 7)):
+    wk = jnp.zeros((cin, cin, 3, 3), jnp.bfloat16)
+    xk = jnp.zeros((B, cin, hw, hw), jnp.bfloat16)
+    dt = scan_bench(lambda a, wk=wk: jax.lax.conv_general_dilated(
+        a, wk, (1, 1), [(1, 1), (1, 1)], dimension_numbers=("NCHW", "OIHW", "NCHW")), xk)
+    fl = 2 * B * hw * hw * cin * cin * 9
+    print(f"3x3 c{cin:3d} hw{hw:3d}: {dt*1e3:.3f} ms  mfu={fl/dt/PEAK:.3f}")
+# 1x1 convs
+for cin, cout, hw in ((64, 64, 56), (256, 64, 56), (1024, 256, 14), (512, 2048, 7)):
+    wk = jnp.zeros((cout, cin, 1, 1), jnp.bfloat16)
+    xk = jnp.zeros((B, cin, hw, hw), jnp.bfloat16)
+    dt = scan_bench(lambda a, wk=wk: jax.lax.conv_general_dilated(
+        a, wk, (1, 1), [(0, 0), (0, 0)], dimension_numbers=("NCHW", "OIHW", "NCHW")), xk)
+    fl = 2 * B * hw * hw * cin * cout
+    print(f"1x1 c{cin:4d}->{cout:4d} hw{hw:3d}: {dt*1e3:.3f} ms  mfu={fl/dt/PEAK:.3f}")

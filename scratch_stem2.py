@@ -1,0 +1,49 @@
+import time, statistics
+import numpy as np
+import jax, jax.numpy as jnp
+PEAK = 1.97e14; B = 128; N = 300
+
+def scan_bench_w(op_with_w, x, w, n=N):
+    @jax.jit
+    def f(x, w):
+        def body(c, _):
+            o = op_with_w(x, w * (1.0 + c).astype(w.dtype))
+            return o.reshape(-1)[0].astype(jnp.float32) * 1e-20, None
+        c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=n)
+        return c
+    r = f(x, w); r.block_until_ready()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); float(np.asarray(f(x, w))); ts.append(time.perf_counter() - t0)
+    return statistics.median(ts) / n
+
+def conv(dn_in, dn_w, dn_out, stride=(1,1), pad=[(0,0),(0,0)]):
+    return lambda a, w: jax.lax.conv_general_dilated(
+        a, w, stride, pad, dimension_numbers=(dn_in, dn_w, dn_out))
+
+# 1x1 convs, NCHW vs NHWC
+for cin, cout, hw in ((64, 64, 56), (256, 64, 56), (64, 256, 56), (1024, 256, 14), (512, 2048, 7)):
+    xk = jnp.zeros((B, cin, hw, hw), jnp.bfloat16)
+    wk = jnp.zeros((cout, cin, 1, 1), jnp.bfloat16)
+    dt = scan_bench_w(conv("NCHW", "OIHW", "NCHW"), xk, wk)
+    fl = 2 * B * hw * hw * cin * cout
+    xk2 = jnp.zeros((B, hw, hw, cin), jnp.bfloat16)
+    wk2 = jnp.zeros((1, 1, cin, cout), jnp.bfloat16)
+    dt2 = scan_bench_w(conv("NHWC", "HWIO", "NHWC"), xk2, wk2)
+    # matmul formulation
+    xm = jnp.zeros((B * hw * hw, cin), jnp.bfloat16)
+    wm = jnp.zeros((cin, cout), jnp.bfloat16)
+    dt3 = scan_bench_w(lambda a, w: jnp.matmul(a, w), xm, wm)
+    print(f"1x1 c{cin:4d}->{cout:4d} hw{hw:3d}: NCHW {dt*1e3:.3f}ms mfu={fl/dt/PEAK:.3f} | "
+          f"NHWC {dt2*1e3:.3f}ms mfu={fl/dt2/PEAK:.3f} | mm {dt3*1e3:.3f}ms mfu={fl/dt3/PEAK:.3f}", flush=True)
+
+# 3x3 NCHW vs NHWC at stage2
+for cin, hw in ((64, 56), (256, 14)):
+    xk = jnp.zeros((B, cin, hw, hw), jnp.bfloat16)
+    wk = jnp.zeros((cin, cin, 3, 3), jnp.bfloat16)
+    dt = scan_bench_w(conv("NCHW", "OIHW", "NCHW", (1,1), [(1,1),(1,1)]), xk, wk)
+    xk2 = jnp.zeros((B, hw, hw, cin), jnp.bfloat16)
+    wk2 = jnp.zeros((3, 3, cin, cin), jnp.bfloat16)
+    dt2 = scan_bench_w(conv("NHWC", "HWIO", "NHWC", (1,1), [(1,1),(1,1)]), xk2, wk2)
+    fl = 2 * B * hw * hw * cin * cin * 9
+    print(f"3x3 c{cin:3d} hw{hw:3d}: NCHW {dt*1e3:.3f}ms mfu={fl/dt/PEAK:.3f} | NHWC {dt2*1e3:.3f}ms mfu={fl/dt2/PEAK:.3f}", flush=True)

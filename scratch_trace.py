@@ -1,0 +1,29 @@
+import sys, time, glob, os
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, ".")
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.jit.functional import functional_call, split_state
+
+paddle.seed(0)
+net = models.resnet50(data_format="NHWC"); net.eval()
+trainable, frozen = split_state(net)
+pnames, bnames = list(trainable), list(frozen)
+dtype = jnp.bfloat16
+p = [trainable[n]._value.astype(dtype) if jnp.issubdtype(trainable[n]._value.dtype, jnp.floating) else trainable[n]._value for n in pnames]
+b = [frozen[n]._value.astype(dtype) if jnp.issubdtype(frozen[n]._value.dtype, jnp.floating) else frozen[n]._value for n in bnames]
+
+@jax.jit
+def f(x):
+    out = functional_call(net, pnames, p, bnames, b, paddle.Tensor(x))
+    return out._value if hasattr(out, "_value") else out
+
+x = jnp.asarray(np.random.rand(128, 224, 224, 3).astype(np.float32)).astype(dtype)
+r = f(x); float(np.asarray(r.reshape(-1)[0]))
+os.makedirs("/root/repo/_trace", exist_ok=True)
+with jax.profiler.trace("/root/repo/_trace"):
+    for _ in range(20):
+        r = f(x)
+    float(np.asarray(r.reshape(-1)[0]))
+print("trace files:", glob.glob("/root/repo/_trace/**/*", recursive=True)[:10])

@@ -107,3 +107,89 @@ class TestElastic:
                              timeout=60)
         assert res.success
         assert res.restarts == 1
+
+
+class TestElasticScaleOut:
+    """World-size-change events (reference fleet/elastic/manager.py:215-266):
+    a NEW node joining triggers re-rendezvous with a larger gang, and
+    AutoCheckpoint-driven training resumes instead of restarting."""
+
+    def _script(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent(f"""
+            import json, os, sys, time
+            sys.path.insert(0, {repr(str(__import__('pathlib').Path(__file__).resolve().parents[1]))})
+            from paddle_tpu.framework.sharded_io import AutoCheckpoint
+
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            ws = int(os.environ["PADDLE_TRAINERS_NUM"])
+            launch = int(os.environ["PADDLE_ELASTIC_RESTART_COUNT"])
+            log = open({repr(str(tmp_path))} + f"/log_{{rank}}.txt", "a")
+            print(f"START ws{{ws}} launch{{launch}}", file=log, flush=True)
+
+            if rank == 1 and launch == 0:
+                time.sleep(0.4)
+                sys.exit(9)    # die on the FIRST launch -> gang relaunch
+
+            if rank == 0:
+                state = {{}}
+                acp = AutoCheckpoint(
+                    {repr(str(tmp_path))} + "/ckpt",
+                    save_fn=lambda p: open(p, "w").write(json.dumps(state)),
+                    load_fn=lambda p: state.update(json.loads(open(p).read())))
+                for epoch in acp.train_epoch_range(8):
+                    state["epoch"] = epoch
+                    print(f"ws{{ws}} epoch{{epoch}}", file=log, flush=True)
+                    time.sleep(0.35)
+            else:
+                time.sleep(0.35 * 8)
+            sys.exit(0)
+        """))
+        return script
+
+    def test_kill_and_join_resumes_at_new_world_size(self, tmp_path):
+        import threading
+        from paddle_tpu._native import TCPStore
+        from paddle_tpu.parallel.elastic import ElasticManager, launch_elastic
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        script = self._script(tmp_path)
+
+        def join_later():
+            # a brand-new node announces itself only once the
+            # crash-triggered relaunch is observably underway (child
+            # startup is slow in this image: sitecustomize pre-imports
+            # jax, so wall-clock sleeps race the gang)
+            log0 = tmp_path / "log_0.txt"
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                if log0.exists() and "launch1" in log0.read_text():
+                    break
+                time.sleep(0.2)
+            joiner = ElasticManager(store, rank=-1, world_size=0)
+            joiner.announce_join("new-node-A")
+
+        th = threading.Thread(target=join_later)
+        th.start()
+        res = launch_elastic(str(script), nprocs=2, max_restarts=2,
+                             timeout=120, store=store, max_np=3)
+        th.join()
+        assert res.success, (res.restarts, res.returncodes)
+        assert res.restarts >= 1          # the kill consumed failure budget
+        assert len(res.returncodes) == 3  # final gang ran at world size 3
+
+        log = [l for l in
+               (tmp_path / "log_0.txt").read_text().strip().splitlines()
+               if "epoch" in l]
+        ws3 = [l for l in log if l.startswith("ws3")]
+        assert ws3, f"no world-size-3 phase in log: {log}"
+        # AutoCheckpoint resume: the ws3 phase continues the epoch count,
+        # it does not restart from epoch0 (the interrupted epoch may
+        # replay once — crash-safe semantics)
+        first_ws3_epoch = int(ws3[0].split("epoch")[1])
+        pre = [int(l.split("epoch")[1]) for l in log if not l.startswith("ws3")]
+        assert pre, "no pre-scale phase logged"
+        assert first_ws3_epoch >= max(pre), (first_ws3_epoch, log)
+        # and the full 8 epochs completed exactly once past the resume point
+        all_epochs = [int(l.split("epoch")[1]) for l in log]
+        assert max(all_epochs) == 7
