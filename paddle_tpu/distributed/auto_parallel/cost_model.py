@@ -120,14 +120,18 @@ def train_step_cost(param_bytes, flops_per_step, act_bytes_per_layer,
     reach_dp = n
     comm = allreduce_time(shard_param, dp, cluster.axis_bandwidth(reach_dp)) \
         + (lat if dp > 1 else 0.0)
+    # act_bytes_per_layer is computed from the GLOBAL batch (planner.py);
+    # dp shards the batch, so every per-chip activation quantity — comm
+    # payloads below and the memory term at the end — divides by dp.
+    act_local = act_bytes_per_layer / max(dp, 1)
     if mp > 1:
         bw_mp = cluster.axis_bandwidth(reach_mp)
         comm += 4 * layers_per_stage * (
-            allreduce_time(act_bytes_per_layer / (mp * sp), mp, bw_mp) + lat)
+            allreduce_time(act_local / (mp * sp), mp, bw_mp) + lat)
     if sp > 1:
         # ring attention: each of sp-1 steps sends the local K and V block
         bw_sp = cluster.axis_bandwidth(reach_sp)
-        per_block = act_bytes_per_layer / sp
+        per_block = act_local / sp
         comm += 3 * layers_per_stage * (sp - 1) * (
             2 * p2p_time(per_block, bw_sp) + lat)  # fwd + ~2x bwd => 3x
     micro = micro_batches or max(2 * pp, 1)
@@ -136,7 +140,7 @@ def train_step_cost(param_bytes, flops_per_step, act_bytes_per_layer,
     if pp > 1:
         bw_pp = cluster.axis_bandwidth(reach_pp)
         comm += (pp - 1) * micro * (
-            p2p_time(act_bytes_per_layer / (mp * sp), bw_pp) + lat)
+            p2p_time(act_local / (mp * sp), bw_pp) + lat)
 
     states = 3.0  # grads + adam m/v, in param-bytes units
     if sharding_stage >= 1:
@@ -144,6 +148,6 @@ def train_step_cost(param_bytes, flops_per_step, act_bytes_per_layer,
     if sharding_stage >= 2:
         states = 1.0 / max(dp, 1) + 2.0 / max(dp, 1)
     mem = shard_param * (1.0 + states) \
-        + layers_per_stage * act_bytes_per_layer / (mp * sp)
+        + layers_per_stage * act_local / (mp * sp)
     return PlanCost(compute=comp, comm=comm, memory_per_chip=mem,
                     bubble=bubble)

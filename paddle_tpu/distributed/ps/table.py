@@ -353,11 +353,13 @@ class SSDSparseTable(SparseTable):
 
     def __len__(self):
         # resident + spilled
-        return len(self._rows) + len(self._db)
+        with self._lock:
+            return len(self._rows) + len(self._db)
 
     @property
     def resident_rows(self):
-        return len(self._rows)
+        with self._lock:
+            return len(self._rows)
 
     # ---- hooks keeping the LRU/disk tiers consistent with the base ----
     def _on_evict(self, key):
@@ -367,6 +369,10 @@ class SSDSparseTable(SparseTable):
             del self._db[k]
 
     def _on_load_row(self, key):
+        # A load() into a reused spill db must supersede any stale disk
+        # copy, or _iter_all_rows would yield the key twice and the stale
+        # row would win on the next load.
+        self._on_evict(key)
         self._touch(key)
         self._spill_if_needed()
 
@@ -374,6 +380,8 @@ class SSDSparseTable(SparseTable):
         import pickle
         yield from super()._iter_all_rows()
         for kb in self._db.keys():
+            if int(kb.decode()) in self._rows:
+                continue  # resident copy is authoritative
             row, slots, stat = pickle.loads(self._db[kb])
             yield int(kb.decode()), row, slots, stat
 

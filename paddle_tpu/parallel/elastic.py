@@ -197,7 +197,9 @@ def launch_elastic(training_script: str, script_args: Sequence[str] = (),
                 break
             if watcher is not None:
                 joins = watcher.pending_joins(absorbed)
-                if joins and (max_np is None or np_now + len(joins) <= max_np):
+                # Partial absorption: grow whenever there is headroom at
+                # all — the absorb slice below caps how many join.
+                if joins and (max_np is None or np_now < max_np):
                     outcome = "scale_out"
                     break
             if time.time() > deadline:

@@ -417,6 +417,24 @@ class TestSSDCtrInterplay:
         assert len(t) == 0
         t.close()
 
+    def test_load_into_reused_db_supersedes_stale_disk_rows(self, tmp_path):
+        # Restart-recovery flow: save, keep using the SAME spill db, then
+        # load() the checkpoint again. Stale disk copies must not shadow
+        # the loaded (and subsequently trained) rows.
+        from paddle_tpu.distributed.ps.table import SSDSparseTable
+        t = SSDSparseTable(dim=2, path=str(tmp_path / "g"), cache_rows=2,
+                           optimizer="sgd", seed=3)
+        t.pull([1, 2, 3, 4])            # rows 1..4; two spill to disk
+        t.save(str(tmp_path / "ckpt"))
+        t.load(str(tmp_path / "ckpt"))  # same db reused — no duplicates
+        assert len(t) == 4
+        t.push([1], np.full((1, 2), 10.0, np.float32))   # train row 1
+        after = t.pull([1]).copy()
+        t.save(str(tmp_path / "ckpt2"))
+        t.load(str(tmp_path / "ckpt2"))
+        np.testing.assert_allclose(t.pull([1]), after)   # update survives
+        t.close()
+
     def test_unknown_kwarg_raises(self):
         from paddle_tpu.distributed.ps.table import SparseTable
         with pytest.raises(TypeError, match="accessor"):
