@@ -21,3 +21,4 @@ from .small_nets import (  # noqa: F401
     AlexNet, DenseNet, GoogLeNet, ShuffleNetV2, SqueezeNet, alexnet,
     densenet121, googlenet, shufflenet_v2_x1_0, squeezenet1_1,
 )
+from .pp_ocr import PPOCRRec, pp_ocrv3_rec  # noqa: F401

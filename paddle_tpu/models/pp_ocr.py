@@ -1,0 +1,124 @@
+"""PP-OCRv3-style text recognizer: conv backbone -> BiLSTM -> CTC head.
+
+Reference parity: the PP-OCRv3 recognition pipeline served through Paddle
+Inference in the reference ecosystem (MobileNet-style backbone + sequence
+encoder + CTC head — the SVTR/CRNN "rec" half of BASELINE config 4; the
+BiLSTM encoder is `paddle.nn.LSTM(direction='bidirect')`, rnn.py:1212).
+
+TPU-first notes: NHWC keeps channels on the lane dimension through the conv
+stack; the height axis is pooled away before the sequence stage so the
+BiLSTM sees one [B, W', C] sequence whose whole sweep compiles to a single
+pair of lax.scans (see nn/layer/rnn.py); the CTC loss is the scanned
+log-semiring DP in nn/functional/loss.py — no warpctc kernel.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, in_c, out_c, k=3, stride=1, groups=1,
+                 data_format="NHWC"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False, data_format=data_format)
+        self.bn = nn.BatchNorm2D(out_c, data_format=data_format)
+        self.act = nn.Hardswish()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c, stride, data_format="NHWC"):
+        super().__init__()
+        self.dw = _ConvBNAct(in_c, in_c, 3, stride=stride, groups=in_c,
+                             data_format=data_format)
+        self.pw = _ConvBNAct(in_c, out_c, 1, data_format=data_format)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class RecBackbone(nn.Layer):
+    """MobileNetV1-style rec backbone; strides shrink H aggressively and W
+    gently so the output keeps a long width axis for the sequence stage.
+    """
+
+    def __init__(self, in_channels=3, scale=0.5, data_format="NHWC"):
+        super().__init__()
+        c = lambda ch: max(8, int(ch * scale))
+        df = data_format
+        # (out_c, stride): stride (2,1) halves H only — keeps sequence length
+        cfg = [(64, (2, 1)), (128, (1, 1)), (128, (2, 1)), (256, (1, 1)),
+               (256, (2, 1)), (512, (1, 1))]
+        self.stem = _ConvBNAct(in_channels, c(32), 3, stride=2, data_format=df)
+        blocks = []
+        in_c = c(32)
+        for out_c, stride in cfg:
+            blocks.append(_DepthwiseSeparable(in_c, c(out_c), stride, df))
+            in_c = c(out_c)
+        self.blocks = nn.Sequential(*blocks)
+        self.out_channels = in_c
+        self.data_format = df
+
+    def forward(self, x):
+        return self.blocks(self.stem(x))
+
+
+class SequenceEncoder(nn.Layer):
+    """Pool H away, then a bidirectional LSTM over the width axis."""
+
+    def __init__(self, in_channels, hidden_size=48, num_layers=2):
+        super().__init__()
+        self.lstm = nn.LSTM(in_channels, hidden_size, num_layers=num_layers,
+                            direction="bidirect")
+        self.out_channels = hidden_size * 2
+
+    def forward(self, x):
+        # x: [B, H', W', C] (NHWC) -> [B, W', C]
+        x = x.mean(axis=1)
+        out, _ = self.lstm(x)
+        return out
+
+
+class CTCHead(nn.Layer):
+    def __init__(self, in_channels, n_classes):
+        super().__init__()
+        self.fc = nn.Linear(in_channels, n_classes)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class PPOCRRec(nn.Layer):
+    """End-to-end recognizer. Input [B, 32, W, 3] NHWC images; output
+    per-position logits [B, W/2, n_classes] (class 0 = CTC blank — only
+    the stem strides the width axis; the stage strides shrink H only)."""
+
+    def __init__(self, n_classes=6625, scale=0.5, hidden_size=48,
+                 data_format="NHWC"):
+        super().__init__()
+        self.backbone = RecBackbone(3, scale, data_format)
+        self.neck = SequenceEncoder(self.backbone.out_channels, hidden_size)
+        self.head = CTCHead(self.neck.out_channels, n_classes)
+        self.n_classes = n_classes
+
+    def forward(self, x):
+        return self.head(self.neck(self.backbone(x)))
+
+    def loss(self, logits, labels, label_lengths):
+        """CTC training loss; every input width position is a valid step."""
+        import numpy as np
+        T = logits.shape[1]
+        B = logits.shape[0]
+        logits_tm = logits.transpose([1, 0, 2])   # -> [T, B, C]
+        input_lengths = np.full((B,), T, "int64")
+        return F.ctc_loss(logits_tm, labels, input_lengths, label_lengths,
+                          blank=0, reduction="mean")
+
+
+def pp_ocrv3_rec(n_classes=6625, **kw):
+    return PPOCRRec(n_classes=n_classes, **kw)

@@ -248,3 +248,86 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     if normalizer is not None:
         ins.append(ensure_tensor(normalizer))
     return run_op(f, ins, "sigmoid_focal_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification loss.
+
+    Reference parity: `python/paddle/nn/functional/loss.py:1010` (warpctc
+    wrapper — softmax is applied internally, so `log_probs` is UNSCALED
+    logits shaped [max_logit_length, batch, num_classes+1]; `reduction`
+    'mean' divides each sample's loss by its label length first).
+
+    TPU-first design: instead of the warp-ctc CUDA kernel the forward is
+    the standard log-semiring alpha recursion vectorized over (batch,
+    extended-label) and scanned over time with `lax.scan`; the backward is
+    the scan's VJP, so no hand-written gradient kernel is needed.
+    """
+    if norm_by_times:
+        raise NotImplementedError(
+            "norm_by_times rescales gradients only (warpctc semantics); "
+            "use reduction='mean' on TPU instead")
+
+    def f(logits):
+        lab = labels_v
+        T, B, C = logits.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        in_len = jnp.asarray(input_lengths_v, jnp.int32)
+        lab_len = jnp.asarray(label_lengths_v, jnp.int32)
+        neg_inf = jnp.float32(-1e30)
+
+        # extended label row per sample: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        # skip transition allowed where ext[s] != blank and != ext[s-2]
+        ext_m2 = jnp.concatenate(
+            [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_m2)
+
+        emit = jnp.take_along_axis(          # [T, B, S] log p(ext[s] | t)
+            logp, jnp.broadcast_to(ext[None], (T, B, S)), axis=2)
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, emit[0, :, 1], neg_inf))
+
+        def step(alpha, inp):
+            em, t = inp
+            a_m1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_m2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_m2 = jnp.where(can_skip, a_m2, neg_inf)
+            stacked = jnp.stack([alpha, a_m1, a_m2], 0)
+            new = jax.scipy.special.logsumexp(stacked, axis=0) + em
+            # past this sample's input length the alphas freeze
+            live = (t < in_len)[:, None]
+            return jnp.where(live, new, alpha), None
+
+        ts = jnp.arange(1, T, dtype=jnp.int32)
+        alpha, _ = jax.lax.scan(step, alpha0, (emit[1:], ts))
+
+        end = 2 * lab_len            # blank after last label
+        a_last = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.maximum(end[:, None] - 1, 0), axis=1)[:, 0]
+        a_prev = jnp.where(lab_len > 0, a_prev, neg_inf)
+        ll = jnp.logaddexp(a_last, a_prev)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len, 1).astype(loss.dtype))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    log_probs = ensure_tensor(log_probs)
+    labels_v = ensure_tensor(labels)._value
+    input_lengths_v = input_lengths._value if isinstance(
+        input_lengths, Tensor) else jnp.asarray(input_lengths)
+    label_lengths_v = label_lengths._value if isinstance(
+        label_lengths, Tensor) else jnp.asarray(label_lengths)
+    return run_op(f, [log_probs], "ctc_loss")

@@ -1,0 +1,597 @@
+"""Recurrent layers: SimpleRNN/LSTM/GRU cells and multi-layer wrappers.
+
+Reference parity: `python/paddle/nn/layer/rnn.py` — RNNCellBase (:139),
+SimpleRNNCell (:263), LSTMCell (:399), GRUCell (:556), RNN (:707),
+BiRNN (:782), RNNBase (:861), SimpleRNN/LSTM/GRU (:1105/:1212/:1323);
+sequence-length masking semantics from `fluid/layers/rnn.py:517`
+(_maybe_copy: padded steps carry the previous state through).
+
+TPU-first design: where the reference dispatches one fused cudnn `rnn` op
+per forward (`_cudnn_impl`, rnn.py:1002) or falls back to a Python
+time-step loop, here the entire sequence sweep of a builtin cell is ONE
+`lax.scan` traced as a single autograd op — XLA unrolls nothing, the MXU
+sees one [B, I]x[I, G*H] matmul per step, and backward is the scan's VJP
+(a reverse scan), so eager mode records one tape node per layer-direction
+instead of O(T) nodes. Custom cells still get the step-loop fallback.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._dispatch import ensure_tensor, run_op
+from .. import functional as F
+from .. import initializer as I
+from .container import LayerList
+from .layers import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+def split_states(states, bidirectional=False, state_components=1):
+    """[L*D, B, H]-stacked states -> per-layer (per-direction) structures.
+
+    Reference parity: `nn/layer/rnn.py:44`.
+    """
+    from ...ops.manipulation import unstack
+    if state_components == 1:
+        states = unstack(states)
+    else:
+        comps = [unstack(c) for c in states]
+        states = [tuple(c[i] for c in comps) for i in range(len(comps[0]))]
+    if not bidirectional:
+        return states
+    return [(states[2 * i], states[2 * i + 1]) for i in range(len(states) // 2)]
+
+
+def concat_states(states, bidirectional=False, state_components=1):
+    """Inverse of split_states. Reference parity: `nn/layer/rnn.py:97`."""
+    if bidirectional:
+        flat = []
+        for pair in states:
+            flat.extend(pair)
+    else:
+        flat = list(states)
+    if state_components == 1:
+        return _stack(flat)
+    comps = []
+    for c in range(state_components):
+        comps.append(_stack([s[c] for s in flat]))
+    return tuple(comps)
+
+
+def _stack(tensors):
+    from ...ops.manipulation import stack
+    return stack(tensors, axis=0)
+
+
+class RNNCellBase(Layer):
+    """Base for cells: provides zero initial states from a batch reference.
+
+    Reference parity: `nn/layer/rnn.py:139`.
+    """
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        shape = shape if shape is not None else self.state_shape
+        ref = batch_ref
+        while isinstance(ref, (list, tuple)):
+            ref = ref[0]
+        batch = ref.shape[batch_dim_idx]
+        dtype = dtype or ref.dtype
+
+        def make(s):
+            return Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                   dtype=dtype))
+
+        if shape and isinstance(shape[0], (list, tuple)):
+            return tuple(make(s) for s in shape)
+        return make(shape)
+
+
+# ---- pure per-step transition functions (scanned AND single-stepped) ----
+
+def _simple_rnn_step(x, hs, w_ih, w_hh, b_ih, b_hh, activation):
+    h, = hs
+    g = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    h = jnp.tanh(g) if activation == "tanh" else jax.nn.relu(g)
+    return h, (h,)
+
+
+def _lstm_step(x, hs, w_ih, w_hh, b_ih, b_hh, _activation=None):
+    h, c = hs
+    g = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    i, f, cand, o = jnp.split(g, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(cand)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, (h, c)
+
+
+def _gru_step(x, hs, w_ih, w_hh, b_ih, b_hh, _activation=None):
+    h, = hs
+    xg = x @ w_ih.T
+    if b_ih is not None:
+        xg = xg + b_ih
+    hg = h @ w_hh.T
+    if b_hh is not None:
+        hg = hg + b_hh
+    x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(x_r + h_r)
+    z = jax.nn.sigmoid(x_z + h_z)
+    cand = jnp.tanh(x_c + r * h_c)  # reset gate applied after the matmul
+    h = z * h + (1.0 - z) * cand
+    return h, (h,)
+
+
+class _BuiltinCell(RNNCellBase):
+    """Shared weight plumbing for the three builtin cells."""
+
+    _gates = 1
+    _step = staticmethod(_simple_rnn_step)
+    _state_components = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError(
+                f"hidden_size of {type(self).__name__} must be greater than "
+                f"0, but now equals to {hidden_size}")
+        std = 1.0 / math.sqrt(hidden_size)
+        g = self._gates
+        self.weight_ih = self.create_parameter(
+            (g * hidden_size, input_size), weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (g * hidden_size, hidden_size), weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            (g * hidden_size,), bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            (g * hidden_size,), bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = "tanh"
+
+    def _weight_tensors(self):
+        ws = [self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            ws.append(self.bias_ih)
+        if self.bias_hh is not None:
+            ws.append(self.bias_hh)
+        return ws
+
+    def _unpack_weights(self, arrs):
+        """(w_ih, w_hh, b_ih|None, b_hh|None) from the flat array list."""
+        it = iter(arrs)
+        w_ih, w_hh = next(it), next(it)
+        b_ih = next(it) if self.bias_ih is not None else None
+        b_hh = next(it) if self.bias_hh is not None else None
+        return w_ih, w_hh, b_ih, b_hh
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        flat_states = list(states) if isinstance(states, (list, tuple)) \
+            else [states]
+        flat_states = [ensure_tensor(s) for s in flat_states]
+        step, act = self._step, self.activation
+        n_state = len(flat_states)
+
+        def fused(x, *rest):
+            hs = rest[:n_state]
+            w = self._unpack_weights(rest[n_state:])
+            _, new = step(x, hs, *w, act)
+            return tuple(new)
+
+        outs = run_op(fused, [inputs, *flat_states, *self._weight_tensors()],
+                      type(self).__name__)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        if self._state_components == 1:
+            return outs[0], outs[0]
+        return outs[0], tuple(outs)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class SimpleRNNCell(_BuiltinCell):
+    r"""Elman cell: h_t = act(W_ih x_t + b_ih + W_hh h_{t-1} + b_hh).
+
+    Reference parity: `nn/layer/rnn.py:263`.
+    """
+
+    _gates = 1
+    _step = staticmethod(_simple_rnn_step)
+    _state_components = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+        if activation not in ("tanh", "relu"):
+            raise ValueError(
+                "activation for SimpleRNNCell should be tanh or relu, "
+                f"but get {activation}")
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        s = f"{self.input_size}, {self.hidden_size}"
+        if self.activation != "tanh":
+            s += f", activation={self.activation}"
+        return s
+
+
+class LSTMCell(_BuiltinCell):
+    r"""LSTM cell; weights hold the i|f|g|o gate concatenation.
+
+    Reference parity: `nn/layer/rnn.py:399` (gate order at :536-539).
+    """
+
+    _gates = 4
+    _step = staticmethod(_lstm_step)
+    _state_components = 2
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(_BuiltinCell):
+    r"""GRU cell; weights hold the r|z|c gate concatenation.
+
+    Reference parity: `nn/layer/rnn.py:556` (reset-after-matmul at :681).
+    """
+
+    _gates = 3
+    _step = staticmethod(_gru_step)
+    _state_components = 1
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _scan_rnn(step, x, states, weights, *, activation, time_major,
+              is_reverse, seq_len):
+    """One whole-sequence sweep as a single lax.scan (pure arrays in/out)."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)          # -> [T, B, I]
+    T = x.shape[0]
+    mask = None
+    if seq_len is not None:
+        t_idx = jnp.arange(T, dtype=jnp.int32)[:, None]
+        mask = (t_idx < seq_len[None, :].astype(jnp.int32)).astype(x.dtype)
+    if is_reverse:
+        x = jnp.flip(x, axis=0)
+        mask = jnp.flip(mask, axis=0) if mask is not None else None
+
+    def body(carry, inp):
+        if mask is None:
+            xt = inp
+            out, new = step(xt, carry, *weights, activation)
+        else:
+            xt, m = inp
+            out, new = step(xt, carry, *weights, activation)
+            m = m[:, None]
+            new = tuple(m * n + (1.0 - m) * o for n, o in zip(new, carry))
+        return new, out
+
+    xs = x if mask is None else (x, mask)
+    final, outs = jax.lax.scan(body, tuple(states), xs)
+    if is_reverse:
+        outs = jnp.flip(outs, axis=0)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    return outs, final
+
+
+class RNN(Layer):
+    """Run a cell over a sequence.
+
+    Reference parity: `nn/layer/rnn.py:707` + `fluid/layers/rnn.py:437`
+    (padded steps pass the previous state through; outputs are the raw per-
+    step outputs). Builtin cells take the fused single-scan path; arbitrary
+    cells fall back to a per-step loop like `_rnn_dynamic_graph`
+    (`fluid/layers/rnn.py:529`).
+    """
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if isinstance(self.cell, _BuiltinCell) and not kwargs:
+            return self._scan_forward(inputs, initial_states, sequence_length)
+        return self._loop_forward(inputs, initial_states, sequence_length,
+                                  **kwargs)
+
+    def _scan_forward(self, inputs, initial_states, sequence_length):
+        cell = self.cell
+        inputs = ensure_tensor(inputs)
+        if initial_states is None:
+            initial_states = cell.get_initial_states(
+                inputs, cell.state_shape,
+                batch_dim_idx=1 if self.time_major else 0)
+        flat_states = list(initial_states) if isinstance(
+            initial_states, (list, tuple)) else [initial_states]
+        flat_states = [ensure_tensor(s) for s in flat_states]
+        n_state = len(flat_states)
+        seq = None
+        if sequence_length is not None:
+            seq = sequence_length._value if isinstance(
+                sequence_length, Tensor) else jnp.asarray(sequence_length)
+        step, act = cell._step, cell.activation
+        time_major, is_reverse = self.time_major, self.is_reverse
+
+        def sweep(x, *rest):
+            hs = rest[:n_state]
+            w = cell._unpack_weights(rest[n_state:])
+            outs, final = _scan_rnn(step, x, hs, w, activation=act,
+                                    time_major=time_major,
+                                    is_reverse=is_reverse, seq_len=seq)
+            return (outs,) + tuple(final)
+
+        res = run_op(sweep, [inputs, *flat_states, *cell._weight_tensors()],
+                     f"rnn_{type(cell).__name__}")
+        outputs = res[0]
+        finals = res[1:]
+        if cell._state_components == 1:
+            return outputs, finals[0]
+        return outputs, tuple(finals)
+
+    def _loop_forward(self, inputs, initial_states, sequence_length, **kwargs):
+        cell = self.cell
+        inputs = ensure_tensor(inputs)
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        if initial_states is None:
+            initial_states = cell.get_initial_states(
+                inputs, batch_dim_idx=1 if self.time_major else 0)
+        states = initial_states
+        mask_np = None
+        if sequence_length is not None:
+            seq = sequence_length._value if isinstance(
+                sequence_length, Tensor) else jnp.asarray(sequence_length)
+            mask_np = (jnp.arange(T)[:, None] < seq[None, :]).astype(
+                inputs.dtype)
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = []
+        for t in order:
+            xt = run_op(lambda a, t=t: jnp.take(a, t, axis=time_axis),
+                        [inputs], "slice")
+            out, new_states = cell(xt, states, **kwargs)
+            if mask_np is not None:
+                m = Tensor(mask_np[t][:, None])
+                flat_new = new_states if isinstance(new_states, tuple) \
+                    else (new_states,)
+                flat_old = states if isinstance(states, tuple) else (states,)
+                merged = tuple(n * m + o * (1.0 - m)
+                               for n, o in zip(flat_new, flat_old))
+                new_states = merged if isinstance(new_states, tuple) \
+                    else merged[0]
+            states = new_states
+            outs.append(out)
+        if self.is_reverse:
+            outs.reverse()
+        outputs = run_op(lambda *xs: jnp.stack(xs, axis=time_axis),
+                         outs, "stack")
+        return outputs, states
+
+
+class BiRNN(Layer):
+    """Forward + backward sweeps, outputs concatenated on the last axis.
+
+    Reference parity: `nn/layer/rnn.py:782`.
+    """
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, final_fw = self.rnn_fw(inputs, states_fw, sequence_length,
+                                       **kwargs)
+        out_bw, final_bw = self.rnn_bw(inputs, states_bw, sequence_length,
+                                       **kwargs)
+        outputs = run_op(lambda a, b: jnp.concatenate([a, b], axis=-1),
+                         [out_fw, out_bw], "concat")
+        return outputs, (final_fw, final_bw)
+
+
+class RNNBase(LayerList):
+    """Multi-layer, optionally bidirectional recurrent network.
+
+    Reference parity: `nn/layer/rnn.py:861`; `flatten_parameters`
+    (cudnn weight coalescing, :948) is a no-op here — XLA owns layout.
+    """
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, activation=None):
+        super().__init__()
+        bidirectional_list = ("bidirectional", "bidirect")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.dropout = dropout
+        self.num_directions = 2 if direction in bidirectional_list else 1
+        self.time_major = time_major
+        self.num_layers = num_layers
+        self.state_components = 2 if mode == "LSTM" else 1
+
+        kwargs = {
+            "weight_ih_attr": weight_ih_attr,
+            "weight_hh_attr": weight_hh_attr,
+            "bias_ih_attr": bias_ih_attr,
+            "bias_hh_attr": bias_hh_attr,
+        }
+        if mode == "LSTM":
+            rnn_cls = LSTMCell
+        elif mode == "GRU":
+            rnn_cls = GRUCell
+        else:
+            rnn_cls = SimpleRNNCell
+            kwargs["activation"] = activation or "tanh"
+
+        if direction == "forward":
+            cell = rnn_cls(input_size, hidden_size, **kwargs)
+            self.append(RNN(cell, False, time_major))
+            for _ in range(1, num_layers):
+                cell = rnn_cls(hidden_size, hidden_size, **kwargs)
+                self.append(RNN(cell, False, time_major))
+        elif direction in bidirectional_list:
+            cell_fw = rnn_cls(input_size, hidden_size, **kwargs)
+            cell_bw = rnn_cls(input_size, hidden_size, **kwargs)
+            self.append(BiRNN(cell_fw, cell_bw, time_major))
+            for _ in range(1, num_layers):
+                cell_fw = rnn_cls(2 * hidden_size, hidden_size, **kwargs)
+                cell_bw = rnn_cls(2 * hidden_size, hidden_size, **kwargs)
+                self.append(BiRNN(cell_fw, cell_bw, time_major))
+        else:
+            raise ValueError(
+                "direction should be forward or bidirect (or bidirectional), "
+                f"received direction = {direction}")
+
+        # Flat aliases (weight_ih_l0, bias_hh_l1_reverse, ...) matching the
+        # reference's exposed attribute names; stored via object.__setattr__
+        # so state_dict does not double-count the cells' parameters.
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                suffix = "_reverse" if d == 1 else ""
+                wrapper = self[layer]
+                cell = (wrapper.cell_fw if d == 0 else wrapper.cell_bw) \
+                    if self.num_directions == 2 else wrapper.cell
+                for wname in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                    p = getattr(cell, wname, None)
+                    if p is not None:
+                        object.__setattr__(
+                            self, f"{wname}_l{layer}{suffix}", p)
+
+    def flatten_parameters(self):
+        """cudnn weight-coalescing hook — nothing to do under XLA."""
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_index = 1 if self.time_major else 0
+        if initial_states is None:
+            L = self.num_layers * self.num_directions
+            batch = inputs.shape[batch_index]
+            z = Tensor(jnp.zeros((L, batch, self.hidden_size),
+                                 dtype=ensure_tensor(inputs).dtype))
+            initial_states = tuple(
+                Tensor(z._value) for _ in range(self.state_components))
+            initial_states = initial_states if self.state_components > 1 \
+                else initial_states[0]
+        if not isinstance(initial_states, (list, tuple)):
+            initial_states = (initial_states,)
+        elif self.state_components > 1:
+            initial_states = tuple(initial_states)
+
+        states = split_states(
+            tuple(ensure_tensor(s) for s in initial_states)
+            if self.state_components > 1 else ensure_tensor(initial_states[0]),
+            self.num_directions == 2, self.state_components)
+
+        final_states = []
+        outputs = inputs
+        for i, rnn_layer in enumerate(self):
+            if i > 0:
+                outputs = F.dropout(outputs, self.dropout,
+                                    training=self.training,
+                                    mode="upscale_in_train")
+            outputs, final = rnn_layer(outputs, states[i], sequence_length)
+            final_states.append(final)
+
+        final_states = concat_states(final_states, self.num_directions == 2,
+                                     self.state_components)
+        return outputs, final_states
+
+    def extra_repr(self):
+        s = f"{self.input_size}, {self.hidden_size}"
+        if self.num_layers != 1:
+            s += f", num_layers={self.num_layers}"
+        if self.time_major:
+            s += f", time_major={self.time_major}"
+        if self.dropout != 0:
+            s += f", dropout={self.dropout}"
+        return s
+
+
+class SimpleRNN(RNNBase):
+    """Multilayer Elman network. Reference parity: `nn/layer/rnn.py:1105`."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        if activation == "tanh":
+            mode = "RNN_TANH"
+        elif activation == "relu":
+            mode = "RNN_RELU"
+        else:
+            raise ValueError(f"Unknown activation '{activation}'")
+        self.activation = activation
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr, activation=activation)
+
+
+class LSTM(RNNBase):
+    """Multilayer LSTM. Reference parity: `nn/layer/rnn.py:1212`."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(RNNBase):
+    """Multilayer GRU. Reference parity: `nn/layer/rnn.py:1323`."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

@@ -1,0 +1,128 @@
+"""CTC loss (torch-oracle) + PP-OCRv3-style recognizer tests.
+
+Reference test model: `unittests/test_warpctc_op.py` (CTC forward/grad) and
+the rec-model configs of BASELINE config 4.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def torch_ctc(logits, labels, in_len, lab_len, blank=0, reduction="none"):
+    lp = torch.log_softmax(torch.tensor(logits), -1)
+    return torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels.astype("int64")), torch.tensor(in_len),
+        torch.tensor(lab_len), blank=blank, reduction=reduction).numpy()
+
+
+class TestCTCLoss:
+    def test_matches_torch_forward(self):
+        T, B, C, L = 12, 3, 7, 4
+        logits = np.random.randn(T, B, C).astype("float32")
+        labels = np.random.randint(1, C, (B, L)).astype("int32")
+        in_len = np.array([12, 9, 11], "int64")
+        lab_len = np.array([4, 2, 3], "int64")
+        got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         in_len, lab_len, reduction="none")
+        want = torch_ctc(logits, labels, in_len, lab_len)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+
+    def test_matches_torch_grad(self):
+        T, B, C, L = 9, 2, 5, 3
+        logits = np.random.randn(T, B, C).astype("float32")
+        labels = np.random.randint(1, C, (B, L)).astype("int32")
+        in_len = np.array([9, 7], "int64")
+        lab_len = np.array([3, 2], "int64")
+        x = paddle.to_tensor(logits, stop_gradient=False)
+        F.ctc_loss(x, paddle.to_tensor(labels), in_len, lab_len,
+                   reduction="mean").backward()
+        tx = torch.tensor(logits, requires_grad=True)
+        torch.nn.functional.ctc_loss(
+            torch.log_softmax(tx, -1), torch.tensor(labels.astype("int64")),
+            torch.tensor(in_len), torch.tensor(lab_len), blank=0,
+            reduction="mean").backward()
+        np.testing.assert_allclose(np.asarray(x.gradient()), tx.grad.numpy(),
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_repeated_labels(self):
+        # repeats force the blank-transition path in the DP
+        T, B, C = 10, 1, 4
+        logits = np.random.randn(T, B, C).astype("float32")
+        labels = np.array([[2, 2, 3, 3]], "int32")
+        in_len = np.array([10], "int64")
+        lab_len = np.array([4], "int64")
+        got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         in_len, lab_len, reduction="none")
+        want = torch_ctc(logits, labels, in_len, lab_len)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+
+    def test_nonzero_blank_and_reductions(self):
+        T, B, C, L = 8, 2, 6, 3
+        logits = np.random.randn(T, B, C).astype("float32")
+        labels = np.random.randint(0, C - 1, (B, L)).astype("int32")
+        blank = C - 1
+        in_len = np.array([8, 8], "int64")
+        lab_len = np.array([3, 1], "int64")
+        for red in ("none", "mean", "sum"):
+            got = F.ctc_loss(paddle.to_tensor(logits),
+                             paddle.to_tensor(labels), in_len, lab_len,
+                             blank=blank, reduction=red)
+            want = torch_ctc(logits, labels, in_len, lab_len, blank=blank,
+                             reduction=red)
+            np.testing.assert_allclose(np.atleast_1d(got.numpy()),
+                                       np.atleast_1d(want), rtol=1e-4)
+
+    def test_layer_wrapper(self):
+        loss_fn = nn.CTCLoss(blank=0, reduction="sum")
+        T, B, C = 6, 2, 5
+        logits = np.random.randn(T, B, C).astype("float32")
+        labels = np.array([[1, 2], [3, 0]], "int32")
+        got = loss_fn(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      np.array([6, 6], "int64"), np.array([2, 1], "int64"))
+        want = torch_ctc(logits, labels, np.array([6, 6], "int64"),
+                         np.array([2, 1], "int64"), reduction="sum")
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4)
+
+    def test_norm_by_times_guarded(self):
+        with pytest.raises(NotImplementedError):
+            F.ctc_loss(paddle.to_tensor(np.zeros((4, 1, 3), "float32")),
+                       paddle.to_tensor(np.zeros((1, 2), "int32")),
+                       np.array([4], "int64"), np.array([2], "int64"),
+                       norm_by_times=True)
+
+
+class TestPPOCRRec:
+    def test_shapes_and_param_geometry(self):
+        from paddle_tpu.models import pp_ocrv3_rec
+        net = pp_ocrv3_rec(n_classes=97, scale=0.35, hidden_size=32)
+        x = paddle.to_tensor(np.random.randn(2, 32, 64, 3).astype("float32"))
+        logits = net(x)
+        assert tuple(logits.shape) == (2, 32, 97)   # T = W/2 (stem only)
+        # BiLSTM encoder: 2 layers x 2 directions x 4 weights
+        lstm_params = [p for n, p in net.named_parameters() if "lstm" in n]
+        assert len(lstm_params) == 16
+
+    def test_trains(self):
+        from paddle_tpu.models import pp_ocrv3_rec
+        net = pp_ocrv3_rec(n_classes=20, scale=0.25, hidden_size=16)
+        x = paddle.to_tensor(
+            np.random.randn(4, 32, 48, 3).astype("float32"))
+        labels = paddle.to_tensor(
+            np.random.randint(1, 20, (4, 6)).astype("int32"))
+        lab_len = np.array([6, 4, 5, 6], "int64")
+        opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                    parameters=net.parameters())
+        first = last = None
+        for _ in range(12):
+            loss = net.loss(net(x), labels, lab_len)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = v if first is None else first
+            last = v
+        assert last < first, (first, last)

@@ -1,0 +1,416 @@
+"""RNN/LSTM/GRU family: numpy-oracle forward checks, grad checks, masking,
+bidirection, multi-layer, save/load, and to_static tracing.
+
+Mirrors the reference's test strategy for `nn/layer/rnn.py`
+(`unittests/rnn/test_rnn_nets.py`: compare against a numpy rnn_numpy.py
+oracle across direction/time_major/sequence_length configs).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# ---- numpy oracles ----
+
+def np_simple_rnn_step(x, h, w_ih, w_hh, b_ih, b_hh, act="tanh"):
+    g = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    return np.tanh(g) if act == "tanh" else np.maximum(g, 0.0)
+
+
+def np_lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    g = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, cand, o = np.split(g, 4, axis=-1)
+    c = sigmoid(f) * c + sigmoid(i) * np.tanh(cand)
+    h = sigmoid(o) * np.tanh(c)
+    return h, c
+
+
+def np_gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xg = x @ w_ih.T + b_ih
+    hg = h @ w_hh.T + b_hh
+    x_r, x_z, x_c = np.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = np.split(hg, 3, axis=-1)
+    r = sigmoid(x_r + h_r)
+    z = sigmoid(x_z + h_z)
+    cand = np.tanh(x_c + r * h_c)
+    return z * h + (1.0 - z) * cand
+
+
+def np_sweep(stepper, x, states, seq_len=None, is_reverse=False):
+    """x: [B, T, I]; states tuple of [B, H]. Returns outs [B,T,H], states."""
+    B, T, _ = x.shape
+    order = range(T - 1, -1, -1) if is_reverse else range(T)
+    outs = np.zeros((B, T, states[0].shape[-1]), x.dtype)
+    states = tuple(s.copy() for s in states)
+    for t in order:
+        new = stepper(x[:, t], *states)
+        new = new if isinstance(new, tuple) else (new,)
+        outs[:, t] = new[0]
+        if seq_len is not None:
+            m = (t < seq_len).astype(x.dtype)[:, None]
+            states = tuple(m * n + (1 - m) * s for n, s in zip(new, states))
+        else:
+            states = new
+    return outs, states
+
+
+def get_w(cell):
+    return (np.asarray(cell.weight_ih.numpy()),
+            np.asarray(cell.weight_hh.numpy()),
+            np.asarray(cell.bias_ih.numpy()),
+            np.asarray(cell.bias_hh.numpy()))
+
+
+class TestCells:
+    def test_simple_rnn_cell_matches_numpy(self):
+        cell = nn.SimpleRNNCell(16, 32)
+        x = np.random.randn(4, 16).astype("float32")
+        h = np.random.randn(4, 32).astype("float32")
+        y, h_new = cell(paddle.to_tensor(x), paddle.to_tensor(h))
+        want = np_simple_rnn_step(x, h, *get_w(cell))
+        np.testing.assert_allclose(y.numpy(), want, rtol=1e-5, atol=1e-5)
+        assert tuple(y.shape) == (4, 32)
+
+    def test_simple_rnn_cell_relu(self):
+        cell = nn.SimpleRNNCell(8, 8, activation="relu")
+        x = np.random.randn(2, 8).astype("float32")
+        h = np.random.randn(2, 8).astype("float32")
+        y, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h))
+        want = np_simple_rnn_step(x, h, *get_w(cell), act="relu")
+        np.testing.assert_allclose(y.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_lstm_cell_matches_numpy(self):
+        cell = nn.LSTMCell(16, 32)
+        x = np.random.randn(4, 16).astype("float32")
+        h = np.random.randn(4, 32).astype("float32")
+        c = np.random.randn(4, 32).astype("float32")
+        y, (h2, c2) = cell(paddle.to_tensor(x),
+                           (paddle.to_tensor(h), paddle.to_tensor(c)))
+        want_h, want_c = np_lstm_step(x, h, c, *get_w(cell))
+        np.testing.assert_allclose(y.numpy(), want_h, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c2.numpy(), want_c, rtol=1e-5, atol=1e-5)
+
+    def test_gru_cell_matches_numpy(self):
+        cell = nn.GRUCell(16, 32)
+        x = np.random.randn(4, 16).astype("float32")
+        h = np.random.randn(4, 32).astype("float32")
+        y, h2 = cell(paddle.to_tensor(x), paddle.to_tensor(h))
+        want = np_gru_step(x, h, *get_w(cell))
+        np.testing.assert_allclose(y.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_cell_default_zero_state(self):
+        cell = nn.GRUCell(6, 10)
+        x = np.random.randn(3, 6).astype("float32")
+        y, _ = cell(paddle.to_tensor(x))
+        want = np_gru_step(x, np.zeros((3, 10), "float32"), *get_w(cell))
+        np.testing.assert_allclose(y.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_bad_hidden_size_raises(self):
+        with pytest.raises(ValueError):
+            nn.LSTMCell(4, 0)
+        with pytest.raises(ValueError):
+            nn.SimpleRNNCell(4, 8, activation="gelu")
+
+    def test_weight_shapes(self):
+        lstm = nn.LSTMCell(16, 32)
+        assert tuple(lstm.weight_ih.shape) == (128, 16)
+        assert tuple(lstm.weight_hh.shape) == (128, 32)
+        gru = nn.GRUCell(16, 32)
+        assert tuple(gru.weight_ih.shape) == (96, 16)
+        assert tuple(gru.bias_hh.shape) == (96,)
+
+
+class TestRNNWrapper:
+    def test_rnn_scan_matches_numpy(self):
+        cell = nn.SimpleRNNCell(8, 16)
+        rnn = nn.RNN(cell)
+        x = np.random.randn(4, 12, 8).astype("float32")
+        h0 = np.random.randn(4, 16).astype("float32")
+        outs, hT = rnn(paddle.to_tensor(x), paddle.to_tensor(h0))
+        w = get_w(cell)
+        want, (want_h,) = np_sweep(
+            lambda xt, h: np_simple_rnn_step(xt, h, *w), x, (h0,))
+        np.testing.assert_allclose(outs.numpy(), want, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(hT.numpy(), want_h, rtol=1e-5, atol=1e-5)
+
+    def test_rnn_lstm_reverse(self):
+        cell = nn.LSTMCell(8, 16)
+        rnn = nn.RNN(cell, is_reverse=True)
+        x = np.random.randn(2, 7, 8).astype("float32")
+        outs, (hT, cT) = rnn(paddle.to_tensor(x))
+        w = get_w(cell)
+        want, (want_h, want_c) = np_sweep(
+            lambda xt, h, c: np_lstm_step(xt, h, c, *w), x,
+            (np.zeros((2, 16), "float32"), np.zeros((2, 16), "float32")),
+            is_reverse=True)
+        np.testing.assert_allclose(outs.numpy(), want, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(hT.numpy(), want_h, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(cT.numpy(), want_c, rtol=1e-5, atol=1e-5)
+
+    def test_rnn_time_major(self):
+        cell = nn.GRUCell(5, 9)
+        rnn = nn.RNN(cell, time_major=True)
+        x = np.random.randn(11, 3, 5).astype("float32")   # [T, B, I]
+        outs, hT = rnn(paddle.to_tensor(x))
+        w = get_w(cell)
+        want, (want_h,) = np_sweep(
+            lambda xt, h: np_gru_step(xt, h, *w),
+            x.transpose(1, 0, 2), (np.zeros((3, 9), "float32"),))
+        np.testing.assert_allclose(outs.numpy(), want.transpose(1, 0, 2),
+                                   rtol=1e-5, atol=1e-5)
+        assert tuple(outs.shape) == (11, 3, 9)
+
+    def test_sequence_length_masks_states(self):
+        cell = nn.GRUCell(4, 8)
+        rnn = nn.RNN(cell)
+        x = np.random.randn(3, 10, 4).astype("float32")
+        seq = np.array([10, 4, 7], "int64")
+        outs, hT = rnn(paddle.to_tensor(x), sequence_length=paddle.to_tensor(seq))
+        w = get_w(cell)
+        want, (want_h,) = np_sweep(
+            lambda xt, h: np_gru_step(xt, h, *w), x,
+            (np.zeros((3, 8), "float32"),), seq_len=seq)
+        np.testing.assert_allclose(hT.numpy(), want_h, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(outs.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_custom_cell_loop_fallback(self):
+        class MyCell(nn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            @property
+            def state_shape(self):
+                return (4,)
+
+            def forward(self, x, h=None):
+                if h is None:
+                    h = self.get_initial_states(x, self.state_shape)
+                out = paddle.tanh(self.lin(x) + h)
+                return out, out
+
+        rnn = nn.RNN(MyCell())
+        x = paddle.to_tensor(np.random.randn(2, 5, 4).astype("float32"))
+        outs, hT = rnn(x)
+        assert tuple(outs.shape) == (2, 5, 4) and tuple(hT.shape) == (2, 4)
+
+    def test_birnn_concat(self):
+        cf, cb = nn.LSTMCell(6, 8), nn.LSTMCell(6, 8)
+        birnn = nn.BiRNN(cf, cb)
+        x = np.random.randn(2, 5, 6).astype("float32")
+        outs, (sf, sb) = birnn(paddle.to_tensor(x))
+        assert tuple(outs.shape) == (2, 5, 16)
+        wf, wb = get_w(cf), get_w(cb)
+        zeros = np.zeros((2, 8), "float32")
+        want_f, _ = np_sweep(lambda xt, h, c: np_lstm_step(xt, h, c, *wf),
+                             x, (zeros, zeros))
+        want_b, _ = np_sweep(lambda xt, h, c: np_lstm_step(xt, h, c, *wb),
+                             x, (zeros, zeros), is_reverse=True)
+        np.testing.assert_allclose(
+            outs.numpy(), np.concatenate([want_f, want_b], -1),
+            rtol=1e-5, atol=1e-5)
+
+
+def np_multilayer(mode, cells, x, seq=None, bidirectional=False):
+    """cells: list per layer of (fw,) or (fw, bw) weight tuples."""
+    H = cells[0][0][1].shape[-1]
+    for layer in cells:
+        outs = []
+        for d, w in enumerate(layer):
+            if mode == "LSTM":
+                f = lambda xt, h, c, w=w: np_lstm_step(xt, h, c, *w)
+                s0 = (np.zeros((x.shape[0], H), "float32"),) * 2
+            elif mode == "GRU":
+                f = lambda xt, h, w=w: np_gru_step(xt, h, *w)
+                s0 = (np.zeros((x.shape[0], H), "float32"),)
+            else:
+                f = lambda xt, h, w=w: np_simple_rnn_step(xt, h, *w)
+                s0 = (np.zeros((x.shape[0], H), "float32"),)
+            o, _ = np_sweep(f, x, s0, seq_len=seq, is_reverse=(d == 1))
+            outs.append(o)
+        x = np.concatenate(outs, -1) if len(outs) == 2 else outs[0]
+    return x
+
+
+class TestMultiLayer:
+    @pytest.mark.parametrize("klass,mode", [
+        (nn.SimpleRNN, "RNN"), (nn.LSTM, "LSTM"), (nn.GRU, "GRU")])
+    def test_two_layer_forward(self, klass, mode):
+        net = klass(8, 16, num_layers=2)
+        net.eval()
+        x = np.random.randn(4, 6, 8).astype("float32")
+        outs, final = net(paddle.to_tensor(x))
+        cells = [(get_w(net[i].cell),) for i in range(2)]
+        want = np_multilayer(mode, cells, x)
+        np.testing.assert_allclose(outs.numpy(), want, rtol=1e-5, atol=1e-5)
+        assert tuple(outs.shape) == (4, 6, 16)
+        if mode == "LSTM":
+            h, c = final
+            assert tuple(h.shape) == (2, 4, 16) and tuple(c.shape) == (2, 4, 16)
+        else:
+            assert tuple(final.shape) == (2, 4, 16)
+
+    def test_bidirectional_lstm(self):
+        net = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+        net.eval()
+        x = np.random.randn(3, 5, 8).astype("float32")
+        outs, (h, c) = net(paddle.to_tensor(x))
+        assert tuple(outs.shape) == (3, 5, 32)
+        assert tuple(h.shape) == (4, 3, 16) and tuple(c.shape) == (4, 3, 16)
+        cells = [(get_w(net[i].cell_fw), get_w(net[i].cell_bw))
+                 for i in range(2)]
+        want = np_multilayer("LSTM", cells, x, bidirectional=True)
+        np.testing.assert_allclose(outs.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_initial_and_final_states_roundtrip(self):
+        net = nn.GRU(4, 8, num_layers=2)
+        net.eval()
+        x = np.random.randn(2, 3, 4).astype("float32")
+        h0 = np.random.randn(2, 2, 8).astype("float32")
+        outs, hT = net(paddle.to_tensor(x), paddle.to_tensor(h0))
+        assert tuple(hT.shape) == (2, 2, 8)
+        # feeding the final state back must continue the sequence exactly
+        x2 = np.random.randn(2, 3, 4).astype("float32")
+        outs2, _ = net(paddle.to_tensor(x2), hT)
+        both, _ = net(paddle.to_tensor(np.concatenate([x, x2], 1)),
+                      paddle.to_tensor(h0))
+        np.testing.assert_allclose(outs2.numpy(), both.numpy()[:, 3:],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sequence_length_multilayer(self):
+        net = nn.LSTM(4, 8, num_layers=2, direction="bidirect")
+        net.eval()
+        x = np.random.randn(3, 7, 4).astype("float32")
+        seq = np.array([7, 3, 5], "int64")
+        outs, _ = net(paddle.to_tensor(x), sequence_length=paddle.to_tensor(seq))
+        cells = [(get_w(net[i].cell_fw), get_w(net[i].cell_bw))
+                 for i in range(2)]
+        want = np_multilayer("LSTM", cells, x, seq=seq)
+        np.testing.assert_allclose(outs.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_dropout_zero_in_eval(self):
+        net = nn.SimpleRNN(4, 8, num_layers=2, dropout=0.5)
+        net.eval()
+        x = paddle.to_tensor(np.random.randn(2, 3, 4).astype("float32"))
+        a, _ = net(x)
+        b, _ = net(x)
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_flat_weight_aliases(self):
+        net = nn.LSTM(4, 8, num_layers=2, direction="bidirect")
+        assert net.weight_ih_l0 is net[0].cell_fw.weight_ih
+        assert net.bias_hh_l1_reverse is net[1].cell_bw.bias_hh
+        # aliases must not inflate state_dict
+        assert len(net.state_dict()) == 16
+        assert len(net.parameters()) == 16
+
+    def test_bad_direction_raises(self):
+        with pytest.raises(ValueError):
+            nn.GRU(4, 8, direction="sideways")
+
+
+class TestGradients:
+    def test_lstm_grad_flows_to_all_params_and_input(self):
+        net = nn.LSTM(6, 12, num_layers=2, direction="bidirect")
+        x = paddle.to_tensor(
+            np.random.randn(2, 5, 6).astype("float32"), stop_gradient=False)
+        outs, _ = net(x)
+        loss = outs.sum()
+        loss.backward()
+        assert x.grad is not None and np.isfinite(x.gradient()).all()
+        for p in net.parameters():
+            assert p.grad is not None, "missing grad on a parameter"
+            assert np.isfinite(p.gradient()).all()
+
+    def test_gru_numeric_grad(self):
+        cell = nn.GRUCell(3, 4)
+        rnn = nn.RNN(cell)
+        x0 = np.random.randn(2, 4, 3).astype("float64").astype("float32")
+
+        def f(xv):
+            outs, _ = rnn(paddle.to_tensor(xv.astype("float32")))
+            return float(outs.sum().numpy())
+
+        x = paddle.to_tensor(x0, stop_gradient=False)
+        outs, _ = rnn(x)
+        outs.sum().backward()
+        got = np.asarray(x.gradient())
+        eps = 1e-3
+        num = np.zeros_like(x0)
+        it = np.nditer(x0, flags=["multi_index"])
+        for _ in range(6):   # spot-check a few coordinates
+            idx = tuple(np.random.randint(s) for s in x0.shape)
+            d = np.zeros_like(x0); d[idx] = eps
+            num = (f(x0 + d) - f(x0 - d)) / (2 * eps)
+            np.testing.assert_allclose(got[idx], num, rtol=2e-2, atol=2e-3)
+
+    def test_masked_steps_contribute_no_input_grad(self):
+        cell = nn.SimpleRNNCell(3, 5)
+        rnn = nn.RNN(cell)
+        x = paddle.to_tensor(np.random.randn(2, 6, 3).astype("float32"),
+                             stop_gradient=False)
+        seq = paddle.to_tensor(np.array([6, 2], "int64"))
+        outs, hT = rnn(x, sequence_length=seq)
+        hT.sum().backward()
+        g = np.asarray(x.gradient())
+        # batch element 1 is padded from t=2 on: the final STATE ignores
+        # those steps, so their input grad via hT must be zero
+        assert np.abs(g[1, 2:]).max() == 0.0
+        assert np.abs(g[1, :2]).max() > 0.0
+
+
+class TestIntegration:
+    def test_state_dict_roundtrip(self):
+        net = nn.LSTM(4, 8, num_layers=2)
+        sd = net.state_dict()
+        net2 = nn.LSTM(4, 8, num_layers=2)
+        net2.set_state_dict(sd)
+        x = paddle.to_tensor(np.random.randn(2, 3, 4).astype("float32"))
+        a, _ = net(x)
+        b, _ = net2(x)
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
+
+    def test_lstm_trains(self):
+        # tiny seq-classification: loss must descend
+        net = nn.Sequential()
+        lstm = nn.LSTM(4, 16)
+        head = nn.Linear(16, 2)
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-2,
+            parameters=lstm.parameters() + head.parameters())
+        x = np.random.randn(8, 10, 4).astype("float32")
+        y = (x.sum((1, 2)) > 0).astype("int64")
+        first = last = None
+        for step in range(30):
+            outs, (h, _) = lstm(paddle.to_tensor(x))
+            logits = head(h[-1])
+            loss = paddle.nn.functional.cross_entropy(
+                logits, paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = v if first is None else first
+            last = v
+        assert last < first * 0.5, (first, last)
+
+    def test_to_static_traces_scan(self):
+        net = nn.GRU(4, 8)
+        net.eval()
+
+        @paddle.jit.to_static
+        def fwd(x):
+            outs, h = net(x)
+            return outs
+
+        x = paddle.to_tensor(np.random.randn(2, 5, 4).astype("float32"))
+        got = fwd(x)
+        want, _ = net(x)
+        np.testing.assert_allclose(got.numpy(), want.numpy(),
+                                   rtol=1e-5, atol=1e-5)
