@@ -14,12 +14,14 @@ through the stored VJP closures. Gradients accumulate on leaf tensors'
 """
 from __future__ import annotations
 
+import functools
 import threading
 import weakref
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class _State(threading.local):
@@ -94,6 +96,154 @@ class enable_grad(no_grad):
         return self
 
 
+# ---- eager dispatch cache -------------------------------------------------
+# The reference's dygraph hot loop (`imperative/tracer.cc:172`) pays one
+# kernel launch per op; our eager hot loop pays one jax.vjp RE-TRACE per op
+# (~5-10ms of Python) plus per-primitive dispatch RTT on a tunneled device.
+# Both collapse when the (forward, vjp) pair is traced ONCE per op closure
+# and re-dispatched as a single cached XLA executable: `jax.jit` can return
+# jax.vjp's function (it is a pytree of residual arrays over a static
+# treedef), and a shared jitted applicator replays the backward.
+#
+# Cache key: the op closure's identity-by-VALUE — code object + frozen
+# closure cells + frozen defaults. Closures capturing anything unhashable
+# (arrays, Tensors, per-call lambdas) fall back to the uncached path, so
+# caching can never alias two behaviorally different ops.
+
+_JIT_CACHE: dict = {}
+_UNJITTABLE: set = set()
+_JIT_CACHE_CAP = 4096
+from .random import TraceKeyError as _TraceKeyError  # noqa: E402
+
+_BAILOUT_ERRORS = (jax.errors.TracerBoolConversionError,
+                   jax.errors.ConcretizationTypeError,
+                   jax.errors.TracerArrayConversionError,
+                   jax.errors.TracerIntegerConversionError,
+                   jax.errors.UnexpectedTracerError,
+                   _TraceKeyError)
+
+
+class _Uncacheable(Exception):
+    pass
+
+
+def _freeze(v):
+    """Hashable value-token for a closure cell, or raise _Uncacheable."""
+    if isinstance(v, (str, int, float, bool, bytes, complex, type(None))):
+        return v
+    if isinstance(v, np.dtype):
+        return ("dt", v.str)
+    if isinstance(v, tuple):
+        return ("t",) + tuple(_freeze(x) for x in v)
+    if isinstance(v, list):
+        return ("l",) + tuple(_freeze(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return ("s",) + tuple(sorted((_freeze(x) for x in v), key=repr))
+    if isinstance(v, dict):
+        return ("d",) + tuple((k, _freeze(x)) for k, x in sorted(v.items()))
+    if isinstance(v, functools.partial):
+        return ("p", _freeze(v.func), _freeze(v.args), _freeze(v.keywords))
+    if callable(v):
+        qn = getattr(v, "__qualname__", "<locals>")
+        if "<locals>" not in qn and getattr(v, "__module__", None):
+            return ("f", v.__module__, qn)  # stable module-level callable
+    raise _Uncacheable
+
+
+def _ambient_key():
+    """Global state op fns may read at trace time (AMP autocast regime,
+    matmul precision flag, default dtype) — it must key the cache, or a fn
+    traced under one regime would replay under another."""
+    from ..amp.state import amp_state
+    from . import flags as _flags
+    from .dtype import get_default_dtype
+    s = amp_state()
+    return (s.enabled, str(s.dtype), s.level,
+            _flags.flag("tpu_matmul_precision"), get_default_dtype())
+
+
+def _fn_key(fn):
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return ("fn", _freeze(fn), _ambient_key())
+    frozen = tuple(_freeze(c.cell_contents) for c in (fn.__closure__ or ()))
+    dflt = _freeze(fn.__defaults__) if fn.__defaults__ else None
+    kwd = _freeze(fn.__kwdefaults__) if getattr(fn, "__kwdefaults__", None) \
+        else None
+    return ("code", code, frozen, dflt, kwd, _ambient_key())
+
+
+def _cached_jit(fn, kind, build=None):
+    """Jitted forward (kind='primal') or forward+vjp (kind='vjp') for fn,
+    or None when fn's closure can't be value-keyed."""
+    try:
+        key = (kind, _fn_key(fn))
+    except _Uncacheable:
+        return None, None
+    if key in _UNJITTABLE:
+        return None, None
+    jf = _JIT_CACHE.get(key)
+    if jf is None:
+        if len(_JIT_CACHE) >= _JIT_CACHE_CAP:
+            _JIT_CACHE.clear()
+        if build is not None:
+            jf = build()
+        elif kind == "vjp":
+            jf = jax.jit(lambda *a: jax.vjp(fn, *a))
+        else:
+            jf = jax.jit(fn)
+        _JIT_CACHE[key] = jf
+    return jf, key
+
+
+@functools.lru_cache(maxsize=1)
+def _bwd_apply():
+    # jit cache specializes on the VJP pytree's treedef (= its backward
+    # jaxpr), which is stable across calls of the same cached forward.
+    return jax.jit(lambda vjp_fn, cts: vjp_fn(cts))
+
+
+class _JitVJP:
+    """VJP wrapper routing application through the shared jitted applicator
+    so backward is one executable dispatch instead of an op-by-op walk.
+
+    `inexact` (when set) marks which of the op's positional inputs were
+    differentiated; integer/bool inputs got no cotangent slot and are
+    reported as None (their tape entries are stop_gradient and skipped)."""
+
+    __slots__ = ("raw", "inexact")
+
+    def __init__(self, raw, inexact=None):
+        self.raw = raw
+        self.inexact = inexact
+
+    def __call__(self, cts):
+        try:
+            part = _bwd_apply()(self.raw, cts)
+        except _BAILOUT_ERRORS:
+            part = self.raw(cts)
+        if self.inexact is None:
+            return part
+        it = iter(part)
+        return tuple(next(it) if f else None for f in self.inexact)
+
+
+def _split_vjp_builder(fn, inexact):
+    """fn with integer args: differentiate only the inexact positions,
+    threading the integer arrays through as plain jit arguments."""
+    didx = tuple(i for i, f in enumerate(inexact) if f)
+
+    def wrapper(*args):
+        def g(*diff):
+            it = iter(diff)
+            full = [next(it) if inexact[i] else args[i]
+                    for i in range(len(args))]
+            return fn(*full)
+        return jax.vjp(g, *(args[i] for i in didx))
+
+    return wrapper
+
+
 def apply_op(
     fn: Callable,
     diff_inputs: Sequence["Tensor"],  # noqa: F821
@@ -110,10 +260,42 @@ def apply_op(
     record = _STATE.enabled and any(not t.stop_gradient for t in diff_inputs)
     # Inside a jax trace (to_static), inputs are tracers: let JAX do the
     # differentiation; recording a tape of tracers would leak them.
-    if record and any(isinstance(a, jax.core.Tracer) for a in arrays):
+    tracing = any(isinstance(a, jax.core.Tracer) for a in arrays)
+    if record and tracing:
         record = False
     if not record:
+        if tracing:
+            return fn(*arrays), None
+        jf, key = _cached_jit(fn, "primal")
+        if jf is not None:
+            try:
+                return jf(*arrays), None
+            except _BAILOUT_ERRORS:
+                _UNJITTABLE.add(key)
         return fn(*arrays), None
+    inexact = tuple(bool(jnp.issubdtype(a.dtype, jnp.inexact))
+                    for a in arrays)
+    if all(inexact):
+        jf, key = _cached_jit(fn, "vjp")
+        if jf is not None:
+            try:
+                outs, vjp_fn = jf(*arrays)
+                return outs, _JitVJP(vjp_fn)
+            except _BAILOUT_ERRORS:
+                _UNJITTABLE.add(key)
+    elif all(t.stop_gradient or f
+             for t, f in zip(diff_inputs, inexact)):
+        # integer inputs (labels, indices) ride through as jit args; only
+        # the float positions are differentiated — no float0 round-trip.
+        jf, key = _cached_jit(fn, ("vjp_split", inexact),
+                              build=lambda f=fn: jax.jit(
+                                  _split_vjp_builder(f, inexact)))
+        if jf is not None:
+            try:
+                outs, vjp_fn = jf(*arrays)
+                return outs, _JitVJP(vjp_fn, inexact)
+            except _BAILOUT_ERRORS:
+                _UNJITTABLE.add(key)
     outs, vjp_fn = jax.vjp(fn, *arrays)
     return outs, vjp_fn
 
@@ -147,6 +329,8 @@ def _accumulate(store: dict, tensor, value):
     # sparse+sparse concat and sparse+dense densify); conversion to dense
     # happens only when a cotangent is CONSUMED by an upstream jnp vjp
     # (_dense_cot) — paddle.grad on a sparse leaf stays sparse.
+    if value is None:  # integer input skipped by a split vjp
+        return
     key = id(tensor)
     cur = store.get(key)
     store[key] = value if cur is None else cur + value

@@ -12,6 +12,14 @@ import threading
 import jax
 
 
+class TraceKeyError(RuntimeError):
+    """A stateful key draw was attempted inside a jax trace with no trace
+    key pushed. Mutating the global generator under a trace would leak a
+    tracer into host state; callers must hoist `next_key()` out of traced
+    fns (or push a trace key). The eager dispatch cache treats this as a
+    bailout signal and reruns the op uncached (core/autograd.py)."""
+
+
 class Generator:
     """Stateful wrapper over a jax PRNG key; `next_key()` splits off fresh keys."""
 
@@ -34,6 +42,11 @@ class Generator:
         return self._seed
 
     def next_key(self, n: int = 1):
+        from jax._src import core as _jcore
+        if not _jcore.trace_state_clean():
+            raise TraceKeyError(
+                "Generator.next_key() called inside a jax trace — draw the "
+                "key before tracing (or push a trace key for replay)")
         with self._lock:
             if self._key is None:
                 self._key = jax.random.key(self._seed)

@@ -52,6 +52,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         return flash_attention(q, k, v, causal=is_causal)
 
     scale = 1.0 / math.sqrt(head_dim)
+    # key drawn OUTSIDE the traced fn: drawing inside would leak a tracer
+    # into the global RNG state under the eager dispatch cache
+    drop_key = None
+    if dropout_p > 0.0 and training:
+        from ...core import random as rnd
+        drop_key = rnd.next_key()
 
     def f(qa, ka, va):
         # [B,S,H,D] -> [B,H,S,D]
@@ -70,9 +76,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             else:
                 logits = logits + m.astype(logits.dtype)
         probs = jax.nn.softmax(logits, axis=-1)
-        if dropout_p > 0.0 and training:
-            from ...core import random as rnd
-            keep = jax.random.bernoulli(rnd.next_key(), 1.0 - dropout_p, probs.shape)
+        if drop_key is not None:
+            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, probs.shape)
             probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
         out = jnp.einsum("bhst,bhtd->bhsd", probs, va, precision=_precision())
         return jnp.swapaxes(out, 1, 2)

@@ -27,9 +27,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                   name=None):
     input = ensure_tensor(input)
     label = ensure_tensor(label)
-    lv = label._value
 
-    def f(logits, *rest):
+    # label rides as a positional input (not a closure capture) so the
+    # eager dispatch cache can key this op by its static config alone
+    def f(logits, lv, *rest):
         def _logp():
             return jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
                 jnp.log(jnp.maximum(logits, 1e-30))
@@ -87,7 +88,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             per = jnp.expand_dims(per, axis)
         return per
 
-    ins = [input] + ([ensure_tensor(weight)] if weight is not None else [])
+    ins = [input, label] + ([ensure_tensor(weight)] if weight is not None else [])
     return run_op(f, ins, "cross_entropy")
 
 

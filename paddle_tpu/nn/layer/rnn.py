@@ -138,6 +138,16 @@ def _gru_step(x, hs, w_ih, w_hh, b_ih, b_hh, _activation=None):
     return h, (h,)
 
 
+def _unpack_weights(arrs, flags):
+    """(w_ih, w_hh, b_ih|None, b_hh|None) from the flat array list."""
+    has_bih, has_bhh = flags
+    it = iter(arrs)
+    w_ih, w_hh = next(it), next(it)
+    b_ih = next(it) if has_bih else None
+    b_hh = next(it) if has_bhh else None
+    return w_ih, w_hh, b_ih, b_hh
+
+
 class _BuiltinCell(RNNCellBase):
     """Shared weight plumbing for the three builtin cells."""
 
@@ -179,13 +189,8 @@ class _BuiltinCell(RNNCellBase):
             ws.append(self.bias_hh)
         return ws
 
-    def _unpack_weights(self, arrs):
-        """(w_ih, w_hh, b_ih|None, b_hh|None) from the flat array list."""
-        it = iter(arrs)
-        w_ih, w_hh = next(it), next(it)
-        b_ih = next(it) if self.bias_ih is not None else None
-        b_hh = next(it) if self.bias_hh is not None else None
-        return w_ih, w_hh, b_ih, b_hh
+    def _bias_flags(self):
+        return (self.bias_ih is not None, self.bias_hh is not None)
 
     def forward(self, inputs, states=None):
         inputs = ensure_tensor(inputs)
@@ -194,12 +199,14 @@ class _BuiltinCell(RNNCellBase):
         flat_states = list(states) if isinstance(states, (list, tuple)) \
             else [states]
         flat_states = [ensure_tensor(s) for s in flat_states]
+        # closure captures hashables only, so eager dispatch can cache the
+        # traced (forward, vjp) pair across steps (core/autograd.py)
         step, act = self._step, self.activation
-        n_state = len(flat_states)
+        n_state, flags = len(flat_states), self._bias_flags()
 
         def fused(x, *rest):
             hs = rest[:n_state]
-            w = self._unpack_weights(rest[n_state:])
+            w = _unpack_weights(rest[n_state:], flags)
             _, new = step(x, hs, *w, act)
             return tuple(new)
 
@@ -348,12 +355,13 @@ class RNN(Layer):
         if sequence_length is not None:
             seq = sequence_length._value if isinstance(
                 sequence_length, Tensor) else jnp.asarray(sequence_length)
-        step, act = cell._step, cell.activation
+        # hashable-only closure (except seq) -> dispatch-cacheable sweep
+        step, act, flags = cell._step, cell.activation, cell._bias_flags()
         time_major, is_reverse = self.time_major, self.is_reverse
 
         def sweep(x, *rest):
             hs = rest[:n_state]
-            w = cell._unpack_weights(rest[n_state:])
+            w = _unpack_weights(rest[n_state:], flags)
             outs, final = _scan_rnn(step, x, hs, w, activation=act,
                                     time_major=time_major,
                                     is_reverse=is_reverse, seq_len=seq)
