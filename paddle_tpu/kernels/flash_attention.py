@@ -314,3 +314,228 @@ def flash_attention(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
         lambda a, b, c: flash_attention_arrays(a, b, c, causal=causal,
                                                block_q=block_q, block_k=block_k),
         [q, k, v], "flash_attention")
+
+
+# ---- ring-attention block kernels ------------------------------------------
+# Building blocks for sequence-parallel ring attention (parallel/sp.py):
+# each chip's local q attends one rotating K/V shard per ring hop. The
+# kernels are the same online-softmax tiles as above, plus a global
+# (q_offset, k_offset) pair in SMEM so causal masking and the block trip
+# counts see GLOBAL sequence positions — hops that are entirely in the
+# masked future run ZERO k-block iterations, which is where causal ring
+# attention gets its ~2x FLOP saving over dense sharded attention.
+# The lse emitted by the forward is what the ring hop-merge combines
+# (out = sum_hops exp(lse_hop - lse_total) * out_hop).
+
+def _fa_ring_fwd_kernel(q_ref, k_ref, v_ref, off_ref, o_ref, lse_ref, *,
+                        scale, causal, block_k, kv_len):
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    block_q = q.shape[0]
+    n_kb = kv_len // block_k
+    if causal:
+        q_off = off_ref[0]
+        k_off = off_ref[1]
+        vis = q_off + (qi + 1) * block_q - k_off   # visible keys this q block
+        kmax = jnp.clip((vis + block_k - 1) // block_k, 0, n_kb)
+    else:
+        kmax = n_kb
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = k_off + kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    a0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, kmax, body, (m0, l0, a0))
+    lsafe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / lsafe).astype(o_ref.dtype)
+    # rows with no visible keys get lse ~ -1e30 -> zero weight in the merge
+    lse_ref[0, 0] = jnp.where(l[:, 0] > 0.0, (m + jnp.log(lsafe))[:, 0], -1e30)
+
+
+def _fa_ring_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       off_ref, dq_ref, *, scale, causal, block_k, kv_len):
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    block_q = q.shape[0]
+    n_kb = kv_len // block_k
+    if causal:
+        q_off = off_ref[0]
+        k_off = off_ref[1]
+        vis = q_off + (qi + 1) * block_q - k_off
+        kmax = jnp.clip((vis + block_k - 1) // block_k, 0, n_kb)
+    else:
+        kmax = n_kb
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = k_off + kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, kmax, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _fa_ring_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        off_ref, dk_ref, dv_ref, *, scale, causal, block_q,
+                        q_len):
+    ki = pl.program_id(1)
+    k = k_ref[0]
+    v = v_ref[0]
+    block_k = k.shape[0]
+    n_qb = q_len // block_q
+    if causal:
+        q_off = off_ref[0]
+        k_off = off_ref[1]
+        # first q block whose last row reaches this k block's first key
+        qmin = jnp.clip((k_off + ki * block_k - q_off) // block_q, 0, n_qb)
+    else:
+        qmin = 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_off + qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = k_off + ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k.shape[1]
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qmin, n_qb, body, (z, z))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def ring_block_fwd(q, k, v, offs, *, causal, block_q, block_k, interpret):
+    """One ring hop: local q [BH,Sq,D] x held k/v [BH,Sk,D] ->
+    (out [BH,Sq,D], lse [BH,1,Sq] f32). offs = int32[2] global offsets."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    return pl.pallas_call(
+        functools.partial(_fa_ring_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, kv_len=sk),
+        out_shape=(jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32)),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            _smem_spec(),
+        ],
+        out_specs=(pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))),
+        interpret=interpret,
+    )(q, k, v, offs)
+
+
+def ring_block_dq(q, k, v, do, lse, delta, offs, *, causal, block_q, block_k,
+                  interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    full = lambda b, i: (b, 0, 0)  # noqa: E731
+    return pl.pallas_call(
+        functools.partial(_fa_ring_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, kv_len=sk),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), full),
+            pl.BlockSpec((1, sk, d), full),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            _smem_spec(),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, offs)
+
+
+def ring_block_dkv(q, k, v, do, lse, delta, offs, *, causal, block_q, block_k,
+                   interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    full = lambda b, i: (b, 0, 0)  # noqa: E731
+    return pl.pallas_call(
+        functools.partial(_fa_ring_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, q_len=sq),
+        out_shape=(jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, sk, d), jnp.float32)),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), full),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sq, d), full),
+            pl.BlockSpec((1, 1, sq), full),
+            pl.BlockSpec((1, 1, sq), full),
+            _smem_spec(),
+        ],
+        out_specs=(pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, offs)

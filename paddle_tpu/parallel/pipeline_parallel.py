@@ -88,9 +88,14 @@ class PipelineParallel:
             mesh = self._stage_meshes[s]
 
             def f(params, buffers, x, key):
+                from .topology import use_mesh
                 rnd.push_trace_key(key)
                 try:
-                    return functional_call(mod, pnames, params, bnames, buffers, Tensor(x))
+                    # trace under the STAGE submesh so mp sharding
+                    # constraints bind to the stage's own dp x mp axes
+                    with use_mesh(mesh):
+                        return functional_call(mod, pnames, params, bnames,
+                                               buffers, Tensor(x))
                 finally:
                     rnd.pop_trace_key()
 
@@ -111,13 +116,15 @@ class PipelineParallel:
             mesh = self._stage_meshes[s]
 
             def b(params, buffers, x, g, key):
+                from .topology import use_mesh
                 rnd.push_trace_key(key)
                 try:
                     def f2(ps, xx):
                         return functional_call(mod, pnames, ps, bnames, buffers,
                                                Tensor(xx))
-                    _, vjp = jax.vjp(f2, params, x)
-                    gp, gx = vjp(g)
+                    with use_mesh(mesh):
+                        _, vjp = jax.vjp(f2, params, x)
+                        gp, gx = vjp(g)
                     return gp, gx
                 finally:
                     rnd.pop_trace_key()

@@ -124,7 +124,14 @@ def sequence_parallel_attention(q, k, v, impl="ring", causal=False, mesh=None,
         from ..nn.functional.attention import scaled_dot_product_attention
         return scaled_dot_product_attention(q, k, v, is_causal=causal)
     q, k, v = ensure_tensor(q), ensure_tensor(k), ensure_tensor(v)
-    local = ring_attention_local if impl == "ring" else ulysses_attention_local
+    if impl == "ring":
+        # flash-block ring when the local shard can tile the MXU,
+        # dense-block einsum ring otherwise (decided per-geometry inside)
+        local = ring_flash_attention_local
+    elif impl == "ring_dense":
+        local = ring_attention_local
+    else:
+        local = ulysses_attention_local
     spec = P(None, axis_name, None, None)
     other = tuple(a for a in mesh.axis_names if a != axis_name)
 
@@ -159,3 +166,129 @@ class SequenceParallelAttention:
             impl = "ulysses" if heads % max(n, 1) == 0 and heads >= n * 2 else "ring"
         return sequence_parallel_attention(q, k, v, impl=impl, causal=self.causal,
                                            axis_name=self.axis_name)
+
+
+# ---- ring attention with Pallas flash blocks -------------------------------
+
+def _to_bhsd(x):
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+
+def _from_bhsd(x, b, h):
+    bh, s, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k,
+                             interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    """Forward ring: flash kernel per hop, lse-weighted merge across hops.
+
+    The per-hop kernel returns softmax-normalized block outputs plus their
+    logsumexp; combining hops i with weights exp(lse_i - lse_total) is
+    exactly the flash recurrence lifted to hop granularity, so the merged
+    result equals full-sequence attention to numerical precision.
+    """
+    from ..kernels.flash_attention import ring_block_fwd
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    qf, kf, vf = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    o = jnp.zeros((b * h, s, d), jnp.float32)
+    lse = jnp.full((b * h, 1, s), -1e30, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = kf, vf
+    for step in range(n):
+        kb = (my - step) % n
+        offs = jnp.stack([my * s, kb * s]).astype(jnp.int32)
+        o_b, lse_b = ring_block_fwd(qf, k_cur, v_cur, offs, causal=causal,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=interpret)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        w_old = jnp.exp(lse - lse_new)
+        w_new = jnp.exp(lse_b - lse_new)
+        o = o * jnp.swapaxes(w_old, 1, 2) \
+            + o_b.astype(jnp.float32) * jnp.swapaxes(w_new, 1, 2)
+        lse = lse_new
+        if step < n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    out = _from_bhsd(o, b, h).astype(q.dtype)
+    return out, lse
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, causal, block_q, block_k,
+                         interpret):
+    out, lse = _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, causal, block_q, block_k, interpret, res,
+                         g):
+    """Backward ring: dq accumulates locally; dk/dv accumulators rotate WITH
+    their k/v blocks and arrive home after the full ring (n hops). Uses the
+    global lse, so per-hop probabilities are already globally normalized —
+    hop contributions just sum (flash backward algebra, block-diagonal in
+    hops)."""
+    from ..kernels.flash_attention import ring_block_dq, ring_block_dkv
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    qf, kf, vf = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    of, dof = _to_bhsd(out), _to_bhsd(g)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    dq = jnp.zeros((b * h, s, d), jnp.float32)
+    dk_cur = jnp.zeros((b * h, s, d), jnp.float32)
+    dv_cur = jnp.zeros((b * h, s, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = kf, vf
+    for step in range(n):
+        kb = (my - step) % n
+        offs = jnp.stack([my * s, kb * s]).astype(jnp.int32)
+        dq = dq + ring_block_dq(qf, k_cur, v_cur, dof, lse, delta, offs,
+                                causal=causal, block_q=block_q,
+                                block_k=block_k, interpret=interpret)
+        dk_b, dv_b = ring_block_dkv(qf, k_cur, v_cur, dof, lse, delta, offs,
+                                    causal=causal, block_q=block_q,
+                                    block_k=block_k, interpret=interpret)
+        dk_cur = dk_cur + dk_b
+        dv_cur = dv_cur + dv_b
+        # rotate grads WITH their k/v block; after n hops the grads are
+        # home (k/v need not make the final hop — nothing reads them)
+        if step < n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+    dq_ = _from_bhsd(dq, b, h).astype(q.dtype)
+    dk_ = _from_bhsd(dk_cur, b, h).astype(k.dtype)
+    dv_ = _from_bhsd(dv_cur, b, h).astype(v.dtype)
+    return dq_, dk_, dv_
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def ring_flash_attention_local(q, k, v, axis_name="sp", causal=False,
+                               block_q=None, block_k=None):
+    """Per-shard ring attention with Pallas flash block kernels (call inside
+    shard_map). Falls back to the dense-block einsum ring when the local
+    sequence is too short to tile the MXU."""
+    from ..kernels.flash_attention import DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    s_loc = q.shape[1]
+    bq = min(block_q or DEFAULT_BLOCK_Q, s_loc)
+    bk = min(block_k or DEFAULT_BLOCK_K, s_loc)
+    if s_loc < 128 or s_loc % bq or s_loc % bk:
+        return ring_attention_local(q, k, v, axis_name=axis_name,
+                                    causal=causal)
+    interpret = jax.default_backend() != "tpu"
+    return _ring_flash(q, k, v, axis_name, causal, bq, bk, interpret)

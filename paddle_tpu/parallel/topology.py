@@ -151,6 +151,24 @@ def get_mesh() -> Optional[Mesh]:
     return _GLOBAL_MESH[0]
 
 
+class use_mesh:
+    """Context manager scoping the active mesh (e.g. a pipeline stage's
+    dp x mp submesh) so sharding constraints traced inside see the mesh the
+    computation is actually jitted over, not the global hybrid mesh."""
+
+    def __init__(self, mesh: Mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        self._prev = _GLOBAL_MESH[0]
+        _GLOBAL_MESH[0] = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        _GLOBAL_MESH[0] = self._prev
+        return False
+
+
 def create_mesh(shape: Dict[str, int], devices=None) -> Mesh:
     """Direct mesh construction: create_mesh({'dp': 2, 'mp': 4})."""
     devices = devices if devices is not None else jax.devices()
