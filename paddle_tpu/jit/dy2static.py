@@ -19,9 +19,21 @@ Rewrites applied:
   a and b / a or b  -> convert_logical_and/or(lambda: a, lambda: b)
   not a             -> convert_logical_not(a)
 
-Limitations (mirroring the reference's documented ones): branches containing
-return/break/continue are left as Python (static predicates only); loop
-variables must be initialized before a tensor-predicate loop.
+  break/continue     -> guard flags: `break` becomes `_dy2s_brk_i = True`
+                       (loop test gains `and not _dy2s_brk_i`), `continue`
+                       becomes `_dy2s_cont_i = True`, and the statements
+                       after the escape are wrapped in `if not flag:` —
+                       the reference's break_continue_transformer.py:1
+                       lowering, landing on lax-compatible carried bools
+  return             -> tail `if c: return a / return b` fuses into
+                       if/else; returns under loops lower to
+                       (_dy2s_ret_flag, _dy2s_ret_val) guard flags with
+                       flag-aware loop tests — return_transformer.py:1
+
+Limitations: loop variables must be initialized before a tensor-predicate
+loop; a traced early-return's value must be type-joinable with the other
+paths (the reference's RETURN_NO_VALUE magic-number scheme has the same
+constraint, enforced at lax.cond/while typing instead).
 """
 from __future__ import annotations
 
@@ -125,28 +137,78 @@ def convert_ifelse(pred, true_fn, false_fn, init_vars, names):
         return vals
 
     from ..static.nn import cond
-    return cond(pred, lambda: _chk(true_fn(*init_vars)),
-                lambda: _chk(false_fn(*init_vars)))
+
+    try:
+        return cond(pred, lambda: _chk(true_fn(*init_vars)),
+                    lambda: _chk(false_fn(*init_vars)))
+    except TypeError:
+        if not (names and any(n.startswith("_dy2s_") for n in names)):
+            raise
+
+    # Pytree mismatch on a lowered escape: a _dy2s_* var (e.g. the return
+    # value) is None on the untaken side. Probe both branches for a type
+    # template and backfill the None side with a typed zero — dead by
+    # construction: the flag protocol guarantees a real assignment happens
+    # before the value is consumed (return_transformer.py's
+    # RETURN_NO_VALUE magic-number scheme, typed instead). The probe cost
+    # (one extra branch trace) is only paid on this repair path.
+    fixes = {}
+    probe_t = true_fn(*init_vars)
+    probe_f = false_fn(*init_vars)
+    seq_t = probe_t if isinstance(probe_t, tuple) else (probe_t,)
+    seq_f = probe_f if isinstance(probe_f, tuple) else (probe_f,)
+    for i, (n, a, b) in enumerate(zip(names, seq_t, seq_f)):
+        if not n.startswith("_dy2s_"):
+            continue
+        a_none, b_none = a is None or a is UNDEF, b is None or b is UNDEF
+        if a_none != b_none:
+            tmpl = _unwrap(b if a_none else a)
+            if hasattr(tmpl, "shape"):
+                fixes[i] = jnp.zeros(jnp.shape(tmpl), jnp.result_type(tmpl))
+
+    def _fix(vals):
+        if not fixes:
+            return _chk(vals)
+        seq = list(vals) if isinstance(vals, tuple) else [vals]
+        for i, z in fixes.items():
+            if seq[i] is None or seq[i] is UNDEF:
+                seq[i] = z
+        out = tuple(seq) if isinstance(vals, tuple) else seq[0]
+        return _chk(out)
+
+    return cond(pred, lambda: _fix(true_fn(*init_vars)),
+                lambda: _fix(false_fn(*init_vars)))
 
 
 def convert_while(cond_fn, body_fn, loop_vars, names):
     # A static (python) predicate unrolls under trace — required when the
     # body indexes layers by the counter; only a traced predicate lowers to
     # lax.while_loop.
+    def _lax_loop(vs):
+        for n, v in zip(names, vs):
+            if v is UNDEF:
+                raise ValueError(
+                    f"dy2static: loop variable '{n}' must be initialized "
+                    "before a tensor-predicate `while`")
+        from ..static.nn import while_loop
+        return tuple(while_loop(cond_fn, body_fn, list(vs)))
+
     c0 = cond_fn(*loop_vars)
     if not _is_traced(c0):
         vs = list(loop_vars)
-        while cond_fn(*vs):
+        while True:
+            c = cond_fn(*vs)
+            if _is_traced(c):
+                # the predicate BECAME traced mid-loop (e.g. a lowered
+                # break flag fed by a tensor `if`): hand the current
+                # carries to lax for the remaining iterations
+                return _lax_loop(vs)
+            if not c:
+                break
             out = body_fn(*vs)
             vs = list(out) if isinstance(out, (list, tuple)) else [out]
         return tuple(vs)
-    for n, v in zip(names, loop_vars):
-        if v is UNDEF:
-            raise ValueError(
-                f"dy2static: loop variable '{n}' must be initialized before "
-                "a tensor-predicate `while`")
-    from ..static.nn import while_loop
-    return tuple(while_loop(cond_fn, body_fn, list(loop_vars)))
+    return _lax_loop(loop_vars)
 
 
 def convert_for_range(start, stop, step, body_fn, target_init, loop_vars,
@@ -358,6 +420,377 @@ def _fn_def(name, argnames, body, returns_names):
         body=body, decorator_list=[], returns=None)
 
 
+# ---------------- escape lowering (break/continue/return) ----------------
+
+def _sets_name(stmt, name):
+    """Does stmt's subtree (sans nested defs) assign `name`?"""
+    for n in ast.walk(stmt):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+                and n is not stmt:
+            continue
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+    return False
+
+
+def _not_name(name):
+    return ast.UnaryOp(op=ast.Not(), operand=_name(name, ast.Load()))
+
+
+def _assign_const(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _guard_tail(stmts, flag):
+    """After any statement that may set `flag`, wrap the rest of the block
+    in `if not flag:` — recursively, including inside nested `if` arms
+    (loops and nested defs are scope boundaries handled by their own
+    lowering passes)."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            s.body = _guard_tail(s.body, flag)
+            s.orelse = _guard_tail(s.orelse, flag)
+        out.append(s)
+        if _sets_name(s, flag) and i + 1 < len(stmts):
+            rest = _guard_tail(stmts[i + 1:], flag)
+            out.append(ast.If(test=_not_name(flag), body=rest, orelse=[]))
+            return out
+    return out
+
+
+def _ends_in_return(stmts):
+    return bool(stmts) and isinstance(stmts[-1], (ast.Return, ast.Raise))
+
+
+def _always_returns(stmts):
+    """Every path through stmts ends in return/raise (shallow analysis)."""
+    if _ends_in_return(stmts):
+        return True
+    if stmts and isinstance(stmts[-1], ast.If):
+        last = stmts[-1]
+        return bool(last.orelse) and _always_returns(last.body) \
+            and _always_returns(last.orelse)
+    return False
+
+
+class _TailReturnFusion(ast.NodeTransformer):
+    """`if c: ...return` followed by more statements -> push the rest into
+    the else branch. Turns the ubiquitous early-return pattern into a
+    well-typed if/else join with no guard flags needed
+    (return_transformer.py's simplest case)."""
+
+    def _fuse_block(self, stmts):
+        stmts = list(stmts)
+        changed = True
+        while changed:
+            changed = False
+            for i, s in enumerate(stmts):
+                if isinstance(s, ast.If) and _always_returns(s.body) \
+                        and not s.orelse and i + 1 < len(stmts):
+                    s.orelse = self._fuse_block(stmts[i + 1:])
+                    stmts = stmts[:i + 1]
+                    changed = True
+                    break
+        return stmts
+
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+        node.body = self._fuse_block(node.body)
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        node.body = self._fuse_block(node.body)
+        node.orelse = self._fuse_block(node.orelse)
+        return node
+
+
+def _strip_tail_returns(stmts, var):
+    """Replace the terminal Return on every path of an always-returning
+    block with an assignment to `var`."""
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        val = last.value if last.value is not None else ast.Constant(value=None)
+        stmts[-1] = ast.Assign(targets=[_name(var, ast.Store())], value=val)
+    elif isinstance(last, ast.If):
+        _strip_tail_returns(last.body, var)
+        _strip_tail_returns(last.orelse, var)
+    return stmts
+
+
+class _ReturnPushdown(ast.NodeTransformer):
+    """A block ending in an If where BOTH arms always return becomes
+    branch-assignments of one fresh var + a single trailing return — a
+    well-typed lax.cond join with no guard flags (the structured half of
+    return_transformer.py; _ReturnLowering handles the rest)."""
+
+    def __init__(self, uid):
+        self._uid = uid
+
+    def _push_block(self, stmts):
+        if not stmts:
+            return stmts
+        last = stmts[-1]
+        if isinstance(last, ast.If) and last.orelse \
+                and _always_returns(last.body) and _always_returns(last.orelse) \
+                and not isinstance(last.body[-1], ast.Raise) \
+                and not isinstance(last.orelse[-1], ast.Raise):
+            var = f"_dy2s_ret_{self._uid()}"
+            _strip_tail_returns(last.body, var)
+            _strip_tail_returns(last.orelse, var)
+            return stmts + [ast.Return(value=_name(var, ast.Load()))]
+        return stmts
+
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+        node.body = self._push_block(node.body)
+        return node
+
+
+class _ForRangeToWhile(ast.NodeTransformer):
+    """for x in range(...) containing break/continue/return -> explicit
+    while, so the guard-flag lowering has a test to AND flags into. The
+    increment is tagged so continue-guards leave it outside."""
+
+    def __init__(self, uid):
+        self._uid = uid
+
+    def _visit_block(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+    def generic_visit(self, node):
+        for field, old in ast.iter_fields(node):
+            if isinstance(old, list) and old and isinstance(old[0], ast.stmt):
+                setattr(node, field, self._visit_block(old))
+            elif isinstance(old, ast.AST):
+                self.visit(old)
+        return node
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (not _escapes(node.body) or node.orelse
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or not isinstance(node.target, ast.Name)):
+            return node
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(value=0), rargs[0], \
+                ast.Constant(value=1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(value=1)
+        elif len(rargs) == 3:
+            start, stop, step = rargs
+        else:
+            return node
+        i = self._uid()
+        it, st, sp = f"_dy2s_it_{i}", f"_dy2s_stop_{i}", f"_dy2s_step_{i}"
+
+        def nm(x, ctx=ast.Load):
+            return _name(x, ctx())
+
+        # ((step > 0) and (it < stop)) or ((step < 0) and (it > stop))
+        test = ast.BoolOp(op=ast.Or(), values=[
+            ast.BoolOp(op=ast.And(), values=[
+                ast.Compare(left=nm(sp), ops=[ast.Gt()],
+                            comparators=[ast.Constant(value=0)]),
+                ast.Compare(left=nm(it), ops=[ast.Lt()],
+                            comparators=[nm(st)])]),
+            ast.BoolOp(op=ast.And(), values=[
+                ast.Compare(left=nm(sp), ops=[ast.Lt()],
+                            comparators=[ast.Constant(value=0)]),
+                ast.Compare(left=nm(it), ops=[ast.Gt()],
+                            comparators=[nm(st)])])])
+        incr = ast.Assign(
+            targets=[nm(it, ast.Store)],
+            value=ast.BinOp(left=nm(it), op=ast.Add(), right=nm(sp)))
+        incr._dy2s_incr = True
+        body = [ast.Assign(targets=[_name(node.target.id, ast.Store())],
+                           value=nm(it))] + node.body + [incr]
+        return [
+            ast.Assign(targets=[nm(it, ast.Store)], value=start),
+            ast.Assign(targets=[nm(st, ast.Store)], value=stop),
+            ast.Assign(targets=[nm(sp, ast.Store)], value=step),
+            ast.While(test=test, body=body, orelse=[]),
+        ]
+
+
+class _ReturnLowering(ast.NodeTransformer):
+    """Returns under control flow -> (_dy2s_ret_flag, _dy2s_ret_val) with
+    guarded tails and flag-aware while tests (return_transformer.py:1)."""
+
+    FLAG, VAL = "_dy2s_ret_flag", "_dy2s_ret_val"
+
+    def lower(self, fdef):
+        # A Return inside a surviving `for` over a NON-range iterable can't
+        # be flag-lowered (no test expression to AND the flag into) — leave
+        # the whole function on python-escape semantics rather than lower
+        # partially and keep iterating past the "return".
+        for n in ast.walk(fdef):
+            if isinstance(n, ast.For):
+                if any(isinstance(m, (ast.Return, ast.Break, ast.Continue))
+                       for m in ast.walk(n)):
+                    return fdef
+        inside = False
+        for n in ast.walk(fdef):
+            if isinstance(n, (ast.If, ast.While, ast.For)):
+                if any(isinstance(m, ast.Return) for m in ast.walk(n)):
+                    inside = True
+                    break
+        if not inside:
+            return fdef
+        self._replace_block(fdef)
+        fdef.body = [_assign_const(self.FLAG, False),
+                     _assign_const(self.VAL, None)] + \
+            self._guard_blocks(fdef).body
+        fdef.body.append(ast.Return(value=_name(self.VAL, ast.Load())))
+        return fdef
+
+    # pass 1: every Return -> val/flag assignment
+    def _replace_block(self, root):
+        class R(ast.NodeTransformer):
+            def visit_Return(self, node):
+                val = node.value if node.value is not None \
+                    else ast.Constant(value=None)
+                return [
+                    ast.Assign(targets=[_name(_ReturnLowering.VAL,
+                                              ast.Store())], value=val),
+                    _assign_const(_ReturnLowering.FLAG, True),
+                ]
+
+            def visit_FunctionDef(self, node):
+                return node  # inner scope
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                return node
+
+        for field, old in ast.iter_fields(root):
+            if isinstance(old, list):
+                new = []
+                for s in old:
+                    if isinstance(s, ast.stmt):
+                        r = R().visit(s)
+                        new.extend(r if isinstance(r, list) else [r])
+                    else:
+                        new.append(s)
+                setattr(root, field, new)
+        for child in ast.iter_child_nodes(root):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)) or child is root:
+                self._replace_block(child)
+        return root
+
+    # pass 2: guard tails + while tests
+    def _guard_blocks(self, root):
+        for child in ast.iter_child_nodes(root):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                self._guard_blocks(child)
+        for field, old in ast.iter_fields(root):
+            if isinstance(old, list) and old and isinstance(old[0], ast.stmt):
+                setattr(root, field, _guard_tail(old, self.FLAG))
+        if isinstance(root, ast.While) and _sets_name(root, self.FLAG):
+            root.test = ast.BoolOp(op=ast.And(),
+                                   values=[root.test, _not_name(self.FLAG)])
+        return root
+
+
+class _BreakContinueLowering(ast.NodeTransformer):
+    """Per-loop guard flags (break_continue_transformer.py:1). Runs after
+    return lowering, so remaining Break/Continue nodes at a loop's level
+    (nested loops already lowered) belong to that loop."""
+
+    def __init__(self, uid):
+        self._uid = uid
+
+    def _visit_block(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+    def generic_visit(self, node):
+        for field, old in ast.iter_fields(node):
+            if isinstance(old, list) and old and isinstance(old[0], ast.stmt):
+                setattr(node, field, self._visit_block(old))
+            elif isinstance(old, ast.AST):
+                self.visit(old)
+        return node
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # inner loops first
+        has_b = any(isinstance(n, ast.Break) for n in ast.walk(node))
+        has_c = any(isinstance(n, ast.Continue) for n in ast.walk(node))
+        if not (has_b or has_c):
+            return node
+        i = self._uid()
+        brk, cont = f"_dy2s_brk_{i}", f"_dy2s_cont_{i}"
+
+        class R(ast.NodeTransformer):
+            def visit_Break(self, n):
+                return _assign_const(brk, True)
+
+            def visit_Continue(self, n):
+                return _assign_const(cont, True)
+
+            def visit_While(self, n):
+                return n  # inner loops already lowered; don't descend
+
+            def visit_For(self, n):
+                return n
+
+            def visit_FunctionDef(self, n):
+                return n
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, n):
+                return n
+
+        body = [R().visit(s) for s in node.body]
+        # keep a tagged for->while increment outside the continue guards
+        tail = []
+        if body and getattr(body[-1], "_dy2s_incr", False):
+            tail = [body[-1]]
+            body = body[:-1]
+        if has_c:
+            body = _guard_tail(body, cont)
+        if has_b:
+            body = _guard_tail(body, brk)
+        pre = []
+        if has_c:
+            body = [_assign_const(cont, False)] + body
+        if has_b:
+            pre.append(_assign_const(brk, False))
+            node.test = ast.BoolOp(op=ast.And(),
+                                   values=[node.test, _not_name(brk)])
+        node.body = body + tail
+        return pre + [node] if pre else node
+
+
+def _lower_escapes(tree, uid):
+    """break/continue/return -> structured control flow + guard flags."""
+    tree = _TailReturnFusion().visit(tree)
+    tree = _ReturnPushdown(uid).visit(tree)
+    tree = _ForRangeToWhile(uid).visit(tree)
+    fdef = tree.body[0]
+    _ReturnLowering().lower(fdef)
+    tree = _BreakContinueLowering(uid).visit(tree)
+    return tree
+
+
 class _Dy2StaticTransformer(ast.NodeTransformer):
     def __init__(self):
         self._n = 0
@@ -499,6 +932,9 @@ def ast_transform(func):
     if not _has_ctrl_flow(fdef):
         return func
     fdef.decorator_list = []  # avoid re-applying @to_static etc.
+    import itertools
+    counter = itertools.count(1)
+    tree = _lower_escapes(tree, lambda: next(counter))
     new_tree = _Dy2StaticTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
     try:

@@ -270,3 +270,168 @@ class TestEndToEnd:
                               stop_gradient=False)
         f(xn).backward()
         np.testing.assert_allclose(xn.gradient(), [5.0, 5.0])
+
+
+class TestEscapes:
+    """break/continue/return lowering (break_continue_transformer.py,
+    return_transformer.py parity): the same source must run eagerly and
+    traced."""
+
+    def test_early_return_tensor_pred(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            return x - 1
+
+        xp = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        np.testing.assert_allclose(f(xp).numpy(), [2.0, 4.0])
+        xn = paddle.to_tensor(np.array([-1.0, -2.0], "float32"))
+        np.testing.assert_allclose(f(xn).numpy(), [-2.0, -3.0])
+
+    def test_early_return_chain(self):
+        @to_static
+        def f(x):
+            s = x.sum()
+            if s > 10:
+                return x * 10
+            if s > 0:
+                return x * 2
+            return -x
+
+        a = paddle.to_tensor(np.array([20.0], "float32"))
+        np.testing.assert_allclose(f(a).numpy(), [200.0])
+        b = paddle.to_tensor(np.array([1.0], "float32"))
+        np.testing.assert_allclose(f(b).numpy(), [2.0])
+        c = paddle.to_tensor(np.array([-3.0], "float32"))
+        np.testing.assert_allclose(f(c).numpy(), [3.0])
+
+    def test_break_in_tensor_while(self):
+        @to_static
+        def f(x):
+            i = paddle.to_tensor(np.array(0.0, "float32"))
+            acc = x * 0
+            while i < 100.0:
+                acc = acc + x
+                if acc.sum() > 5.0:
+                    break
+                i = i + 1.0
+            return acc
+
+        x = paddle.to_tensor(np.array([1.0, 1.0], "float32"))
+        # acc sums: 2,4,6 -> break after 3 adds
+        np.testing.assert_allclose(f(x).numpy(), [3.0, 3.0])
+
+    def test_continue_in_for_range(self):
+        @to_static
+        def f(x):
+            acc = x * 0
+            for i in range(6):
+                if i % 2 == 1:
+                    continue
+                acc = acc + x * float(i)
+            return acc
+
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [0.0 + 2 + 4])
+
+    def test_break_in_for_range(self):
+        @to_static
+        def f(x):
+            acc = x * 0
+            for i in range(100):
+                acc = acc + x
+                if acc.sum() >= 4.0:
+                    break
+            return acc
+
+        x = paddle.to_tensor(np.array([2.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [4.0])
+
+    def test_return_inside_loop(self):
+        @to_static
+        def f(x):
+            acc = x * 0
+            for i in range(10):
+                acc = acc + x
+                if acc.sum() > 3.0:
+                    return acc * 100
+            return acc
+
+        x = paddle.to_tensor(np.array([2.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [400.0])
+        y = paddle.to_tensor(np.array([0.1], "float32"))
+        np.testing.assert_allclose(f(y).numpy(), [1.0], rtol=1e-5)
+
+    def test_nested_loops_inner_break(self):
+        @to_static
+        def f(x):
+            acc = x * 0
+            for i in range(3):
+                for j in range(5):
+                    if j >= 2:
+                        break
+                    acc = acc + x
+            return acc
+
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [6.0])  # 3 outer x 2 inner
+
+    def test_continue_then_statements_skipped(self):
+        @to_static
+        def f(x):
+            acc = x * 0
+            bonus = x * 0
+            for i in range(4):
+                if i == 1:
+                    continue
+                acc = acc + x
+                bonus = bonus + x * 10.0
+            return acc + bonus
+
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [3.0 + 30.0])
+
+    def test_eager_semantics_unchanged(self):
+        # the transformed source must behave identically WITHOUT tracing
+        def g(x):
+            out = []
+            for i in range(5):
+                if i == 2:
+                    continue
+                if i == 4:
+                    break
+                out.append(i)
+            return out
+
+        t = ast_transform(g)
+        assert t(None) == [0, 1, 3]
+
+    def test_grad_through_early_return(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return (x * 3.0).sum()
+            return (x * 5.0).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 1.0], "float32"),
+                             stop_gradient=False)
+        f(x).backward()
+        np.testing.assert_allclose(x.gradient(), [3.0, 3.0])
+
+    def test_return_in_for_over_list_keeps_python_semantics(self):
+        # non-range iterables can't be flag-lowered; the escape must keep
+        # exact python behavior (no extra iterations, no side effects)
+        def g(x):
+            seen = []
+            acc = 0.0
+            for v in [2.0, 3.0, 4.0]:
+                seen.append(v)
+                acc += v
+                if acc > 1.0:
+                    return acc, seen
+            return -1.0, seen
+
+        t = ast_transform(g)
+        acc, seen = t(None)
+        assert acc == 2.0 and seen == [2.0]
