@@ -220,7 +220,10 @@ def bench_lenet_dispatch(backend):
     _sync(loss._value)
     ms = (time.perf_counter() - t0) / n * 1000
     return {"step_latency_ms": round(ms, 2),
-            "note": "eager per-op dispatch; includes tunnel RTT per op on axon"}
+            "note": "eager per-op dispatch through the traced-vjp cache "
+                    "(core/autograd.py): one cached XLA executable per op, "
+                    "so the tunnel RTT is paid once per step-chain, not "
+                    "once per primitive"}
 
 
 def bench_flash_attention(backend):
@@ -304,37 +307,47 @@ def bench_yoloe_infer(backend):
             "variant": "ppyoloe_s"}
 
 
+def bench_ocr_rec_infer(backend):
+    """BASELINE config 4, recognition half: PP-OCRv3-style CRNN (conv
+    backbone -> BiLSTM -> CTC head) through the Predictor. Completes the
+    config-4 pair next to bench_yoloe_infer (detection half)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+
+    if backend != "tpu":
+        return {"skipped": "needs real chip"}
+    batch, h, w = 64, 32, 320
+    paddle.seed(0)
+    net = models.pp_ocrv3_rec(n_classes=6625, scale=0.5, hidden_size=48)
+    med, spread = _predictor_rate(net, (batch, h, w, 3), 200, 5,
+                                  precision="bfloat16")
+    return {"imgs_per_sec": round(med, 2), "spread": round(spread, 3),
+            "batch": batch, "img": f"{h}x{w}", "layout": "NHWC",
+            "precision": "bf16", "variant": "pp_ocrv3_rec (CRNN+BiLSTM+CTC)"}
+
+
 def bench_ernie10b_layer(backend):
     """BASELINE config 5 proxy: ERNIE-3.0-Titan 10B layer-scale train step
-    that fits one chip. Two transformer layers at the titan geometry
-    (h=4096, ffn=16384, 64 heads — ~201M params/layer, what one chip of a
-    16-way sharding+pipeline pod slice would hold) run fwd+bwd+AdamW at
-    seq 2048; MFU extrapolates per-layer. The full-model stage-3 sharding
-    path is certified by __graft_entry__.dryrun_multichip on the virtual
-    mesh (BASELINE.json config 5; reference `ernie_titan` fleet configs).
+    that fits one chip. FOUR transformer layers at the titan geometry
+    (h=4096, ffn=16384, 64 heads — ~201M params/layer; 4 layers + AdamW
+    state = ~13 GB, what one chip of a 12-way sharding+pipeline pod slice
+    holds) run fwd+bwd+AdamW at seq 2048 through the scan-over-layers
+    stack with per-layer remat (models/ernie.py ErnieScanStack — the same
+    machinery the full 48-layer model trains with). MFU extrapolates
+    per-layer. The full-model ZeRO-3 / pp x mp / SP-ring+flash regimes and
+    the 16 GB/chip memory arithmetic are certified by
+    __graft_entry__.dryrun_multichip and tests/test_titan_feasibility.py.
     """
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
-    from paddle_tpu.models.ernie import ErnieLayer
+    from paddle_tpu.models.ernie import ErnieScanStack
     from paddle_tpu.jit import TrainStep
 
     if backend != "tpu":
         return {"skipped": "needs real chip"}
-    h, ffn, heads, seq, batch, nlayers = 4096, 16384, 64, 2048, 2, 2
+    h, ffn, heads, seq, batch, nlayers = 4096, 16384, 64, 2048, 2, 4
     paddle.seed(0)
-
-    class Block(nn.Layer):
-        def __init__(self):
-            super().__init__()
-            self.layers = nn.LayerList([
-                ErnieLayer(h, heads, ffn, dropout=0.0) for _ in range(nlayers)])
-
-        def forward(self, x):
-            for l in self.layers:
-                x = l(x)
-            return x
-
-    net = Block()
+    net = ErnieScanStack(h, heads, ffn, nlayers, remat=True)
 
     def loss_fn(out):
         # target-free MSE-to-zero: shipping a [10,2,2048,4096] zeros target
@@ -360,8 +373,10 @@ def bench_ernie10b_layer(backend):
     ms_layer = 1000.0 / (sps * nlayers) / batch
     return {"layer_step_ms_per_sample": round(ms_layer, 2), "mfu": round(mfu, 4),
             "geometry": f"h{h}xffn{ffn}x{heads}head seq{seq}",
-            "note": "one-chip proxy: 2 titan layers; stage-3 sharding "
-                    "certified by dryrun_multichip"}
+            "note": f"one-chip proxy: {nlayers} titan layers, scanned + "
+                    "per-layer remat; ZeRO-3, pp x mp, SP-ring+flash "
+                    "certified by dryrun_multichip; HBM arithmetic by "
+                    "tests/test_titan_feasibility.py"}
 
 
 def bench_allreduce(backend):
@@ -419,8 +434,9 @@ print(json.dumps({"bus_gbps": round(bus / 1e9, 3), "n_devices": n,
         out = json.loads(proc.stdout.strip().splitlines()[-1])
     except Exception as e:  # noqa: BLE001
         return {"error": str(e)[:200]}
-    out["note"] = ("8-dev virtual CPU mesh (XLA collective path); "
-                   "real ICI BW needs a multi-chip slice")
+    out["note"] = ("correctness-smoke of the collective path on the 8-dev "
+                   "virtual CPU mesh — NOT a bandwidth number; real ICI BW "
+                   "needs a multi-chip slice")
     return out
 
 
@@ -435,8 +451,9 @@ def main():
              "lenet_dispatch": bench_lenet_dispatch(backend),
              f"flash_attn_{flash.get('seq', 'na')}": flash,
              "yoloe_infer": bench_yoloe_infer(backend),
+             "ocr_rec_infer": bench_ocr_rec_infer(backend),
              "ernie10b_layer": bench_ernie10b_layer(backend),
-             "allreduce_bus_bw": bench_allreduce(backend)}
+             "allreduce_smoke": bench_allreduce(backend)}
 
     sps = ernie["samples_per_sec"]
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

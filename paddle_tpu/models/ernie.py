@@ -206,3 +206,109 @@ def ernie_titan_10b(**kw):
 
 bert_base = ernie_base
 bert_large = ernie_large
+
+
+class ErnieScanStack(nn.Layer):
+    """N identical transformer layers as ONE scanned, rematerialized layer.
+
+    TPU-first design for the deep (48-layer titan) stack: instead of
+    unrolling 48 python layers into the HLO (48x compile time, 48x code) the
+    layer weights live STACKED ([L, ...] leading axis) and the forward is
+    `lax.scan(jax.checkpoint(layer_fn))`:
+      - compile time and program size are O(1) in depth;
+      - `jax.checkpoint` per scan step = per-layer remat, so backward peak
+        activation memory is one layer's activations + L boundary tensors
+        (the enabler for ZeRO-3 titan training, reference
+        `sharding_stage3.py:308` + `recompute` meta-optimizer);
+      - GSPMD shards the stacked weights on their hidden axes exactly like
+        the unrolled layers.
+    Semantics match a loop of ErnieLayer(dropout=0) (post-LN residual
+    blocks); dropout is compiled out (the large-scale configs train with
+    dropout 0 anyway — reference ernie titan configs).
+    """
+
+    def __init__(self, hidden_size, num_heads, intermediate_size, n_layers,
+                 remat=True, causal=False):
+        super().__init__()
+        import math as _math
+        h, ffn, L = hidden_size, intermediate_size, n_layers
+        self.hidden_size, self.num_heads, self.n_layers = h, num_heads, L
+        self.remat, self.causal = remat, causal
+        k = 1.0 / _math.sqrt(h)
+
+        def mk(*shape):
+            return self.create_parameter(
+                shape, default_initializer=nn.initializer.Uniform(-k, k))
+
+        def zeros_(*shape):
+            return self.create_parameter(
+                shape, default_initializer=nn.initializer.Constant(0.0))
+
+        self.qkv_w = mk(L, h, 3 * h)
+        self.qkv_b = zeros_(L, 3 * h)
+        self.proj_w = mk(L, h, h)
+        self.proj_b = zeros_(L, h)
+        self.fc1_w = mk(L, h, ffn)
+        self.fc1_b = zeros_(L, ffn)
+        self.fc2_w = mk(L, ffn, h)
+        self.fc2_b = zeros_(L, h)
+        ones_ = nn.initializer.Constant(1.0)
+        self.ln1_g = self.create_parameter((L, h), default_initializer=ones_)
+        self.ln1_b = zeros_(L, h)
+        self.ln2_g = self.create_parameter((L, h), default_initializer=ones_)
+        self.ln2_b = zeros_(L, h)
+        # GSPMD layout: shard the big matrices on their widest axis
+        for p, attr in ((self.qkv_w, (None, None, "mp")),
+                        (self.fc1_w, (None, None, "mp")),
+                        (self.fc2_w, (None, "mp", None)),
+                        (self.proj_w, (None, "mp", None))):
+            p.dist_attr = attr
+
+    def _layer_fn(self, x, wl):
+        import jax
+        import jax.numpy as jnp
+        import math as _math
+        (qkv_w, qkv_b, proj_w, proj_b, fc1_w, fc1_b, fc2_w, fc2_b,
+         ln1_g, ln1_b, ln2_g, ln2_b) = wl
+        B, S, H = x.shape
+        nh = self.num_heads
+        hd = H // nh
+
+        def ln(v, g, b):
+            mu = jnp.mean(v, -1, keepdims=True)
+            var = jnp.var(v, -1, keepdims=True)
+            return (v - mu) * jax.lax.rsqrt(var + 1e-12) * g + b
+
+        qkv = x @ qkv_w + qkv_b
+        q, k_, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, nh, hd)
+        k_ = k_.reshape(B, S, nh, hd)
+        v = v.reshape(B, S, nh, hd)
+        from ..kernels.flash_attention import flash_attention_arrays
+        o = flash_attention_arrays(q, k_, v, causal=self.causal)
+        o = o.reshape(B, S, H) @ proj_w + proj_b
+        x = ln(x + o, ln1_g, ln1_b)
+        m = jax.nn.gelu(x @ fc1_w + fc1_b, approximate=False) @ fc2_w + fc2_b
+        x = ln(x + m, ln2_g, ln2_b)
+        return x
+
+    def forward(self, x):
+        from ..ops._dispatch import ensure_tensor, run_op
+        import jax
+        x = ensure_tensor(x)
+        ws = [self.qkv_w, self.qkv_b, self.proj_w, self.proj_b,
+              self.fc1_w, self.fc1_b, self.fc2_w, self.fc2_b,
+              self.ln1_g, self.ln1_b, self.ln2_g, self.ln2_b]
+        remat = self.remat
+
+        def f(xa, *warrs):
+            def body(carry, wl):
+                step = self._layer_fn
+                if remat:
+                    step = jax.checkpoint(step)
+                return step(carry, wl), None
+
+            out, _ = jax.lax.scan(body, xa, tuple(warrs))
+            return out
+
+        return run_op(f, [x, *ws], "ernie_scan_stack")

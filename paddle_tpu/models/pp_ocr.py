@@ -101,6 +101,10 @@ class PPOCRRec(nn.Layer):
     def __init__(self, n_classes=6625, scale=0.5, hidden_size=48,
                  data_format="NHWC"):
         super().__init__()
+        if data_format != "NHWC":
+            raise ValueError(
+                "PPOCRRec is NHWC-only (TPU deploy layout); the sequence "
+                f"neck pools the height axis — got {data_format}")
         self.backbone = RecBackbone(3, scale, data_format)
         self.neck = SequenceEncoder(self.backbone.out_channels, hidden_size)
         self.head = CTCHead(self.neck.out_channels, n_classes)

@@ -1,0 +1,249 @@
+"""ERNIE-3.0-Titan 10B feasibility artifact (BASELINE config 5).
+
+Three gates that FAIL if the memory math breaks:
+1. exact byte arithmetic for the full 48-layer titan under the pod-slice
+   plan (pp=4 x ZeRO-3 sharding=4 on v5e-16, per-layer remat) must fit the
+   16 GB/chip HBM budget;
+2. the compiled XLA executable for one pipeline stage (12 scanned titan
+   layers, ZeRO-3 over sharding=4, remat) at FULL geometry must report
+   per-chip peak memory within the budget (jit lower+compile -> XLA
+   buffer-assignment stats; nothing is allocated);
+3. the same sharded stage program must actually execute a train step on
+   tiny shapes (8-device virtual mesh).
+
+Reference anchors: sharding stage-3 param slicing
+(`python/paddle/distributed/fleet/meta_parallel/sharding/sharding_stage3.py:308`),
+recompute meta-optimizer, ernie titan fleet configs.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+# ---- the titan plan ----
+H, FFN, HEADS, LAYERS = 4096, 16384, 64, 48
+VOCAB, SEQ = 50304, 2048
+PP, SHARD = 4, 4                  # v5e-16 slice: pp4 x sharding4
+V5E_HBM = 16 * 2 ** 30            # bytes per chip
+MICRO_BATCH = 1                   # per-chip micro batch under 1F1B
+
+
+def layer_param_count(h=H, ffn=FFN):
+    # qkv + proj + fc1 + fc2 (+ biases + 2 LN)
+    return (h * 3 * h + 3 * h) + (h * h + h) + (h * ffn + ffn) \
+        + (ffn * h + h) + 4 * h
+
+
+def titan_plan_bytes():
+    """Exact per-chip byte accounting for pp4 x ZeRO-3(4) + remat."""
+    layers_per_stage = LAYERS // PP
+    stage_params = layers_per_stage * layer_param_count()
+    # embeddings + pooler live on stage 0; charge the worst stage
+    stage_params += VOCAB * H + SEQ * H + 2 * H + H * H + H
+    # fp32 master params + adam m/v, each ZeRO-3 sharded over SHARD chips
+    param_bytes = 4 * stage_params / SHARD
+    slot_bytes = 2 * 4 * stage_params / SHARD
+    grad_bytes = 4 * stage_params / SHARD   # reduce-scattered grads
+    # remat activations: boundary x (layers_per_stage) + one layer's live set
+    act_boundary = layers_per_stage * MICRO_BATCH * SEQ * H * 4
+    act_layer = MICRO_BATCH * SEQ * (3 * H + FFN + 2 * H) * 4
+    total = param_bytes + slot_bytes + grad_bytes + act_boundary + act_layer
+    return {
+        "params": param_bytes, "slots": slot_bytes, "grads": grad_bytes,
+        "act_boundary": act_boundary, "act_layer": act_layer, "total": total,
+    }
+
+
+class TestTitanArithmetic:
+    def test_model_is_10b_scale(self):
+        total = LAYERS * layer_param_count() + VOCAB * H + SEQ * H + H * H
+        assert 9.5e9 < total < 11e9, total
+
+    def test_plan_fits_v5e_hbm(self):
+        b = titan_plan_bytes()
+        assert b["total"] < 0.85 * V5E_HBM, \
+            f"titan plan blows the v5e budget: {b['total'] / 2**30:.2f} GiB"
+
+    def test_unsharded_plan_does_not_fit(self):
+        # sanity: the budget check has teeth — without ZeRO-3 the same
+        # stage CANNOT fit, so the assertion above is not vacuous
+        layers_per_stage = LAYERS // PP
+        stage_params = layers_per_stage * layer_param_count()
+        unsharded = (4 + 8 + 4) * stage_params
+        assert unsharded > V5E_HBM
+
+
+@pytest.fixture(scope="module")
+def stage_mesh():
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, ("dp", "sharding"))
+
+
+def _stage_step_fn(stack, head_w):
+    """Functional ZeRO-3 train step over the scanned stage (params sharded
+    on 'sharding', batch on dp x sharding)."""
+    from paddle_tpu.jit.functional import functional_call, split_state
+    trainable, _ = split_state(stack)
+    pnames = list(trainable)
+
+    def spec_for(name, t):
+        shape = tuple(t.shape)
+        # ZeRO-3: stacked titan weights shard their widest non-layer axis
+        big = max(range(1, len(shape)), key=lambda i: shape[i]) \
+            if len(shape) > 1 else None
+        spec = [None] * len(shape)
+        if big is not None and shape[big] % 4 == 0:
+            spec[big] = "sharding"
+        return P(*spec)
+
+    specs = {n: spec_for(n, trainable[n]) for n in pnames}
+
+    def step(params, hw, x, y):
+        def loss_fn(ps, hw_):
+            out = functional_call(stack, pnames, ps, [], [], paddle.Tensor(x))
+            out = out._value if hasattr(out, "_value") else out
+            logits = out[:, 0, :] @ hw_
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+        loss, (gp, gh) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            params, hw)
+        new_p = [p - 1e-4 * g for p, g in zip(params, gp)]
+        return new_p, hw - 1e-4 * gh, loss
+
+    return step, pnames, specs
+
+
+class TestTitanCompiledMemory:
+    def test_stage_executable_fits_budget(self, stage_mesh):
+        """Compile (AOT, no allocation) ONE pp stage at FULL titan geometry
+        under ZeRO-3 x remat; XLA's buffer assignment must fit the chip."""
+        paddle.seed(0)
+        from paddle_tpu.models.ernie import ErnieScanStack
+        # build at tiny dims only to get the pytree STRUCTURE; the lowered
+        # shapes below use the real geometry
+        stack = ErnieScanStack(H, HEADS, FFN, LAYERS // PP, remat=True)
+        step, pnames, specs = _stage_step_fn(stack, None)
+        mesh = stage_mesh
+
+        from paddle_tpu.jit.functional import split_state
+        trainable, _ = split_state(stack)
+        pshapes = [jax.ShapeDtypeStruct(tuple(trainable[n].shape),
+                                        jnp.float32) for n in pnames]
+        in_sh = ([NamedSharding(mesh, specs[n]) for n in pnames],
+                 NamedSharding(mesh, P(None, "sharding")),
+                 NamedSharding(mesh, P(("dp", "sharding"))),
+                 NamedSharding(mesh, P(("dp", "sharding"))))
+        jitted = jax.jit(step, in_shardings=in_sh,
+                         donate_argnums=(0,))
+        B = 8 * MICRO_BATCH   # global batch = micro-batch per chip-group
+        lowered = jitted.lower(
+            pshapes,
+            jax.ShapeDtypeStruct((H, 8), jnp.float32),
+            jax.ShapeDtypeStruct((B, SEQ, H), jnp.float32),
+            jax.ShapeDtypeStruct((B,), jnp.int32))
+        compiled = lowered.compile()
+        ma = compiled.memory_analysis()
+        peak = ma.argument_size_in_bytes + ma.temp_size_in_bytes
+        arith = titan_plan_bytes()
+        # the executable holds params+grads+temps; optimizer slots would be
+        # donated arguments in the full step — compare against the budget
+        # minus the arithmetic slot share
+        budget = 0.85 * V5E_HBM - arith["slots"]
+        assert peak < budget, \
+            f"stage peak {peak / 2**30:.2f} GiB > budget {budget / 2**30:.2f} GiB"
+        # and the compiled param bytes must agree with the arithmetic
+        # (same order of magnitude catches spec/sharding regressions)
+        assert ma.argument_size_in_bytes < 2.0 * (
+            arith["params"] + arith["grads"]) + 64 * 2 ** 20
+
+    def test_stage_step_executes_tiny(self, stage_mesh):
+        """Same sharded program shape, tiny dims: one step must RUN."""
+        paddle.seed(0)
+        from paddle_tpu.models.ernie import ErnieScanStack
+        h, ffn, heads, L = 256, 1024, 4, 12
+        stack = ErnieScanStack(h, heads, ffn, L, remat=True)
+        step, pnames, specs = _stage_step_fn(stack, None)
+        mesh = stage_mesh
+        from paddle_tpu.jit.functional import split_state
+        trainable, _ = split_state(stack)
+        params = [jax.device_put(trainable[n]._value,
+                                 NamedSharding(mesh, specs[n]))
+                  for n in pnames]
+        hw = jax.device_put(
+            jnp.asarray(np.random.randn(h, 8).astype("float32") * 0.02),
+            NamedSharding(mesh, P(None, "sharding")))
+        x = jax.device_put(
+            jnp.asarray(np.random.randn(8, 64, h).astype("float32")),
+            NamedSharding(mesh, P(("dp", "sharding"))))
+        y = jax.device_put(jnp.asarray(np.random.randint(0, 8, (8,))),
+                           NamedSharding(mesh, P(("dp", "sharding"))))
+        jitted = jax.jit(step, in_shardings=(
+            [NamedSharding(mesh, specs[n]) for n in pnames],
+            NamedSharding(mesh, P(None, "sharding")),
+            NamedSharding(mesh, P(("dp", "sharding"))),
+            NamedSharding(mesh, P(("dp", "sharding")))))
+        new_p, new_hw, loss = jitted(params, hw, x, y)
+        assert np.isfinite(float(loss))
+        # ZeRO-3 invariant: each param's per-device shard is 1/4 on the
+        # sharded axis
+        big = max(p.size for p in new_p)
+        for p in new_p:
+            if p.size == big:
+                shard = p.sharding.shard_shape(p.shape)
+                assert int(np.prod(shard)) * 4 == int(np.prod(p.shape)), \
+                    (p.shape, shard)
+                break
+
+
+class TestScanStackParity:
+    def test_matches_unrolled_ernie_layer(self):
+        """One scanned layer == ErnieLayer(dropout=0) with copied weights."""
+        from paddle_tpu.models.ernie import ErnieLayer, ErnieScanStack
+        paddle.seed(0)
+        h, heads, ffn = 64, 4, 128
+        layer = ErnieLayer(h, heads, ffn, dropout=0.0)
+        layer.eval()
+        stack = ErnieScanStack(h, heads, ffn, 1, remat=False)
+
+        def put(p, arr):
+            p._value = jnp.asarray(arr)[None]
+
+        put(stack.qkv_w, layer.attention.qkv.weight.numpy())
+        put(stack.qkv_b, layer.attention.qkv.bias.numpy())
+        put(stack.proj_w, layer.attention.out.weight.numpy())
+        put(stack.proj_b, layer.attention.out.bias.numpy())
+        put(stack.fc1_w, layer.mlp.fc1.weight.numpy())
+        put(stack.fc1_b, layer.mlp.fc1.bias.numpy())
+        put(stack.fc2_w, layer.mlp.fc2.weight.numpy())
+        put(stack.fc2_b, layer.mlp.fc2.bias.numpy())
+        put(stack.ln1_g, layer.norm1.weight.numpy())
+        put(stack.ln1_b, layer.norm1.bias.numpy())
+        put(stack.ln2_g, layer.norm2.weight.numpy())
+        put(stack.ln2_b, layer.norm2.bias.numpy())
+
+        x = paddle.to_tensor(np.random.randn(2, 8, h).astype("float32"))
+        want = layer(x)
+        got = stack(x)
+        np.testing.assert_allclose(got.numpy(), want.numpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_remat_matches_no_remat_gradients(self):
+        from paddle_tpu.models.ernie import ErnieScanStack
+        paddle.seed(3)
+        a = ErnieScanStack(32, 2, 64, 3, remat=True)
+        paddle.seed(3)
+        b = ErnieScanStack(32, 2, 64, 3, remat=False)
+        x = np.random.randn(2, 6, 32).astype("float32")
+        xa = paddle.to_tensor(x, stop_gradient=False)
+        xb = paddle.to_tensor(x, stop_gradient=False)
+        a(xa).sum().backward()
+        b(xb).sum().backward()
+        np.testing.assert_allclose(np.asarray(xa.gradient()),
+                                   np.asarray(xb.gradient()),
+                                   rtol=1e-4, atol=1e-5)
