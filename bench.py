@@ -282,9 +282,12 @@ def bench_flash_attention(backend):
             "seq": s,
             # roofline: at head_dim 64 every qk^T/pv/dq dot leaves half the
             # 128-lane MXU contraction/output dim idle, capping the nominal
-            # MFU ceiling near 0.5 for this head geometry; the kernel runs
-            # at ~45% of that d64 ceiling (device step 7.4ms: fwd 2.0,
-            # dq 2.1, dkv 3.1 per profiler)
+            # MFU ceiling near 0.5 for this head geometry. The backward is
+            # the fused single-pass kernel (p/ds computed once, delta
+            # fused, k/v streamed per block): 1.44x the two-pass backward
+            # at this size; the residual gap to the ceiling is VPU
+            # softmax/exp work on the S^2 elements, which d=64 cannot
+            # amortize over more MXU flops
             "roofline": "d64 halves MXU-> ceiling ~0.5 nominal MFU"}
 
 

@@ -42,15 +42,20 @@ class Generator:
         return self._seed
 
     def next_key(self, n: int = 1):
-        from jax._src import core as _jcore
-        if not _jcore.trace_state_clean():
-            raise TraceKeyError(
-                "Generator.next_key() called inside a jax trace — draw the "
-                "key before tracing (or push a trace key for replay)")
         with self._lock:
-            if self._key is None:
-                self._key = jax.random.key(self._seed)
-            self._key, *keys = jax.random.split(self._key, n + 1)
+            cur = self._key if self._key is not None \
+                else jax.random.key(self._seed)
+            new_key, *keys = jax.random.split(cur, n + 1)
+            if isinstance(new_key, jax.core.Tracer):
+                # a jit trace would capture the split and leak a tracer
+                # into host state (note: nothing is committed before this
+                # raise — a lazily-created key may itself be a tracer);
+                # vjp-linearize replays (recompute) keep concrete keys
+                # concrete and pass through here
+                raise TraceKeyError(
+                    "Generator.next_key() called inside a jax trace — draw "
+                    "the key before tracing (or push a trace key for replay)")
+            self._key = new_key
             self._count += n
         return keys[0] if n == 1 else keys
 

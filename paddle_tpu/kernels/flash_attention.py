@@ -264,6 +264,14 @@ def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
+    bh, s, d = q.shape
+    # VMEM-resident streams: q/do/o + dq out (native dtype) + f32 scratch
+    vmem_est = (4 * q.dtype.itemsize + 4) * s * d + 8 * s
+    if s % block_q == 0 and s % block_k == 0 \
+            and vmem_est < _FUSED_BWD_VMEM_CAP:
+        return _flash_bwd_fused_bhsd(q, k, v, o, lse, g, causal=causal,
+                                     block_q=block_q, block_k=block_k,
+                                     interpret=interpret)
     return _flash_bwd_bhsd(q, k, v, o, lse, g, causal=causal, block_q=block_q,
                            block_k=block_k, interpret=interpret)
 
@@ -539,3 +547,120 @@ def ring_block_dkv(q, k, v, do, lse, delta, offs, *, causal, block_q, block_k,
                    pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))),
         interpret=interpret,
     )(q, k, v, do, lse, delta, offs)
+
+
+# ---- fused single-pass backward ---------------------------------------------
+# The two-kernel backward computes p = exp(s - lse) and ds TWICE (once for
+# dq, once for dk/dv) — 7 tile dots and double the VPU softmax work. This
+# kernel makes ONE pass over the (q-block, k-block) tiles computing all
+# three grads: 5 dots, p/ds once, delta fused in (no XLA prepass streaming
+# dO/O from HBM). Grid is (bh, k-blocks) — sequential on the TensorCore —
+# with k/v/dk/dv streamed per k-block while q/do/o stay VMEM-resident and
+# dq accumulates in persistent f32 scratch across the k-block steps
+# (written out on the last one), keeping the footprint inside the 16 MiB
+# scoped-vmem budget.
+
+def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                         dq_ref, dk_ref, dv_ref, dq_acc, delta_ref, *,
+                         scale, causal, block_q, block_k, seq_len):
+    ki = pl.program_id(1)
+    n_qb = seq_len // block_q
+    n_kb = seq_len // block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        # delta = rowsum(dO * O) per q block, once per bh slice
+        def dstep(qb, _):
+            do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+            o = o_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+            delta_ref[0, pl.ds(qb * block_q, block_q)] = jnp.sum(do * o,
+                                                                 axis=1)
+            dq_acc[pl.ds(qb * block_q, block_q), :] = jnp.zeros(
+                (block_q, q_ref.shape[2]), jnp.float32)
+            return 0
+
+        jax.lax.fori_loop(0, n_qb, dstep, 0)
+
+    k = k_ref[0]
+    v = v_ref[0]
+    qmin = (ki * block_k) // block_q if causal else 0
+
+    def qstep(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        p = jnp.exp(s - lse)                                      # [Bq, Bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        sl = pl.ds(qb * block_q, block_q)
+        dq_acc[sl, :] = dq_acc[sl, :] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k.shape[1]
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qmin, n_qb, qstep, (z, z))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(ki == n_kb - 1)
+    def _write_dq():
+        def wstep(qb, _):
+            sl = pl.ds(qb * block_q, block_q)
+            dq_ref[0, sl, :] = (dq_acc[sl, :] * scale).astype(dq_ref.dtype)
+            return 0
+
+        jax.lax.fori_loop(0, n_qb, wstep, 0)
+
+
+def _flash_bwd_fused_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
+                          interpret):
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    scale = 1.0 / math.sqrt(d)
+    full = lambda b, i: (b, 0, 0)  # noqa: E731
+    return pl.pallas_call(
+        functools.partial(_fa_bwd_fused_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=s),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s, d), full),                      # q
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),   # k
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),   # v
+            pl.BlockSpec((1, s, d), full),                      # do
+            pl.BlockSpec((1, s, d), full),                      # o
+            pl.BlockSpec((1, 1, s), full),                      # lse
+        ],
+        out_specs=(pl.BlockSpec((1, s, d), full),               # dq (last)
+                   pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))),
+        scratch_shapes=[pltpu.VMEM((s, d), jnp.float32),
+                        pltpu.VMEM((1, s), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, o, lse)
+
+
+# resident streams for the fused backward: q/do/o/dq at [S, D] + f32 dq
+# scratch (k/v/dk/dv stream per k-block); stay inside scoped vmem
+_FUSED_BWD_VMEM_CAP = 12 * 2 ** 20

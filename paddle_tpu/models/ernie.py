@@ -277,7 +277,9 @@ class ErnieScanStack(nn.Layer):
         def ln(v, g, b):
             mu = jnp.mean(v, -1, keepdims=True)
             var = jnp.var(v, -1, keepdims=True)
-            return (v - mu) * jax.lax.rsqrt(var + 1e-12) * g + b
+            # eps matches nn.LayerNorm's default so scan-stack and unrolled
+            # ErnieLayer checkpoints are interchangeable
+            return (v - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
 
         qkv = x @ qkv_w + qkv_b
         q, k_, v = jnp.split(qkv, 3, axis=-1)

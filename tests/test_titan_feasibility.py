@@ -84,15 +84,17 @@ def stage_mesh():
     return Mesh(devs, ("dp", "sharding"))
 
 
-def _stage_step_fn(stack, head_w):
+def _stage_step_fn(stack, full_shapes=None):
     """Functional ZeRO-3 train step over the scanned stage (params sharded
-    on 'sharding', batch on dp x sharding)."""
+    on 'sharding', batch on dp x sharding). `full_shapes` overrides the
+    stack's own param shapes for spec computation (AOT at full geometry
+    from a structurally-identical small stack)."""
     from paddle_tpu.jit.functional import functional_call, split_state
     trainable, _ = split_state(stack)
     pnames = list(trainable)
 
-    def spec_for(name, t):
-        shape = tuple(t.shape)
+    def spec_for(shape):
+        shape = tuple(shape)
         # ZeRO-3: stacked titan weights shard their widest non-layer axis
         big = max(range(1, len(shape)), key=lambda i: shape[i]) \
             if len(shape) > 1 else None
@@ -101,7 +103,8 @@ def _stage_step_fn(stack, head_w):
             spec[big] = "sharding"
         return P(*spec)
 
-    specs = {n: spec_for(n, trainable[n]) for n in pnames}
+    shapes = full_shapes or {n: tuple(trainable[n].shape) for n in pnames}
+    specs = {n: spec_for(shapes[n]) for n in pnames}
 
     def step(params, hw, x, y):
         def loss_fn(ps, hw_):
@@ -125,16 +128,19 @@ class TestTitanCompiledMemory:
         under ZeRO-3 x remat; XLA's buffer assignment must fit the chip."""
         paddle.seed(0)
         from paddle_tpu.models.ernie import ErnieScanStack
-        # build at tiny dims only to get the pytree STRUCTURE; the lowered
-        # shapes below use the real geometry
-        stack = ErnieScanStack(H, HEADS, FFN, LAYERS // PP, remat=True)
-        step, pnames, specs = _stage_step_fn(stack, None)
-        mesh = stage_mesh
-
+        # ONE layer at full width gives the pytree structure + num_heads;
+        # the lowered shapes below scale the leading (layer) axis to the
+        # full 12-layer stage, so nothing stage-sized is ever allocated
+        stack = ErnieScanStack(H, HEADS, FFN, 1, remat=True)
         from paddle_tpu.jit.functional import split_state
         trainable, _ = split_state(stack)
-        pshapes = [jax.ShapeDtypeStruct(tuple(trainable[n].shape),
-                                        jnp.float32) for n in pnames]
+        Ls = LAYERS // PP
+        full_shapes = {n: (Ls,) + tuple(trainable[n].shape)[1:]
+                       for n in trainable}
+        step, pnames, specs = _stage_step_fn(stack, full_shapes)
+        mesh = stage_mesh
+        pshapes = [jax.ShapeDtypeStruct(full_shapes[n], jnp.float32)
+                   for n in pnames]
         in_sh = ([NamedSharding(mesh, specs[n]) for n in pnames],
                  NamedSharding(mesh, P(None, "sharding")),
                  NamedSharding(mesh, P(("dp", "sharding"))),
@@ -168,7 +174,7 @@ class TestTitanCompiledMemory:
         from paddle_tpu.models.ernie import ErnieScanStack
         h, ffn, heads, L = 256, 1024, 4, 12
         stack = ErnieScanStack(h, heads, ffn, L, remat=True)
-        step, pnames, specs = _stage_step_fn(stack, None)
+        step, pnames, specs = _stage_step_fn(stack)
         mesh = stage_mesh
         from paddle_tpu.jit.functional import split_state
         trainable, _ = split_state(stack)
