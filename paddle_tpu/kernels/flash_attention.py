@@ -106,6 +106,25 @@ def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
     )(q, k, v)
 
 
+def _bwd_tile_pds(q, k, v, do, lse, delta, *, scale, causal, q0, k0):
+    """Shared per-tile backward math: (p, ds) for a [Bq, D] q/do tile
+    against a [Bk, D] k/v tile with global row/col offsets (q0, k0).
+    Single source of truth for the two-pass AND fused backward kernels —
+    their gradients must agree bit-for-bit regardless of which path
+    _flash_core_bwd's size guard selects."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos <= qpos, s, -1e30)
+    p = jnp.exp(s - lse)                                        # [Bq, Bk]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta)).astype(q.dtype)
+    return p, ds
+
+
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                       *, scale, causal, block_k, seq_len):
     qi = pl.program_id(1)
@@ -123,16 +142,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def body(kb, dq):
         k = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(kpos <= qpos, s, -1e30)
-        p = jnp.exp(s - lse)                                        # [Bq, Bk]
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(q.dtype)
+        _, ds = _bwd_tile_pds(q, k, v, do, lse, delta, scale=scale,
+                              causal=causal, q0=qi * block_q,
+                              k0=kb * block_k)
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -158,19 +170,12 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(kpos <= qpos, s, -1e30)
-        p = jnp.exp(s - lse)                                        # [Bq, Bk]
+        p, ds = _bwd_tile_pds(q, k, v, do, lse, delta, scale=scale,
+                              causal=causal, q0=qb * block_q,
+                              k0=ki * block_k)
         dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
                                       (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -591,18 +596,9 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(kpos <= qpos, s, -1e30)
-        p = jnp.exp(s - lse)                                      # [Bq, Bk]
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(q.dtype)
+        p, ds = _bwd_tile_pds(q, k, v, do, lse, delta, scale=scale,
+                              causal=causal, q0=qb * block_q,
+                              k0=ki * block_k)
         dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
                                       (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -633,8 +629,8 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 def _flash_bwd_fused_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
                           interpret):
     bh, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    # the caller guarantees s divides both block sizes (trip counts bake
+    # the divisibility in) — no clamping here
     scale = 1.0 / math.sqrt(d)
     full = lambda b, i: (b, 0, 0)  # noqa: E731
     return pl.pallas_call(

@@ -1,0 +1,315 @@
+"""Gradient sweep: finite-difference check_grad across the differentiable
+op surface, f32 analytic-vs-numeric plus bf16 analytic-vs-f32-analytic.
+
+Reference parity: `unittests/op_test.py:1649` runs check_grad per op per
+dtype; this sweep is the consolidated TPU-era equivalent (the dispatch
+cache makes per-op eager FD loops cheap).
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad
+
+
+def r(*shape, lo=-1.0, hi=1.0, seed=None):
+    rng = np.random.RandomState(seed if seed is not None else abs(hash(shape)) % 2**31)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype("float32")
+
+
+def distinct(*shape):
+    """Values with well-separated magnitudes (kink/tie-free FD)."""
+    n = int(np.prod(shape))
+    base = np.linspace(-1.0, 1.0, n) + 0.013
+    rng = np.random.RandomState(n)
+    return rng.permutation(base).astype("float32").reshape(shape)
+
+
+# ---- registry ----
+# (id, op, arrays, kwargs, grad_idx)
+UNARY = [
+    ("exp", paddle.exp, [r(2, 3)]),
+    ("log", paddle.log, [r(2, 3, lo=0.5, hi=2.0)]),
+    ("log2", paddle.log2, [r(2, 3, lo=0.5, hi=2.0)]),
+    ("log10", paddle.log10, [r(2, 3, lo=0.5, hi=2.0)]),
+    ("log1p", paddle.log1p, [r(2, 3, lo=-0.4, hi=0.9)]),
+    ("expm1", paddle.expm1, [r(2, 3)]),
+    ("sqrt", paddle.sqrt, [r(2, 3, lo=0.5, hi=2.0)]),
+    ("rsqrt", paddle.rsqrt, [r(2, 3, lo=0.5, hi=2.0)]),
+    ("sin", paddle.sin, [r(2, 3)]),
+    ("cos", paddle.cos, [r(2, 3)]),
+    ("tan", paddle.tan, [r(2, 3, lo=-0.9, hi=0.9)]),
+    ("tanh", paddle.tanh, [r(2, 3)]),
+    ("asin", paddle.asin, [r(2, 3, lo=-0.8, hi=0.8)]),
+    ("acos", paddle.acos, [r(2, 3, lo=-0.8, hi=0.8)]),
+    ("atan", paddle.atan, [r(2, 3)]),
+    ("sinh", paddle.sinh, [r(2, 3)]),
+    ("cosh", paddle.cosh, [r(2, 3)]),
+    ("asinh", paddle.asinh, [r(2, 3)]),
+    ("acosh", paddle.acosh, [r(2, 3, lo=1.5, hi=3.0)]),
+    ("atanh", paddle.atanh, [r(2, 3, lo=-0.8, hi=0.8)]),
+    ("abs", paddle.abs, [distinct(2, 3)]),
+    ("square", paddle.square, [r(2, 3)]),
+    ("reciprocal", paddle.reciprocal, [r(2, 3, lo=0.5, hi=2.0)]),
+    ("erf", paddle.erf, [r(2, 3)]),
+    ("erfinv", paddle.erfinv, [r(2, 3, lo=-0.7, hi=0.7)]),
+    ("lgamma", paddle.lgamma, [r(2, 3, lo=0.6, hi=2.5)]),
+    ("digamma", paddle.digamma, [r(2, 3, lo=0.6, hi=2.5)]),
+    ("logit", paddle.logit, [r(2, 3, lo=0.2, hi=0.8)]),
+]
+
+BINARY = [
+    ("add", paddle.add, [r(2, 3), r(2, 3)]),
+    ("subtract", paddle.subtract, [r(2, 3), r(2, 3)]),
+    ("multiply", paddle.multiply, [r(2, 3), r(2, 3)]),
+    ("divide", paddle.divide, [r(2, 3), r(2, 3, lo=0.5, hi=2.0)]),
+    ("maximum", paddle.maximum, [distinct(2, 3), distinct(3, 2).T.copy()]),
+    ("minimum", paddle.minimum, [distinct(2, 3), distinct(3, 2).T.copy()]),
+    ("fmax", paddle.fmax, [distinct(2, 3), distinct(3, 2).T.copy() + 0.217]),
+    ("fmin", paddle.fmin, [distinct(2, 3), distinct(3, 2).T.copy() + 0.217]),
+    ("atan2", paddle.atan2, [r(2, 3, lo=0.3, hi=1.0), r(2, 3, lo=0.3, hi=1.0)]),
+    ("hypot", paddle.hypot, [r(2, 3, lo=0.3, hi=1.0), r(2, 3, lo=0.3, hi=1.0)])
+    if hasattr(paddle, "hypot") else None,
+    ("lerp", lambda x, y: paddle.lerp(x, y, 0.3), [r(2, 3), r(2, 3)]),
+    ("broadcast_mul", paddle.multiply, [r(2, 3), r(1, 3)]),
+]
+BINARY = [c for c in BINARY if c is not None]
+
+REDUCE = [
+    ("sum", lambda x: paddle.sum(x), [r(2, 3)]),
+    ("sum_axis", lambda x: paddle.sum(x, axis=1), [r(2, 3)]),
+    ("mean", lambda x: paddle.mean(x), [r(2, 3)]),
+    ("mean_axis", lambda x: paddle.mean(x, axis=0, keepdim=True), [r(2, 3)]),
+    ("max", lambda x: paddle.max(x, axis=1), [distinct(2, 4)]),
+    ("min", lambda x: paddle.min(x, axis=0), [distinct(3, 3)]),
+    ("amax", lambda x: paddle.amax(x, axis=1), [distinct(2, 4)]),
+    ("amin", lambda x: paddle.amin(x, axis=1), [distinct(2, 4)]),
+    ("prod", lambda x: paddle.prod(x, axis=1), [r(2, 3, lo=0.5, hi=1.5)]),
+    ("logsumexp", lambda x: paddle.logsumexp(x, axis=1), [r(2, 4)]),
+    ("std", lambda x: paddle.std(x), [r(2, 4)]),
+    ("var", lambda x: paddle.var(x, axis=1), [r(2, 4)]),
+    ("norm2", lambda x: paddle.norm(x), [r(2, 3, lo=0.2, hi=1.0)]),
+    ("norm_p3", lambda x: paddle.norm(x, p=3, axis=1),
+     [r(2, 3, lo=0.2, hi=1.0)]),
+    ("cumsum", lambda x: paddle.cumsum(x, axis=1), [r(2, 3)]),
+    ("cumprod", lambda x: paddle.cumprod(x, dim=1),
+     [r(2, 3, lo=0.5, hi=1.5)]),
+]
+
+LINALG = [
+    ("matmul", paddle.matmul, [r(2, 3), r(3, 4)]),
+    ("matmul_T", lambda a, b: paddle.matmul(a, b, transpose_y=True),
+     [r(2, 3), r(4, 3)]),
+    ("bmm", paddle.bmm, [r(2, 2, 3), r(2, 3, 2)]),
+    ("dot", paddle.dot, [r(4), r(4)]),
+    ("mv", paddle.mv, [r(3, 4), r(4)]),
+    ("outer", paddle.outer, [r(3), r(4)]),
+    ("inner", paddle.inner, [r(2, 3), r(2, 3)]),
+    ("einsum_ij", lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+     [r(2, 3), r(3, 2)]),
+    ("trace", paddle.trace, [r(3, 3)]),
+    ("cross", paddle.cross, [r(2, 3), r(2, 3)]),
+    ("kron", paddle.kron, [r(2, 2), r(2, 2)]),
+    ("dist", paddle.dist, [r(2, 3), r(2, 3, seed=7) + 0.05]),
+    ("addmm", lambda x, a, b: paddle.addmm(x, a, b), [r(2, 2), r(2, 3), r(3, 2)]),
+    ("t_transpose", lambda x: paddle.transpose(x, [1, 0]), [r(2, 3)]),
+]
+
+_idx = np.array([[0, 2], [1, 0]], "int64")
+MANIP = [
+    ("reshape", lambda x: paddle.reshape(x, [3, 2]), [r(2, 3)]),
+    ("concat", lambda a, b: paddle.concat([a, b], axis=1),
+     [r(2, 2), r(2, 3)]),
+    ("stack", lambda a, b: paddle.stack([a, b]), [r(2, 2), r(2, 2)]),
+    ("split", lambda x: paddle.split(x, 2, axis=1)[0], [r(2, 4)]),
+    ("squeeze", lambda x: paddle.squeeze(x, axis=1), [r(2, 1, 3)]),
+    ("unsqueeze", lambda x: paddle.unsqueeze(x, axis=0), [r(2, 3)]),
+    ("tile", lambda x: paddle.tile(x, [2, 1]), [r(2, 3)]),
+    ("flip", lambda x: paddle.flip(x, axis=[1]), [r(2, 3)]),
+    ("roll", lambda x: paddle.roll(x, 1, axis=1), [r(2, 3)]),
+    ("flatten", lambda x: paddle.flatten(x), [r(2, 3)]),
+    ("expand", lambda x: paddle.expand(x, [2, 2, 3]), [r(2, 3)]),
+    ("clip", lambda x: paddle.clip(x, -0.7, 0.7), [distinct(2, 4) * 1.3]),
+    ("tril", paddle.tril, [r(3, 3)]),
+    ("triu", paddle.triu, [r(3, 3)]),
+    ("rot90", lambda x: paddle.rot90(x), [r(2, 3)]),
+    ("diff", lambda x: paddle.diff(x, axis=1), [r(2, 4)]),
+    ("gather", lambda x: paddle.gather(x, paddle.to_tensor(
+        np.array([0, 2], "int64"))), [r(3, 2)]),
+    ("index_select", lambda x: paddle.index_select(x, paddle.to_tensor(
+        np.array([1, 0], "int64")), axis=1), [r(2, 3)]),
+    ("take_along_axis", lambda x: paddle.take_along_axis(
+        x, paddle.to_tensor(_idx), 1), [r(2, 3)]),
+    ("where", lambda x, y: paddle.where(paddle.to_tensor(
+        np.array([[True, False, True], [False, True, False]])), x, y),
+     [r(2, 3), r(2, 3)]),
+    ("masked_select", lambda x: paddle.masked_select(x, paddle.to_tensor(
+        np.array([[True, False], [True, True]]))), [r(2, 2)]),
+    ("pad", lambda x: F.pad(x, [1, 1], value=0.0), [r(2, 3)]),
+]
+
+ACT = [
+    ("relu", F.relu, [distinct(2, 4)]),
+    ("relu6", F.relu6, [distinct(2, 4) * 4]),
+    ("gelu", F.gelu, [r(2, 4)]),
+    ("gelu_tanh", lambda x: F.gelu(x, approximate=True), [r(2, 4)]),
+    ("silu", F.silu, [r(2, 4)]),
+    ("sigmoid", F.sigmoid, [r(2, 4)]),
+    ("log_sigmoid", F.log_sigmoid, [r(2, 4)]),
+    ("softplus", F.softplus, [r(2, 4)]),
+    ("softsign", F.softsign, [r(2, 4)]),
+    ("elu", F.elu, [distinct(2, 4)]),
+    ("celu", F.celu, [distinct(2, 4)]),
+    ("selu", F.selu, [distinct(2, 4)]),
+    ("leaky_relu", F.leaky_relu, [distinct(2, 4)]),
+    ("hardswish", F.hardswish, [r(2, 4, lo=-2.5, hi=2.5) + 0.07]),
+    ("hardsigmoid", F.hardsigmoid, [r(2, 4) * 2 + 0.07]),
+    ("hardtanh", F.hardtanh, [distinct(2, 4) * 1.7]),
+    ("mish", F.mish, [r(2, 4)]),
+    ("tanhshrink", F.tanhshrink, [r(2, 4)]),
+    ("softshrink", F.softshrink, [distinct(2, 4) * 1.9]),
+    ("hardshrink", F.hardshrink, [distinct(2, 4) * 1.9]),
+    ("swish", F.swish, [r(2, 4)]),
+    ("glu", F.glu, [r(2, 4)]),
+    ("softmax", lambda x: F.softmax(x, axis=-1), [r(2, 4)]),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=-1), [r(2, 4)]),
+    ("prelu", F.prelu, [r(2, 4), np.array([0.25], "float32")]),
+    ("normalize", lambda x: F.normalize(x, axis=1),
+     [r(2, 4, lo=0.3, hi=1.0)]),
+    ("cosine_similarity", F.cosine_similarity,
+     [r(2, 4, lo=0.2, hi=1.0), r(2, 4, lo=0.2, hi=1.0)]),
+]
+
+NORM_CONV = [
+    ("layer_norm", lambda x, w, b: F.layer_norm(x, [4], weight=w, bias=b),
+     [r(2, 4), r(4, lo=0.5, hi=1.5), r(4)]),
+    ("group_norm", lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+     [r(2, 4, 3, 3), r(4, lo=0.5, hi=1.5), r(4)]),
+    ("instance_norm", lambda x: F.instance_norm(x), [r(2, 3, 4, 4)]),
+    ("batch_norm_eval", lambda x, w, b: F.batch_norm(
+        x, paddle.to_tensor(np.zeros(3, "float32")),
+        paddle.to_tensor(np.ones(3, "float32")), weight=w, bias=b,
+        training=False), [r(2, 3, 4, 4), r(3, lo=0.5, hi=1.5), r(3)]),
+    ("linear", F.linear, [r(2, 3), r(3, 4), r(4)]),
+    ("conv2d_x", lambda x: F.conv2d(x, paddle.to_tensor(r(3, 2, 3, 3)),
+                                    padding=1), [r(1, 2, 4, 4)], None, [0]),
+    ("conv2d_w", lambda w: F.conv2d(paddle.to_tensor(r(1, 2, 4, 4)), w,
+                                    padding=1), [r(3, 2, 3, 3)], None, [0]),
+    ("conv1d", lambda x: F.conv1d(x, paddle.to_tensor(r(3, 2, 3)),
+                                  padding=1), [r(1, 2, 6)], None, [0]),
+    ("conv2d_transpose", lambda x: F.conv2d_transpose(
+        x, paddle.to_tensor(r(2, 3, 3, 3))), [r(1, 2, 4, 4)], None, [0]),
+    ("avg_pool2d", lambda x: F.avg_pool2d(x, 2), [r(1, 2, 4, 4)]),
+    ("max_pool2d", lambda x: F.max_pool2d(x, 2), [distinct(1, 2, 4, 4)]),
+    ("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 2),
+     [r(1, 2, 4, 4)]),
+    ("interpolate", lambda x: F.interpolate(x, scale_factor=2,
+                                            mode="bilinear"),
+     [r(1, 2, 3, 3)]),
+    ("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2), [r(1, 4, 2, 2)]),
+    ("embedding_w", lambda w: F.embedding(paddle.to_tensor(
+        np.array([[0, 2], [1, 1]], "int64")), w, sparse=False),
+     [r(4, 3)], None, [0]),
+]
+
+_hard_lab = np.array([1, 0], "int64")
+_soft_lab = np.array([[0.2, 0.8], [0.6, 0.4]], "float32")
+LOSS = [
+    ("cross_entropy", lambda x: F.cross_entropy(
+        x, paddle.to_tensor(_hard_lab)), [r(2, 2)], None, [0]),
+    ("cross_entropy_soft", lambda x: F.cross_entropy(
+        x, paddle.to_tensor(_soft_lab), soft_label=True), [r(2, 2)], None, [0]),
+    ("cross_entropy_smooth", lambda x: F.cross_entropy(
+        x, paddle.to_tensor(_hard_lab), label_smoothing=0.1),
+     [r(2, 2)], None, [0]),
+    ("softmax_with_ce", lambda x: F.softmax_with_cross_entropy(
+        x, paddle.to_tensor(_hard_lab[:, None])), [r(2, 3)], None, [0]),
+    ("mse", F.mse_loss, [r(2, 3), r(2, 3)], None, [0]),
+    ("l1", F.l1_loss, [distinct(2, 3), distinct(3, 2).T.copy() + 0.217], None, [0]),
+    ("smooth_l1", F.smooth_l1_loss, [r(2, 3) * 3, r(2, 3)], None, [0]),
+    ("nll", lambda x: F.nll_loss(x, paddle.to_tensor(_hard_lab)),
+     [np.log(r(2, 2, lo=0.2, hi=0.8))], None, [0]),
+    ("bce", lambda x: F.binary_cross_entropy(
+        x, paddle.to_tensor(r(2, 3, lo=0.0, hi=1.0))),
+     [r(2, 3, lo=0.2, hi=0.8)], None, [0]),
+    ("bce_logits", lambda x: F.binary_cross_entropy_with_logits(
+        x, paddle.to_tensor(r(2, 3, lo=0.0, hi=1.0))), [r(2, 3)], None, [0]),
+    ("kl_div", lambda x: F.kl_div(x, paddle.to_tensor(
+        r(2, 3, lo=0.1, hi=0.9))), [np.log(r(2, 3, lo=0.2, hi=0.8))],
+     None, [0]),
+    ("margin_ranking", lambda a, b: F.margin_ranking_loss(
+        a, b, paddle.to_tensor(np.sign(r(2, 3)) + 0.5).sign(), margin=0.1),
+     [r(2, 3), r(2, 3)]),
+    ("hinge_embedding", lambda x: F.hinge_embedding_loss(
+        x, paddle.to_tensor(np.array([[1., -1, 1], [-1, 1, -1]],
+                                     "float32"))), [r(2, 3) + 2.0], None, [0]),
+    ("cosine_embedding", lambda a, b: F.cosine_embedding_loss(
+        a, b, paddle.to_tensor(np.array([1, -1], "float32")), margin=-0.3),
+     [r(2, 4, lo=0.2, hi=1.0), r(2, 4, lo=0.2, hi=1.0)]),
+    ("triplet", F.triplet_margin_loss,
+     [r(2, 4), r(2, 4) + 1.0, r(2, 4) - 1.0]),
+    ("sigmoid_focal", lambda x: F.sigmoid_focal_loss(
+        x, paddle.to_tensor((r(2, 3) > 0).astype("float32"))),
+     [r(2, 3)], None, [0]),
+    ("square_error", F.square_error_cost, [r(2, 3), r(2, 3)], None, [0]),
+    ("ctc", lambda x: F.ctc_loss(
+        x, paddle.to_tensor(np.array([[1, 2]], "int32")),
+        np.array([4], "int64"), np.array([2], "int64")),
+     [r(4, 1, 3)], None, [0]),
+]
+
+
+def _norm_case(case):
+    name, op, arrs = case[0], case[1], case[2]
+    kw = case[3] if len(case) > 3 else None
+    gi = case[4] if len(case) > 4 else None
+    return name, op, arrs, kw, gi
+
+
+ALL = [_norm_case(c) for c in
+       UNARY + BINARY + REDUCE + LINALG + MANIP + ACT + NORM_CONV + LOSS]
+
+
+@pytest.mark.parametrize("name,op,arrs,kw,gi", ALL, ids=[c[0] for c in ALL])
+def test_grad_f32(name, op, arrs, kw, gi):
+    check_grad(op, arrs, kwargs=kw, grad_idx=gi)
+
+
+# ---- bf16: analytic grads must track the f32 analytic grads ----
+BF16_IDS = {
+    "exp", "log", "sqrt", "tanh", "sigmoid", "abs", "square", "sin", "cos",
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "sum", "mean", "max", "logsumexp", "cumsum",
+    "matmul", "bmm", "einsum_ij", "outer",
+    "reshape", "concat", "stack", "tile", "where", "gather", "pad",
+    "relu", "gelu", "silu", "softplus", "leaky_relu", "softmax",
+    "log_softmax", "glu", "normalize",
+    "linear", "layer_norm", "avg_pool2d", "max_pool2d",
+    "cross_entropy", "mse", "bce_logits", "smooth_l1", "sigmoid_focal",
+}
+BF16 = [c for c in ALL if c[0] in BF16_IDS]
+
+
+@pytest.mark.parametrize("name,op,arrs,kw,gi", BF16,
+                         ids=[c[0] for c in BF16])
+def test_grad_bf16_tracks_f32(name, op, arrs, kw, gi):
+    kw = kw or {}
+    gi = gi if gi is not None else range(len(arrs))
+
+    def grads(dtype):
+        ts = [paddle.to_tensor(a.astype("float32"), dtype=dtype,
+                               stop_gradient=False) for a in arrs]
+        out = op(*ts, **kw)
+        out = out[0] if isinstance(out, (tuple, list)) else out
+        out.astype("float32").sum().backward()
+        return [np.asarray(ts[i].gradient(), dtype=np.float32) for i in gi]
+
+    g32 = grads("float32")
+    g16 = grads("bfloat16")
+    for a, b in zip(g16, g32):
+        scale = max(np.abs(b).max(), 1e-3)
+        np.testing.assert_allclose(a / scale, b / scale, atol=0.06,
+                                   err_msg=f"bf16 grad diverges for {name}")
