@@ -10,7 +10,8 @@ sockets (the C++ TCPStore's wire style) — request header `cmd table n dim`
 starts with a one-byte status; errors carry a message frame so server-side
 failures (unknown table, barrier timeout) surface to the caller instead of
 tearing the connection down. Sparse tables shard across servers by
-`id % n_servers`; dense tables live on server 0. Shard RPCs are issued
+`id % n_servers`; dense tables are row-range sharded across all
+servers. Shard RPCs are issued
 send-first-then-receive so a pull touches all servers in ~one RTT (the
 brpc client's concurrent-request role).
 """
@@ -189,7 +190,13 @@ class PsServer:
                         conn.sendall(_ST_OK)
                     elif cmd == CMD_PULL_DENSE:
                         w = tbl.pull().astype(np.float32)
-                        conn.sendall(_ST_OK + _LEN.pack(w.size) + w.tobytes())
+                        lo, _hi = getattr(tbl, "shard_range", (0, w.size))
+                        total = getattr(tbl, "total_size", w.size)
+                        # slice + (offset, total) so the client can verify
+                        # the shards tile exactly one table
+                        conn.sendall(_ST_OK + _LEN.pack(w.size)
+                                     + _LEN.pack(lo) + _LEN.pack(total)
+                                     + w.tobytes())
                     elif cmd == CMD_PUSH_DENSE:
                         tbl.push(grads.reshape(tbl.w.shape))
                         conn.sendall(_ST_OK)
@@ -214,7 +221,8 @@ class PsServer:
 
 class PsClient:
     """Sharded client (brpc_ps_client role): sparse ids route to server
-    `id % n_servers`; dense tables live on server 0. Transport errors
+    `id % n_servers`; dense tables are row-range sharded across all
+    servers (pull concatenates, push scatters). Transport errors
     invalidate the cached connection so the next call reconnects."""
 
     def __init__(self, endpoints: Sequence[str]):
@@ -222,6 +230,7 @@ class PsClient:
         self._socks: List[Optional[socket.socket]] = [None] * len(endpoints)
         self._locks = [threading.Lock() for _ in endpoints]
         self._dims: Dict[str, int] = {}  # table -> row dim (accessor config)
+        self._dense_sizes: Dict[str, list] = {}  # table -> per-server sizes
 
     def _sock(self, i):
         if self._socks[i] is None:
@@ -334,30 +343,82 @@ class PsClient:
                 self._locks[s].release()
 
     # -- dense --
+    # Dense tables are row-range sharded across ALL servers (reference
+    # `common_dense_table.cc`): pull fans one request per server and
+    # concatenates the slices; push scatters the grad by the same ranges.
+    # Slice sizes are learned on the first pull (each response carries its
+    # size) and cached for pushes.
+
     def pull_dense(self, table: str) -> np.ndarray:
-        with self._locks[0]:
-            try:
-                sk = self._sock(0)
-                sk.sendall(_HDR.pack(CMD_PULL_DENSE, _tname(table), 0, 0))
-                _check_status(sk)
+        n_srv = len(self.endpoints)
+        shards = [(s, None) for s in range(n_srv)]
+        parts: list = [None] * n_srv
+        for s, _ in shards:
+            self._locks[s].acquire()
+        try:
+            self._send_all(shards, lambda s, sel: _HDR.pack(
+                CMD_PULL_DENSE, _tname(table), 0, 0))
+
+            metas: list = [None] * n_srv
+
+            def recv_slice(s, sel, sk):
                 (size,) = _LEN.unpack(_recv_exact(sk, 8))
-                return np.frombuffer(_recv_exact(sk, 4 * size),
-                                     np.float32).copy()
-            except OSError:
-                self._drop(0)
-                raise
+                (lo,) = _LEN.unpack(_recv_exact(sk, 8))
+                (total,) = _LEN.unpack(_recv_exact(sk, 8))
+                metas[s] = (lo, size, total)
+                parts[s] = np.frombuffer(_recv_exact(sk, 4 * size),
+                                         np.float32).copy()
+
+            self._recv_all(shards, recv_slice)
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
+        # the per-server slices must tile [0, total) exactly — this catches
+        # tables registered unsharded on several servers (duplicate full
+        # copies) or with inconsistent shard specs
+        total = metas[0][2]
+        ordered = sorted(range(n_srv), key=lambda s: metas[s][0])
+        cursor = 0
+        for s in ordered:
+            lo, size, tot = metas[s]
+            if tot != total or lo != cursor:
+                raise PsError(
+                    f"pull_dense('{table}'): server shards do not tile the "
+                    f"table (server {s} reports offset {lo} size {size} "
+                    f"total {tot}; expected offset {cursor} total {total}) "
+                    "— register with shard=(i, n_servers) on every server")
+            cursor += size
+        if cursor != total:
+            raise PsError(
+                f"pull_dense('{table}'): shards cover {cursor} of {total} "
+                "elements")
+        self._dense_sizes[table] = [(metas[s][0], metas[s][1])
+                                    for s in range(n_srv)]
+        return np.concatenate([parts[s] for s in ordered])
 
     def push_dense(self, table: str, grad):
         g = np.asarray(grad, np.float32).reshape(-1)
-        with self._locks[0]:
-            try:
-                sk = self._sock(0)
-                sk.sendall(_HDR.pack(CMD_PUSH_DENSE, _tname(table), g.size, 0)
-                           + g.tobytes())
-                _check_status(sk)
-            except OSError:
-                self._drop(0)
-                raise
+        ranges = self._dense_sizes.get(table)
+        if ranges is None:
+            self.pull_dense(table)  # learn (and validate) the shard split
+            ranges = self._dense_sizes[table]
+        total = sum(size for _, size in ranges)
+        if total != g.size:
+            raise PsError(
+                f"push_dense('{table}'): grad size {g.size} != table size "
+                f"{total}")
+        shards = [(s, (lo, lo + size))
+                  for s, (lo, size) in enumerate(ranges) if size]
+        for s, _ in shards:
+            self._locks[s].acquire()
+        try:
+            self._send_all(shards, lambda s, sel: (
+                _HDR.pack(CMD_PUSH_DENSE, _tname(table), sel[1] - sel[0], 0)
+                + g[sel[0]:sel[1]].tobytes()))
+            self._recv_all(shards, None)
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
 
     def barrier(self, n_trainers: int = 1):
         """Block until `n_trainers` clients reach this point (coordinated by

@@ -111,16 +111,45 @@ class CtrAccessor:
                 or stat["unseen_days"] > self.ttl_days)
 
 
-class DenseTable:
-    """Fixed-shape dense parameter block (common_dense_table role)."""
+def dense_shard_range(total: int, shard: int, n_shards: int):
+    """Contiguous row-range partition of a flat dense block (reference
+    `ps/table/common_dense_table.cc` fixed_len split): shard s holds
+    [start, end) with the remainder spread over the leading shards."""
+    base, rem = divmod(int(total), int(n_shards))
+    start = shard * base + min(shard, rem)
+    return start, start + base + (1 if shard < rem else 0)
 
-    def __init__(self, shape, optimizer="sgd", lr=0.01, initializer=None):
+
+class DenseTable:
+    """Fixed-shape dense parameter block (common_dense_table role). With
+    `shard=(i, n)` the table holds only its contiguous row-range slice of
+    the flattened block — the reference distributes dense params across
+    servers the same way (`common_dense_table.cc`), removing the
+    server-0 bandwidth/memory pinch point."""
+
+    def __init__(self, shape, optimizer="sgd", lr=0.01, initializer=None,
+                 shard=None):
         self._lock = threading.Lock()
-        rng = np.random.default_rng(0)
-        if initializer == "zeros" or initializer is None:
-            self.w = np.zeros(shape, np.float32)
+        total = int(np.prod(shape))
+        self.total_size = total
+        if shard is not None:
+            i, n = shard
+            if not 0 <= i < n:
+                raise ValueError(f"dense shard index {i} out of range for "
+                                 f"{n} shards")
+            lo, hi = dense_shard_range(total, i, n)
+            myshape: tuple = (hi - lo,)
+            self.shard_range = (lo, hi)
         else:
-            self.w = rng.normal(0, 0.01, shape).astype(np.float32)
+            myshape = tuple(shape)
+            self.shard_range = (0, total)
+        if initializer == "zeros" or initializer is None:
+            self.w = np.zeros(myshape, np.float32)
+        else:
+            # seed by the global offset so different shards draw
+            # decorrelated streams
+            rng = np.random.default_rng(self.shard_range[0])
+            self.w = rng.normal(0, 0.01, myshape).astype(np.float32)
         self._rule = _RULES[optimizer](lr=lr)
         self._slots = self._rule.slots(self.w.shape)
 

@@ -629,8 +629,8 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 def _flash_bwd_fused_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
                           interpret):
     bh, s, d = q.shape
-    # the caller guarantees s divides both block sizes (trip counts bake
-    # the divisibility in) — no clamping here
+    # the caller guarantees block_q and block_k divide s (the kernel's
+    # trip counts bake the divisibility in) — no clamping here
     scale = 1.0 / math.sqrt(d)
     full = lambda b, i: (b, 0, 0)  # noqa: E731
     return pl.pallas_call(

@@ -69,10 +69,10 @@ class TestTables:
 @pytest.fixture()
 def cluster():
     servers = [PsServer() for _ in range(2)]
-    for s in servers:
+    for i, s in enumerate(servers):
         s.add_sparse_table("emb", dim=4, lr=0.5)
+        s.add_dense_table("fc", (4, 2), lr=0.5, shard=(i, len(servers)))
         s.run()
-    servers[0].add_dense_table("fc", (4, 2), lr=0.5)
     client = PsClient([f"{s.host}:{s.port}" for s in servers])
     client.register_sparse_dim("emb", 4)
     yield servers, client
@@ -100,6 +100,44 @@ class TestService:
         client.push_dense("fc", np.ones(8, np.float32))
         np.testing.assert_allclose(client.pull_dense("fc"), w - 0.5,
                                    rtol=1e-6)
+
+    def test_dense_sharded_across_servers(self, cluster):
+        # reference common_dense_table.cc row-range split: BOTH servers
+        # hold a contiguous slice, and the client reassembles them in order
+        servers, client = cluster
+        t0, t1 = servers[0].table("fc"), servers[1].table("fc")
+        assert t0.w.size == 4 and t1.w.size == 4       # 8 elems split 2-way
+        assert t0.shard_range == (0, 4) and t1.shard_range == (4, 8)
+        t0.set(np.arange(4, dtype=np.float32))
+        t1.set(np.arange(4, 8, dtype=np.float32))
+        np.testing.assert_allclose(client.pull_dense("fc"), np.arange(8))
+        # a push updates each slice on its own server
+        g = np.zeros(8, np.float32)
+        g[5] = 2.0                                     # lands on server 1
+        client.push_dense("fc", g)
+        np.testing.assert_allclose(servers[0].table("fc").w, np.arange(4))
+        got = servers[1].table("fc").w
+        np.testing.assert_allclose(got, [4.0, 4.0, 6.0, 7.0])  # 5 - 0.5*2
+
+    def test_dense_uneven_split(self):
+        # 3 servers, 8 elems -> 3/3/2
+        servers = [PsServer() for _ in range(3)]
+        for i, s in enumerate(servers):
+            s.add_dense_table("d", (8,), lr=1.0, shard=(i, 3))
+            s.run()
+        client = PsClient([f"{s.host}:{s.port}" for s in servers])
+        try:
+            assert [servers[i].table("d").w.size for i in range(3)] == [3, 3, 2]
+            w = client.pull_dense("d")
+            assert w.size == 8
+            client.push_dense("d", np.ones(8, np.float32))
+            np.testing.assert_allclose(client.pull_dense("d"), w - 1.0)
+            with pytest.raises(Exception):
+                client.push_dense("d", np.ones(5, np.float32))  # size guard
+        finally:
+            client.close()
+            for s in servers:
+                s.stop()
 
     def test_communicator_async(self, cluster):
         servers, client = cluster
@@ -441,3 +479,26 @@ class TestSSDCtrInterplay:
             SparseTable(dim=2, init_st=0.5)   # typo'd kwarg
         with pytest.raises(TypeError, match="accessor"):
             SparseTable(dim=2, accessor="ctrr")
+
+
+class TestDenseShardValidation:
+    def test_duplicate_unsharded_registration_detected(self):
+        # pre-sharding registration pattern (full copy on every server)
+        # must fail loudly, not silently return doubled parameters
+        servers = [PsServer() for _ in range(2)]
+        for s in servers:
+            s.add_dense_table("d", (4,), lr=1.0)   # shard=None on BOTH
+            s.run()
+        client = PsClient([f"{s.host}:{s.port}" for s in servers])
+        try:
+            with pytest.raises(Exception, match="tile"):
+                client.pull_dense("d")
+        finally:
+            client.close()
+            for s in servers:
+                s.stop()
+
+    def test_bad_shard_index_raises(self):
+        from paddle_tpu.distributed.ps.table import DenseTable
+        with pytest.raises(ValueError, match="out of range"):
+            DenseTable((8,), shard=(2, 2))
