@@ -1,0 +1,183 @@
+"""paddle.signal: frame / overlap_add / stft / istft.
+
+Reference parity: `python/paddle/signal.py` (frame :32, overlap_add :153,
+stft :236, istft :390). TPU-first: framing is a gather over precomputed
+window indices and the DFT rides `jnp.fft` (XLA-lowered), so an stft is
+two fused device ops instead of the reference's frame_op + fft_c2r CUDA
+kernels; istft's overlap-add is one scatter-add.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .ops._dispatch import ensure_tensor, run_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_idx(seq_len, frame_length, hop_length):
+    n_frames = 1 + (seq_len - frame_length) // hop_length
+    return (np.arange(frame_length)[:, None]
+            + hop_length * np.arange(n_frames)[None, :])   # [L, T]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames: [..., seq] -> [..., L, T] (axis=-1)
+    or [seq, ...] -> [T, L, ...] (axis=0)."""
+    x = ensure_tensor(x)
+    if axis not in (0, -1):
+        raise ValueError(f"frame: axis must be 0 or -1, got {axis}")
+    if hop_length <= 0:
+        raise ValueError(f"hop_length should be > 0, but got {hop_length}")
+    seq = x.shape[-1] if axis == -1 else x.shape[0]
+    if not 0 < frame_length <= seq:
+        raise ValueError(
+            f"frame_length should be in (0, {seq}], got {frame_length}")
+    idx = _frame_idx(seq, frame_length, hop_length)
+
+    if axis == -1:
+        return run_op(lambda a: a[..., idx], [x], "frame")
+    return run_op(lambda a: a[idx.T], [x], "frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., L, T] -> [..., seq] (axis=-1) or
+    [T, L, ...] -> [seq, ...] (axis=0); overlaps sum."""
+    x = ensure_tensor(x)
+    if axis not in (0, -1):
+        raise ValueError(f"overlap_add: axis must be 0 or -1, got {axis}")
+    if hop_length <= 0:
+        raise ValueError(f"hop_length should be > 0, but got {hop_length}")
+    if axis == -1:
+        L, T = x.shape[-2], x.shape[-1]
+    else:
+        T, L = x.shape[0], x.shape[1]
+    seq = (T - 1) * hop_length + L
+    idx = _frame_idx(seq, L, hop_length)  # [L, T]
+
+    def f(a):
+        if axis == -1:
+            out = jnp.zeros(tuple(a.shape[:-2]) + (seq,), a.dtype)
+            return out.at[..., idx].add(a)
+        out = jnp.zeros((seq,) + tuple(a.shape[2:]), a.dtype)
+        return out.at[idx.T].add(a)
+
+    return run_op(f, [x], "overlap_add")
+
+
+def _resolve_window(window, win_length, n_fft, dtype):
+    if win_length > n_fft:
+        raise ValueError(
+            f"win_length ({win_length}) should not be greater than n_fft "
+            f"({n_fft})")
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    else:
+        w = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+        if w.shape[0] != win_length:
+            raise ValueError(
+                f"window length {w.shape[0]} != win_length {win_length}")
+    if win_length < n_fft:  # center-pad to n_fft
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """STFT of a [..., seq] real or complex signal -> complex
+    [..., n_fft//2 + 1, T] (onesided) / [..., n_fft, T]."""
+    x = ensure_tensor(x)
+    if x.ndim not in (1, 2):
+        raise ValueError(f"stft: x must be 1D or 2D, got rank {x.ndim}")
+    hop_length = hop_length if hop_length is not None else n_fft // 4
+    win_length = win_length if win_length is not None else n_fft
+    if hop_length <= 0:
+        raise ValueError(f"hop_length should be > 0, but got {hop_length}")
+    is_complex = jnp.issubdtype(x._value.dtype, jnp.complexfloating)
+    if is_complex and onesided:
+        raise ValueError("stft: onesided is not supported for complex input")
+    wdt = jnp.float64 if x._value.dtype in (jnp.float64, jnp.complex128) \
+        else jnp.float32
+    w = _resolve_window(window, win_length, n_fft, wdt)
+
+    def f(a):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, ((0, 0), (pad, pad)),
+                        mode={"reflect": "reflect", "constant": "constant",
+                              "replicate": "edge"}.get(pad_mode, pad_mode))
+        idx = _frame_idx(a.shape[-1], n_fft, hop_length)      # [N, T]
+        frames = a[..., idx] * w[None, :, None].astype(a.dtype)  # [B, N, T]
+        if onesided:
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, wdt))
+        return spec[0] if squeeze else spec
+
+    return run_op(f, [x], "stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Least-squares inverse STFT of [..., n_bins, T] -> [..., seq]."""
+    x = ensure_tensor(x)
+    if x.ndim not in (2, 3):
+        raise ValueError(f"istft: x must be 2D or 3D, got rank {x.ndim}")
+    hop_length = hop_length if hop_length is not None else n_fft // 4
+    win_length = win_length if win_length is not None else n_fft
+    if onesided and return_complex:
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False (a onesided "
+            "spectrum reconstructs a real signal)")
+    n_bins = x.shape[-2]
+    want = n_fft // 2 + 1 if onesided else n_fft
+    if n_bins != want:
+        raise ValueError(
+            f"istft: expected {want} frequency bins, got {n_bins}")
+    wdt = jnp.float64 if x._value.dtype == jnp.complex128 else jnp.float32
+    w = _resolve_window(window, win_length, n_fft, wdt)
+
+    def f(a):
+        squeeze = a.ndim == 2
+        if squeeze:
+            a = a[None]
+        if normalized:
+            a = a * jnp.sqrt(jnp.asarray(n_fft, wdt))
+        if onesided:
+            fr = jnp.fft.irfft(a, n=n_fft, axis=-2)            # [B, N, T]
+        else:
+            fr = jnp.fft.ifft(a, n=n_fft, axis=-2)
+            if not return_complex:
+                fr = fr.real
+        fr = fr * w[None, :, None].astype(fr.dtype)
+        T = fr.shape[-1]
+        seq = (T - 1) * hop_length + n_fft
+        idx = _frame_idx(seq, n_fft, hop_length)
+        out = jnp.zeros(fr.shape[:-2] + (seq,), fr.dtype).at[..., idx].add(fr)
+        # NOLA normalization: divide by the summed squared window
+        wsq = (w.astype(wdt) ** 2)[:, None] * jnp.ones((1, T), wdt)
+        den = jnp.zeros((seq,), wdt).at[idx].add(wsq)
+        out = out / jnp.maximum(den, 1e-11).astype(out.dtype)
+        if center:
+            out = out[..., n_fft // 2: seq - n_fft // 2]
+        if length is not None:
+            if out.shape[-1] < length:   # samples past the last full frame
+                out = jnp.pad(out, [(0, 0)] * (out.ndim - 1)
+                              + [(0, length - out.shape[-1])])
+            else:
+                out = out[..., :length]
+        return out[0] if squeeze else out
+
+    return run_op(f, [x], "istft")

@@ -1,9 +1,15 @@
-"""paddle.sparse parity (COO/CSR tensors).
+"""paddle.sparse parity: COO/CSR tensors + elementwise/unary/spmm ops.
 
-Reference parity: `phi/core/sparse_coo_tensor.h` / `sparse_csr_tensor.h` +
-`python/paddle/sparse`. TPU note: XLA has no native sparse kernels; COO ops
-lower to scatter/gather (same as the reference's GPU fallbacks for most ops).
-Backed by `jax.experimental.sparse.BCOO` where available.
+Reference parity: `phi/core/sparse_coo_tensor.h` / `sparse_csr_tensor.h`,
+kernels under `paddle/phi/kernels/sparse/` (elementwise, matmul, unary,
+mask), python surface `python/paddle/sparse` (later tree; the 2022
+snapshot ships `paddle.incubate.sparse` with the same ops).
+
+TPU-first: XLA has no native sparse kernels, so values ride as dense
+[nnz] / [nnz, ...] arrays with host-resident index metadata, ops lower to
+gather/segment-scatter (the reference's own GPU fallback strategy), and
+every op routes values through the autograd tape — gradients flow to the
+values (and the dense operand of spmm) like any dense op.
 """
 from __future__ import annotations
 
@@ -11,36 +17,331 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..ops._dispatch import ensure_tensor, run_op
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "to_dense", "add", "subtract", "multiply",
+    "divide", "matmul", "masked_matmul", "relu", "abs", "sin", "tanh",
+    "sqrt", "square", "pow", "neg", "cast", "coalesce", "is_same_shape",
+    "transpose",
+]
 
 
 class SparseCooTensor:
+    """COO: `indices` [sparse_dims, nnz] (host int64) + `values` Tensor."""
+
     def __init__(self, indices, values, shape):
-        self.indices = indices if isinstance(indices, Tensor) else Tensor(jnp.asarray(indices))
-        self.values = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+        ind = indices.numpy() if isinstance(indices, Tensor) else indices
+        self.indices = np.asarray(ind, np.int64)
+        self.values = values if isinstance(values, Tensor) \
+            else Tensor(jnp.asarray(values))
         self.shape = list(shape)
 
+    # -- introspection --
+    def nnz(self):
+        return self.values.shape[0]
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def numpy(self):
+        return np.asarray(self.to_dense().numpy())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # -- conversion --
     def to_dense(self):
-        dense = jnp.zeros(self.shape, dtype=self.values._value.dtype)
-        idx = tuple(self.indices._value[i] for i in range(self.indices._value.shape[0]))
-        return Tensor(dense.at[idx].add(self.values._value))
+        idx = tuple(self.indices[i] for i in range(self.indices.shape[0]))
+        shape = tuple(self.shape)
+        return run_op(
+            lambda v: jnp.zeros(shape, v.dtype).at[idx].add(v),
+            [self.values], "coo_to_dense")
+
+    def to_sparse_csr(self):
+        if self.indices.shape[0] != 2:
+            raise ValueError("to_sparse_csr requires a 2D COO tensor")
+        coo = self.coalesce()
+        rows, cols = coo.indices
+        crows = np.zeros(coo.shape[0] + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(crows, cols, coo.values, coo.shape)
+
+    def coalesce(self):
+        """Sort indices lexicographically and sum duplicates."""
+        sdims = self.indices.shape[0]
+        dims = tuple(self.shape[:sdims])
+        flat = np.ravel_multi_index(tuple(self.indices), dims)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        new_idx = np.stack(np.unravel_index(uniq, dims))
+        inv_j = jnp.asarray(inv)
+        n_out = len(uniq)
+        vals = run_op(
+            lambda v: jnp.zeros((n_out,) + v.shape[1:], v.dtype)
+            .at[inv_j].add(v), [self.values], "coo_coalesce")
+        return SparseCooTensor(new_idx, vals, self.shape)
+
+    # -- operators --
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def T(self):
+        return transpose(self, list(range(len(self.shape)))[::-1])
+
+
+class SparseCsrTensor:
+    """CSR: `crows` [rows+1], `cols` [nnz] (host int64) + `values` Tensor."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = np.asarray(
+            crows.numpy() if isinstance(crows, Tensor) else crows, np.int64)
+        self.cols = np.asarray(
+            cols.numpy() if isinstance(cols, Tensor) else cols, np.int64)
+        self.values = values if isinstance(values, Tensor) \
+            else Tensor(jnp.asarray(values))
+        self.shape = list(shape)
 
     def nnz(self):
-        return self.values._value.shape[0]
+        return self.values.shape[0]
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def _rows(self):
+        return np.repeat(np.arange(len(self.crows) - 1, dtype=np.int64),
+                         np.diff(self.crows))
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(np.stack([self._rows(), self.cols]),
+                               self.values, self.shape)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(self.to_dense().numpy())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
 
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
-    return SparseCooTensor(indices, values, shape)
+    if shape is None:  # infer dims from the index extents (reference API)
+        ind = np.asarray(
+            indices.numpy() if isinstance(indices, Tensor) else indices,
+            np.int64)
+        shape = list(ind.max(axis=1) + 1)
+    t = SparseCooTensor(indices, values, shape)
+    if dtype is not None:
+        t = cast(t, value_dtype=dtype)
+    t.values.stop_gradient = stop_gradient
+    return t
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
-    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
-    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-    indices = np.stack([rows, cols])
-    return SparseCooTensor(indices, values, shape)
+    t = SparseCsrTensor(crows, cols, values, shape)
+    if dtype is not None:
+        t = SparseCsrTensor(t.crows, t.cols, cast_values(t.values, dtype),
+                            t.shape)
+    t.values.stop_gradient = stop_gradient
+    return t
 
 
 def to_dense(x):
     return x.to_dense()
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _as_coo(x):
+    return x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+
+
+def _same_pattern(a, b):
+    return a.indices.shape == b.indices.shape \
+        and np.array_equal(a.indices, b.indices)
+
+
+def _maybe_coalesce(x):
+    sdims = x.indices.shape[0]
+    flat = np.ravel_multi_index(tuple(x.indices), tuple(x.shape[:sdims]))
+    return x.coalesce() if len(np.unique(flat)) < len(flat) else x
+
+
+def _ewise(a, b, fn, name):
+    """Sparse(+)sparse elementwise; result sparsity = union of patterns."""
+    was_csr = isinstance(a, SparseCsrTensor)
+    a, b = _as_coo(a), _as_coo(b)
+    if list(a.shape) != list(b.shape):
+        raise ValueError(f"sparse {name}: shape mismatch {a.shape} vs "
+                         f"{b.shape}")
+    # duplicate indices would be dropped by the union scatter (and have
+    # ill-defined semantics for multiply/divide): coalesce first
+    a, b = _maybe_coalesce(a), _maybe_coalesce(b)
+    if _same_pattern(a, b):
+        vals = run_op(fn, [a.values, b.values], f"sparse_{name}")
+        out = SparseCooTensor(a.indices, vals, a.shape)
+        return out.to_sparse_csr() if was_csr else out
+    # union of patterns: scatter both into the union index set
+    sdims = a.indices.shape[0]
+    dims = tuple(a.shape[:sdims])
+    fa = np.ravel_multi_index(tuple(a.indices), dims)
+    fb = np.ravel_multi_index(tuple(b.indices), dims)
+    uniq = np.union1d(fa, fb)
+    pa = jnp.asarray(np.searchsorted(uniq, fa))
+    pb = jnp.asarray(np.searchsorted(uniq, fb))
+    n = len(uniq)
+
+    def f(va, vb):
+        ua = jnp.zeros((n,) + va.shape[1:], va.dtype).at[pa].set(va)
+        ub = jnp.zeros((n,) + vb.shape[1:], vb.dtype).at[pb].set(vb)
+        return fn(ua, ub)
+
+    vals = run_op(f, [a.values, b.values], f"sparse_{name}")
+    out = SparseCooTensor(np.stack(np.unravel_index(uniq, dims)), vals,
+                          a.shape)
+    return out.to_sparse_csr() if was_csr else out
+
+
+def add(a, b):
+    return _ewise(a, b, lambda x, y: x + y, "add")
+
+
+def subtract(a, b):
+    return _ewise(a, b, lambda x, y: x - y, "subtract")
+
+
+def multiply(a, b):
+    return _ewise(a, b, lambda x, y: x * y, "multiply")
+
+
+def divide(a, b):
+    return _ewise(a, b, lambda x, y: x / y, "divide")
+
+
+def matmul(a, dense):
+    """Sparse [M, K] @ dense [K, N] -> dense Tensor [M, N] (spmm).
+
+    Reference: `paddle/phi/kernels/sparse/` matmul (cusparse SpMM role).
+    Lowered to gather + segment scatter-add; differentiable w.r.t. BOTH
+    the sparse values and the dense operand.
+    """
+    a = _as_coo(a)
+    dense = ensure_tensor(dense)
+    if len(a.shape) != 2 or a.indices.shape[0] != 2:
+        raise ValueError("sparse.matmul supports 2D sparse @ 2D dense")
+    rows = jnp.asarray(a.indices[0])
+    cols = jnp.asarray(a.indices[1])
+    M = a.shape[0]
+
+    def f(vals, d):
+        contrib = vals[:, None] * d[cols]            # [nnz, N]
+        return jnp.zeros((M, d.shape[1]), contrib.dtype).at[rows].add(contrib)
+
+    return run_op(f, [a.values, dense], "sparse_matmul")
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense evaluated ONLY at mask's sparsity pattern ->
+    SparseCooTensor (the reference's SDDMM-style masked matmul)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    mask = _as_coo(mask)
+    rows = jnp.asarray(mask.indices[0])
+    cols = jnp.asarray(mask.indices[1])
+
+    def f(a, b):
+        return jnp.sum(a[rows] * b[:, cols].T, axis=-1)   # [nnz]
+
+    vals = run_op(f, [x, y], "sparse_masked_matmul")
+    return SparseCooTensor(mask.indices, vals, mask.shape)
+
+
+def _unary(fn, name):
+    def op(x):
+        was_csr = isinstance(x, SparseCsrTensor)
+        coo = _as_coo(x)
+        vals = run_op(fn, [coo.values], f"sparse_{name}")
+        out = SparseCooTensor(coo.indices, vals, coo.shape)
+        return out.to_sparse_csr() if was_csr else out
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0), "relu")
+abs = _unary(jnp.abs, "abs")  # noqa: A001
+sin = _unary(jnp.sin, "sin")
+tanh = _unary(jnp.tanh, "tanh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+neg = _unary(jnp.negative, "neg")
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor), "pow")(x)
+
+
+def cast_values(values, dtype):
+    from ..core.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    return run_op(lambda v: v.astype(dt), [ensure_tensor(values)],
+                  "sparse_cast")
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    was_csr = isinstance(x, SparseCsrTensor)
+    coo = _as_coo(x)
+    vals = cast_values(coo.values, value_dtype) if value_dtype else coo.values
+    out = SparseCooTensor(coo.indices, vals, coo.shape)
+    if was_csr:
+        out = out.to_sparse_csr()
+        if index_dtype is not None:
+            out.crows = out.crows.astype(np.dtype(index_dtype))
+            out.cols = out.cols.astype(np.dtype(index_dtype))
+    elif index_dtype is not None:
+        # set after construction: __init__ normalizes to int64
+        out.indices = out.indices.astype(np.dtype(index_dtype))
+    return out
+
+
+def coalesce(x):
+    return x.coalesce()
+
+
+def transpose(x, perm):
+    was_csr = isinstance(x, SparseCsrTensor)
+    coo = _as_coo(x)
+    if len(perm) != len(coo.shape):
+        raise ValueError("transpose perm rank mismatch")
+    new_idx = coo.indices[list(perm)]
+    new_shape = [coo.shape[p] for p in perm]
+    out = SparseCooTensor(new_idx, coo.values, new_shape)
+    return out.to_sparse_csr() if was_csr else out

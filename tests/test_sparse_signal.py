@@ -1,0 +1,260 @@
+"""paddle.sparse op set (scipy oracle) + paddle.signal stft/istft
+(scipy.signal oracle).
+
+Reference test models: `unittests/test_sparse_*_op.py`,
+`unittests/test_stft_op.py` / `test_istft_op.py`.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.signal as ss
+
+import paddle_tpu as paddle
+from paddle_tpu import signal, sparse
+
+
+def rand_coo(m, n, nnz, seed=0):
+    rng = np.random.RandomState(seed)
+    flat = rng.choice(m * n, nnz, replace=False)
+    rows, cols = np.unravel_index(flat, (m, n))
+    vals = rng.randn(nnz).astype("float32")
+    return np.stack([rows, cols]), vals
+
+
+class TestSparseOps:
+    def test_coo_to_dense_matches_scipy(self):
+        idx, vals = rand_coo(5, 6, 10)
+        t = sparse.sparse_coo_tensor(idx, vals, [5, 6])
+        want = sp.coo_matrix((vals, (idx[0], idx[1])), (5, 6)).toarray()
+        np.testing.assert_allclose(t.numpy(), want, rtol=1e-6)
+        assert t.nnz() == 10 and t.is_sparse_coo()
+
+    def test_csr_roundtrip(self):
+        idx, vals = rand_coo(4, 5, 8, seed=1)
+        want = sp.coo_matrix((vals, (idx[0], idx[1])), (4, 5)).tocsr()
+        t = sparse.sparse_csr_tensor(want.indptr, want.indices, want.data,
+                                     [4, 5])
+        assert t.is_sparse_csr()
+        np.testing.assert_allclose(t.numpy(), want.toarray(), rtol=1e-6)
+        coo = t.to_sparse_coo()
+        back = coo.to_sparse_csr()
+        np.testing.assert_array_equal(back.crows, want.indptr)
+        np.testing.assert_array_equal(back.cols, want.indices)
+
+    def test_coalesce_sums_duplicates(self):
+        idx = np.array([[0, 0, 1], [2, 2, 0]])
+        t = sparse.sparse_coo_tensor(idx, np.array([1., 2., 3.], "float32"),
+                                     [2, 3])
+        c = t.coalesce()
+        assert c.nnz() == 2
+        np.testing.assert_allclose(c.numpy()[0, 2], 3.0)
+
+    @pytest.mark.parametrize("op,sop", [
+        (sparse.add, lambda a, b: a + b),
+        (sparse.subtract, lambda a, b: a - b),
+        (sparse.multiply, lambda a, b: a.multiply(b).tocoo()),
+    ])
+    def test_elementwise_same_pattern(self, op, sop):
+        idx, va = rand_coo(5, 5, 7, seed=2)
+        vb = np.random.RandomState(3).randn(7).astype("float32")
+        A = sp.coo_matrix((va, (idx[0], idx[1])), (5, 5))
+        B = sp.coo_matrix((vb, (idx[0], idx[1])), (5, 5))
+        got = op(sparse.sparse_coo_tensor(idx, va, [5, 5]),
+                 sparse.sparse_coo_tensor(idx, vb, [5, 5]))
+        np.testing.assert_allclose(got.numpy(), np.asarray(sop(A, B).todense()),
+                                   rtol=1e-6)
+
+    def test_elementwise_union_pattern(self):
+        ia, va = rand_coo(4, 4, 5, seed=4)
+        ib, vb = rand_coo(4, 4, 5, seed=5)
+        A = sp.coo_matrix((va, (ia[0], ia[1])), (4, 4))
+        B = sp.coo_matrix((vb, (ib[0], ib[1])), (4, 4))
+        got = sparse.add(sparse.sparse_coo_tensor(ia, va, [4, 4]),
+                         sparse.sparse_coo_tensor(ib, vb, [4, 4]))
+        np.testing.assert_allclose(got.numpy(), (A + B).toarray(), rtol=1e-6)
+
+    def test_spmm_matches_scipy_and_grads(self):
+        idx, vals = rand_coo(4, 6, 9, seed=6)
+        A = sp.coo_matrix((vals, (idx[0], idx[1])), (4, 6))
+        d = np.random.RandomState(7).randn(6, 3).astype("float32")
+        sv = paddle.to_tensor(vals, stop_gradient=False)
+        dv = paddle.to_tensor(d, stop_gradient=False)
+        t = sparse.SparseCooTensor(idx, sv, [4, 6])
+        out = sparse.matmul(t, dv)
+        np.testing.assert_allclose(out.numpy(), A @ d, rtol=1e-5)
+        out.sum().backward()
+        # d(sum)/d(vals)[e] = sum_k d[col[e], k]
+        np.testing.assert_allclose(np.asarray(sv.gradient()),
+                                   d[idx[1]].sum(-1), rtol=1e-5)
+        # d(sum)/d(dense)[k, :] = sum of vals in column k
+        colsum = np.zeros(6, "float32")
+        np.add.at(colsum, idx[1], vals)
+        np.testing.assert_allclose(np.asarray(dv.gradient()),
+                                   np.tile(colsum[:, None], (1, 3)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_masked_matmul(self):
+        x = np.random.RandomState(8).randn(4, 5).astype("float32")
+        y = np.random.RandomState(9).randn(5, 4).astype("float32")
+        idx, _ = rand_coo(4, 4, 6, seed=10)
+        mask = sparse.sparse_coo_tensor(idx, np.ones(6, "float32"), [4, 4])
+        got = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        full = x @ y
+        np.testing.assert_allclose(
+            np.asarray(got.values.numpy()), full[idx[0], idx[1]], rtol=1e-5)
+
+    def test_unary_ops(self):
+        idx, vals = rand_coo(3, 4, 6, seed=11)
+        t = sparse.sparse_coo_tensor(idx, vals, [3, 4])
+        np.testing.assert_allclose(
+            np.asarray(sparse.relu(t).values.numpy()),
+            np.maximum(vals, 0), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.tanh(t).values.numpy()), np.tanh(vals),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.square(t).values.numpy()), vals ** 2,
+            rtol=1e-6)
+
+    def test_csr_ops_stay_csr(self):
+        idx, vals = rand_coo(4, 4, 6, seed=12)
+        A = sp.coo_matrix((vals, (idx[0], idx[1])), (4, 4)).tocsr()
+        t = sparse.sparse_csr_tensor(A.indptr, A.indices, A.data, [4, 4])
+        out = sparse.relu(t)
+        assert out.is_sparse_csr()
+        s = sparse.add(t, t)
+        assert s.is_same_shape(t) if hasattr(s, "is_same_shape") else True
+        np.testing.assert_allclose(s.numpy(), (A + A).toarray(), rtol=1e-6)
+
+    def test_transpose(self):
+        idx, vals = rand_coo(3, 5, 6, seed=13)
+        t = sparse.sparse_coo_tensor(idx, vals, [3, 5])
+        tt = sparse.transpose(t, [1, 0])
+        np.testing.assert_allclose(tt.numpy(), t.numpy().T, rtol=1e-6)
+
+
+class TestSignal:
+    def test_frame_reference_examples(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        y0 = signal.frame(x, frame_length=4, hop_length=2, axis=-1)
+        np.testing.assert_array_equal(
+            y0.numpy(), [[0, 2, 4], [1, 3, 5], [2, 4, 6], [3, 5, 7]])
+        y1 = signal.frame(x, frame_length=4, hop_length=2, axis=0)
+        np.testing.assert_array_equal(
+            y1.numpy(), [[0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6, 7]])
+
+    def test_overlap_add_inverts_frame_sum(self):
+        x = np.random.RandomState(0).randn(2, 20).astype("float32")
+        fr = signal.frame(paddle.to_tensor(x), 6, 6)      # non-overlapping
+        back = signal.overlap_add(fr, 6)
+        np.testing.assert_allclose(back.numpy(), x[:, :18], rtol=1e-6)
+
+    def test_stft_matches_scipy(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 400).astype("float32")
+        n_fft, hop = 128, 32
+        win = ss.get_window("hann", n_fft).astype("float32")
+        got = signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                          window=paddle.to_tensor(win), center=False)
+        # scipy oracle: same framing/window, no padding/scaling
+        _, _, want = ss.stft(x, window=win, nperseg=n_fft,
+                             noverlap=n_fft - hop, boundary=None,
+                             padded=False, scaling="spectrum")
+        # scipy 'spectrum' scaling divides by win.sum(); undo it
+        want = want * win.sum()
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-3, atol=1e-3)
+
+    def test_stft_onesided_shape_and_full(self):
+        x = paddle.to_tensor(np.random.randn(3, 512).astype("float32"))
+        y1 = signal.stft(x, n_fft=128)
+        assert tuple(y1.shape) == (3, 65, 1 + 512 // 32)
+        y2 = signal.stft(x, n_fft=128, onesided=False)
+        assert tuple(y2.shape) == (3, 128, 1 + 512 // 32)
+        # full spectrum's lower half must be the conjugate mirror
+        full = y2.numpy()
+        np.testing.assert_allclose(full[:, 1:64], np.conj(full[:, -1:-64:-1]),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_istft_roundtrip(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 800).astype("float32")
+        n_fft, hop = 128, 32
+        win = ss.get_window("hann", n_fft).astype("float32")
+        spec = signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                           window=paddle.to_tensor(win))
+        back = signal.istft(spec, n_fft, hop_length=hop,
+                            window=paddle.to_tensor(win), length=800)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-4)
+
+    def test_istft_normalized_roundtrip(self):
+        x = np.random.RandomState(3).randn(600).astype("float32")
+        win = ss.get_window("hann", 64).astype("float32")
+        spec = signal.stft(paddle.to_tensor(x), 64, window=paddle.to_tensor(win),
+                           normalized=True)
+        back = signal.istft(spec, 64, window=paddle.to_tensor(win),
+                            normalized=True, length=600)
+        # samples past the last full frame are zero-padded; compare the
+        # reconstructable span
+        np.testing.assert_allclose(back.numpy()[:592], x[:592],
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_stft_grad_flows(self):
+        x = paddle.to_tensor(np.random.randn(256).astype("float32"),
+                             stop_gradient=False)
+        spec = signal.stft(x, 64)
+        loss = (spec.abs() ** 2).sum()
+        loss.backward()
+        g = np.asarray(x.gradient())
+        assert g.shape == (256,) and np.isfinite(g).all() and np.abs(g).max() > 0
+
+    def test_error_paths(self):
+        x = paddle.to_tensor(np.random.randn(100).astype("float32"))
+        with pytest.raises(ValueError):
+            signal.stft(x, 64, hop_length=0)
+        with pytest.raises(ValueError):
+            signal.frame(x, 200, 10)
+        spec = signal.stft(x, 64)
+        with pytest.raises(ValueError):
+            signal.istft(spec, 32)  # bin count mismatch
+
+
+class TestReviewRegressions:
+    def test_union_add_with_duplicate_indices(self):
+        a = sparse.sparse_coo_tensor(np.array([[0, 0], [1, 1]]),
+                                     np.array([1., 2.], "float32"), [2, 2])
+        b = sparse.sparse_coo_tensor(np.array([[1], [0]]),
+                                     np.array([5.], "float32"), [2, 2])
+        got = sparse.add(a, b)
+        np.testing.assert_allclose(got.numpy(),
+                                   [[0., 3.], [5., 0.]], rtol=1e-6)
+
+    def test_shape_inference(self):
+        t = sparse.sparse_coo_tensor(np.array([[0, 2], [1, 3]]),
+                                     np.array([1., 2.], "float32"))
+        assert list(t.shape) == [3, 4]
+
+    def test_csr_transpose_stays_csr(self):
+        idx, vals = rand_coo(3, 4, 5, seed=20)
+        A = sp.coo_matrix((vals, (idx[0], idx[1])), (3, 4)).tocsr()
+        t = sparse.sparse_csr_tensor(A.indptr, A.indices, A.data, [3, 4])
+        tt = sparse.transpose(t, [1, 0])
+        assert tt.is_sparse_csr()
+        np.testing.assert_allclose(tt.numpy(), A.toarray().T, rtol=1e-6)
+
+    def test_cast_index_dtype(self):
+        idx, vals = rand_coo(3, 3, 4, seed=21)
+        t = sparse.cast(sparse.sparse_coo_tensor(idx, vals, [3, 3]),
+                        index_dtype="int32", value_dtype="float64")
+        assert t.indices.dtype == np.int32
+
+    def test_signal_arg_validation(self):
+        x = paddle.to_tensor(np.random.randn(100).astype("float32"))
+        with pytest.raises(ValueError, match="win_length"):
+            signal.stft(x, n_fft=32, win_length=64)
+        with pytest.raises(ValueError, match="hop_length"):
+            signal.overlap_add(paddle.to_tensor(
+                np.zeros((4, 3), "float32")), 0)
+        spec = signal.stft(x, 32)
+        with pytest.raises(ValueError, match="return_complex"):
+            signal.istft(spec, 32, return_complex=True)
