@@ -87,7 +87,8 @@ class GPTPretrainingCriterion(nn.Layer):
 
 
 def gpt_pipeline_layer(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
-                       num_stages=2, use_mp=False, dropout=0.1, max_seq_len=1024):
+                       num_stages=2, use_mp=False, dropout=0.1, max_seq_len=1024,
+                       num_virtual_pipeline_stages=1):
     """PipelineLayer build of GPT for pp training (reference pp_layers pattern)."""
     from ..parallel.pp_layers import LayerDesc, PipelineLayer
 
@@ -115,7 +116,8 @@ def gpt_pipeline_layer(vocab_size=50304, hidden_size=768, num_layers=12, num_hea
                                dropout, use_mp, False, True))
     descs.append(LayerDesc(_HeadStage))
     return PipelineLayer(descs, num_stages=num_stages,
-                         loss_fn=GPTPretrainingCriterion())
+                         loss_fn=GPTPretrainingCriterion(),
+                         num_virtual_pipeline_stages=num_virtual_pipeline_stages)
 
 
 # configs

@@ -19,8 +19,16 @@ single controller merges the per-stage sequences with a dependency-driven
 worklist, so stage s's next op is enqueued the moment its input activation
 (forward) or output-gradient (backward) exists; XLA's async dispatch runs
 enqueued work on different stage meshes concurrently. In-flight saved
-activations per stage are bounded by its warmup depth + 1 <= num_stages
-(`last_peak_inflight` exposes the measured peak), unlike GPipe's n_micro.
+activations per LOGICAL stage are bounded by its warmup depth + 1 <= the
+chain length (`last_peak_inflight` exposes the measured peak), unlike
+GPipe's n_micro; under interleaving a physical device hosts V chunks, so
+budget V x the per-chunk bound per device (the chunks are 1/V the size).
+
+Interleaved (virtual-stage) schedule: PipelineLayer with
+num_virtual_pipeline_stages=V splits the model into P*V chunks, chain
+chunk c running on physical stage c % P — the pipeline fills V times
+faster, so the bubble fraction drops from (P-1)/M toward (P-1)/(M*V)
+(reference pipeline_parallel.py:30 "1F1B + interleave-able").
 
 Backward is rematerialized: each stage's backward recomputes its forward
 from the saved stage INPUT (recompute-in-backward — the reference's
@@ -49,10 +57,16 @@ class PipelineParallel:
         self.hcg = hcg or get_hybrid_communicate_group()
         cfg = strategy.pipeline_configs if strategy is not None else {}
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
-        self.num_stages = layers.num_stages
+        # logical chain = physical stages x virtual chunks (interleaved
+        # schedule); chunk l runs on physical mesh l % num_phys_stages
+        self.num_phys_stages = layers.num_stages
+        self.vpp = getattr(layers, "num_virtual_stages", 1)
+        self.num_stages = layers.num_stages * self.vpp
         self.loss_fn = layers.loss_fn
         self.stages = layers.get_stage_modules()
-        self._stage_meshes = self._make_stage_meshes()
+        phys = self._make_stage_meshes()
+        self._stage_meshes = [phys[layers.chunk_to_stage(l)]
+                              for l in range(self.num_stages)]
         self._fwd_fns: List = [None] * self.num_stages
         self._bwd_fns: List = [None] * self.num_stages
         self._upd_fns: dict = {}
@@ -64,16 +78,18 @@ class PipelineParallel:
         self._opt_slots = None
 
     def _make_stage_meshes(self):
+        """One submesh per PHYSICAL stage (virtual chunks share theirs)."""
+        P_ = self.num_phys_stages
         if self.hcg is None:
             # single mesh over all devices, stages share devices (degenerate)
             devs = jax.devices()
-            per = max(1, len(devs) // self.num_stages)
+            per = max(1, len(devs) // P_)
             return [Mesh(np.asarray(devs[s * per:(s + 1) * per]).reshape(-1, 1),
-                         ("dp", "mp")) for s in range(self.num_stages)]
+                         ("dp", "mp")) for s in range(P_)]
         mesh = self.hcg.get_mesh()
         arr = np.asarray(mesh.devices)  # [dp, pp, sharding, mp, (sp)]
         meshes = []
-        for s in range(self.num_stages):
+        for s in range(P_):
             sub = arr[:, s]  # [dp, sharding, mp, ...]
             sub = sub.reshape(arr.shape[0] * int(np.prod(sub.shape[1:-1] or [1])),
                               sub.shape[-1])

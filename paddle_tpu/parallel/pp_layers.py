@@ -42,6 +42,13 @@ class PipelineLayer(Layer):
         super().__init__()
         self.descs = list(layers)
         self.num_stages = num_stages or 1
+        # interleaved (virtual-stage) pipeline: the model splits into
+        # num_stages * V chunks; chain chunk c runs on physical stage
+        # c % num_stages (reference pipeline_parallel.py:30 "1F1B +
+        # interleave-able", Megatron virtual-pipeline assignment) — the
+        # pipeline fills V times faster, shrinking the bubble fraction
+        # from (P-1)/M toward (P-1)/(M*V)
+        self.num_virtual_stages = max(int(num_virtual_pipeline_stages), 1)
         self.loss_fn = loss_fn
         self.seg_method = seg_method
         self._built_layers = []
@@ -67,7 +74,7 @@ class PipelineLayer(Layer):
 
     def _segment(self):
         n = len(self._built_layers)
-        k = self.num_stages
+        k = self.num_stages * self.num_virtual_stages
         if self.seg_method.startswith("layer:"):
             # split at layers whose class name matches (reference seg_method)
             cls_name = self.seg_method.split(":", 1)[1]
@@ -79,8 +86,11 @@ class PipelineLayer(Layer):
                 bounds.append(marks[min(s * per, len(marks) - 1)])
             bounds.append(n)
         else:
-            per = (n + k - 1) // k
-            bounds = [min(i * per, n) for i in range(k)] + [n]
+            # balanced split (i*n//k): slack spreads across chunks instead
+            # of piling into (possibly empty) trailing ones — with virtual
+            # stages k can approach n and the ceil split would starve the
+            # tail chunks
+            bounds = [i * n // k for i in range(k + 1)]
         self.segments = [(bounds[i], bounds[i + 1]) for i in range(k)]
 
     def get_stage_module(self, stage: int) -> Sequential:
@@ -88,7 +98,14 @@ class PipelineLayer(Layer):
         return Sequential(*self._built_layers[lo:hi])
 
     def get_stage_modules(self) -> List[Sequential]:
-        return [self.get_stage_module(s) for s in range(self.num_stages)]
+        """Chunks in CHAIN order (logical pipeline position); with virtual
+        stages there are num_stages * V of them."""
+        return [self.get_stage_module(s)
+                for s in range(len(self.segments))]
+
+    def chunk_to_stage(self, chunk: int) -> int:
+        """Physical stage owning chain chunk `chunk`."""
+        return chunk % self.num_stages
 
     def forward(self, x):
         for layer in self._built_layers:
