@@ -1,0 +1,82 @@
+"""Device memory introspection (paddle.device.* surface).
+
+Reference parity: `paddle/fluid/memory/stats.h` (StatRegistry's
+max_memory_allocated / memory_allocated counters) and the
+`paddle.device.cuda.max_memory_allocated` python surface.
+
+TPU-first: XLA owns the allocator, so the authoritative numbers come
+from the backend — `Device.memory_stats()` where the platform exposes it
+(real TPU HBM pools), with a live-buffer walk (`jax.live_arrays`) as the
+always-available fallback. A process-wide peak tracker is sampled at
+every stats call and can be reset like the reference's counterpart.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+__all__ = [
+    "memory_stats", "memory_allocated", "max_memory_allocated",
+    "reset_max_memory_allocated", "device_count", "get_device",
+]
+
+_peak_bytes = [0]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def get_device() -> str:
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def _live_bytes(device=None) -> int:
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            if device is not None and device not in {d.id for d in a.devices()}:
+                continue
+            total += a.nbytes
+        except Exception:  # deleted/donated buffers race the walk
+            continue
+    return total
+
+
+def memory_stats(device: Optional[int] = None) -> Dict[str, int]:
+    """Allocator statistics for one device (default: device 0).
+
+    Keys follow the reference StatRegistry naming: `allocated.current`,
+    `allocated.peak`, plus backend pool stats (`bytes_in_use`,
+    `peak_bytes_in_use`, ...) when the platform reports them."""
+    d = jax.devices()[device or 0]
+    out: Dict[str, int] = {}
+    backend = None
+    try:
+        backend = d.memory_stats()
+    except Exception:
+        backend = None
+    if backend:
+        out.update({k: int(v) for k, v in backend.items()
+                    if isinstance(v, (int, float))})
+    live = _live_bytes(d.id)
+    _peak_bytes[0] = max(_peak_bytes[0], live,
+                         int(out.get("peak_bytes_in_use", 0)))
+    out["allocated.current"] = int(out.get("bytes_in_use", live))
+    out["allocated.peak"] = _peak_bytes[0]
+    return out
+
+
+def memory_allocated(device: Optional[int] = None) -> int:
+    return memory_stats(device)["allocated.current"]
+
+
+def max_memory_allocated(device: Optional[int] = None) -> int:
+    return memory_stats(device)["allocated.peak"]
+
+
+def reset_max_memory_allocated(device: Optional[int] = None) -> None:
+    _peak_bytes[0] = 0
+    memory_stats(device)

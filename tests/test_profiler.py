@@ -53,3 +53,29 @@ def test_hook_removed_after_stop():
     with Profiler(timer_only=True):
         pass
     assert _dispatch._PROFILE_HOOK is None
+
+
+class TestDeviceMemory:
+    def test_memory_stats_surface(self):
+        import paddle_tpu as paddle
+        import numpy as np
+        paddle.device.reset_max_memory_allocated()
+        base = paddle.device.memory_allocated()
+        keep = paddle.to_tensor(np.ones((256, 1024), "float32"))  # 1 MB
+        stats = paddle.device.memory_stats()
+        assert stats["allocated.current"] >= base + 1_000_000
+        assert paddle.device.max_memory_allocated() >= stats["allocated.current"]
+        assert paddle.device.device_count() >= 1
+        assert ":" in paddle.device.get_device()
+        del keep
+
+    def test_peak_is_monotonic_until_reset(self):
+        import paddle_tpu as paddle
+        import numpy as np
+        paddle.device.reset_max_memory_allocated()
+        t = paddle.to_tensor(np.ones((512, 1024), "float32"))  # 2 MB
+        peak_with = paddle.device.max_memory_allocated()
+        del t
+        assert paddle.device.max_memory_allocated() >= peak_with
+        paddle.device.reset_max_memory_allocated()
+        assert paddle.device.max_memory_allocated() <= peak_with
