@@ -6,9 +6,12 @@ max_memory_allocated / memory_allocated counters) and the
 
 TPU-first: XLA owns the allocator, so the authoritative numbers come
 from the backend — `Device.memory_stats()` where the platform exposes it
-(real TPU HBM pools), with a live-buffer walk (`jax.live_arrays`) as the
-always-available fallback. A process-wide peak tracker is sampled at
-every stats call and can be reset like the reference's counterpart.
+(real TPU HBM pools), with a per-shard live-buffer walk
+(`jax.live_arrays` -> addressable_shards) as the always-available
+fallback. Peaks are tracked PER DEVICE and are resettable like the
+reference counters; after a reset the peak is the max of sampled
+footprints (XLA's own process-lifetime peak cannot be reset, so it is
+only folded in before the first reset).
 """
 from __future__ import annotations
 
@@ -16,30 +19,27 @@ from typing import Dict, Optional
 
 import jax
 
+from ..core.place import device_count, get_device  # noqa: F401  (one surface)
+
 __all__ = [
     "memory_stats", "memory_allocated", "max_memory_allocated",
     "reset_max_memory_allocated", "device_count", "get_device",
 ]
 
-_peak_bytes = [0]
+_peaks: Dict[int, int] = {}         # device id -> tracked peak bytes
+_reset_called: Dict[int, bool] = {}  # device id -> reset happened
 
 
-def device_count() -> int:
-    return jax.device_count()
-
-
-def get_device() -> str:
-    d = jax.devices()[0]
-    return f"{d.platform}:{d.id}"
-
-
-def _live_bytes(device=None) -> int:
+def _live_bytes(device_id: int) -> int:
+    """Bytes actually resident on `device_id`: sums the per-device SHARD
+    sizes, so sharded arrays count 1/n per device and replicated arrays
+    count their full size on every device."""
     total = 0
     for a in jax.live_arrays():
         try:
-            if device is not None and device not in {d.id for d in a.devices()}:
-                continue
-            total += a.nbytes
+            for sh in a.addressable_shards:
+                if sh.device.id == device_id:
+                    total += sh.data.nbytes
         except Exception:  # deleted/donated buffers race the walk
             continue
     return total
@@ -53,7 +53,6 @@ def memory_stats(device: Optional[int] = None) -> Dict[str, int]:
     `peak_bytes_in_use`, ...) when the platform reports them."""
     d = jax.devices()[device or 0]
     out: Dict[str, int] = {}
-    backend = None
     try:
         backend = d.memory_stats()
     except Exception:
@@ -61,11 +60,15 @@ def memory_stats(device: Optional[int] = None) -> Dict[str, int]:
     if backend:
         out.update({k: int(v) for k, v in backend.items()
                     if isinstance(v, (int, float))})
-    live = _live_bytes(d.id)
-    _peak_bytes[0] = max(_peak_bytes[0], live,
-                         int(out.get("peak_bytes_in_use", 0)))
-    out["allocated.current"] = int(out.get("bytes_in_use", live))
-    out["allocated.peak"] = _peak_bytes[0]
+    cur = int(out.get("bytes_in_use", _live_bytes(d.id)))
+    peak = max(_peaks.get(d.id, 0), cur)
+    if not _reset_called.get(d.id):
+        # XLA's pool peak covers allocations our sampling missed — but it
+        # is process-lifetime and unresettable, so only before a reset
+        peak = max(peak, int(out.get("peak_bytes_in_use", 0)))
+    _peaks[d.id] = peak
+    out["allocated.current"] = cur
+    out["allocated.peak"] = peak
     return out
 
 
@@ -78,5 +81,7 @@ def max_memory_allocated(device: Optional[int] = None) -> int:
 
 
 def reset_max_memory_allocated(device: Optional[int] = None) -> None:
-    _peak_bytes[0] = 0
+    d = jax.devices()[device or 0]
+    _reset_called[d.id] = True
+    _peaks[d.id] = 0
     memory_stats(device)

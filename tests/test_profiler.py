@@ -79,3 +79,25 @@ class TestDeviceMemory:
         assert paddle.device.max_memory_allocated() >= peak_with
         paddle.device.reset_max_memory_allocated()
         assert paddle.device.max_memory_allocated() <= peak_with
+
+    def test_per_device_peaks_and_sharded_accounting(self):
+        import paddle_tpu as paddle
+        import numpy as np
+        import jax, jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.parallel import create_mesh
+        mesh = create_mesh({"dp": 8})
+        paddle.device.reset_max_memory_allocated(0)
+        paddle.device.reset_max_memory_allocated(1)
+        big = jax.device_put(jnp.ones((8, 1024, 128), jnp.float32),
+                             NamedSharding(mesh, P("dp")))   # 4MB over 8
+        s0 = paddle.device.memory_allocated(0)
+        # each device holds ~1/8 of the array, not the whole 4MB
+        assert s0 < 2_000_000, s0
+        # device-1 peak must not inherit device-0 allocations
+        only0 = jax.device_put(jnp.ones((1024, 1024), jnp.float32),
+                               jax.devices()[0])             # 4MB on dev 0
+        _ = paddle.device.memory_stats(0)
+        p1 = paddle.device.max_memory_allocated(1)
+        assert p1 < 3_000_000, p1
+        del big, only0
