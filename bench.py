@@ -283,11 +283,11 @@ def bench_flash_attention(backend):
             # roofline: at head_dim 64 every qk^T/pv/dq dot leaves half the
             # 128-lane MXU contraction/output dim idle, capping the nominal
             # MFU ceiling near 0.5 for this head geometry. The backward is
-            # the fused single-pass kernel (p/ds computed once, delta
-            # fused, k/v streamed per block): 1.44x the two-pass backward
-            # at this size; the residual gap to the ceiling is VPU
-            # softmax/exp work on the S^2 elements, which d=64 cannot
-            # amortize over more MXU flops
+            # the fused single-pass kernel (p/ds computed once, k/v
+            # streamed per block): 1.32x the two-pass backward kernel and
+            # ~1.10x the end-to-end grad step under D2H-synced timing; the
+            # residual gap to the ceiling is VPU softmax/exp work on the
+            # S^2 elements, which d=64 cannot amortize over more MXU flops
             "roofline": "d64 halves MXU-> ceiling ~0.5 nominal MFU"}
 
 

@@ -60,7 +60,8 @@ def memory_stats(device: Optional[int] = None) -> Dict[str, int]:
     if backend:
         out.update({k: int(v) for k, v in backend.items()
                     if isinstance(v, (int, float))})
-    cur = int(out.get("bytes_in_use", _live_bytes(d.id)))
+    cur = int(out["bytes_in_use"]) if "bytes_in_use" in out \
+        else _live_bytes(d.id)
     peak = max(_peaks.get(d.id, 0), cur)
     if not _reset_called.get(d.id):
         # XLA's pool peak covers allocations our sampling missed — but it

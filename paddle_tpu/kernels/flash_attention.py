@@ -106,6 +106,13 @@ def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
     )(q, k, v)
 
 
+def _delta(g, o):
+    """delta = rowsum(dO * O) as [BH, 1, S] — the softmax-grad correction
+    term, computed once in XLA for BOTH backward implementations."""
+    return jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=-1)[:, None, :]
+
+
 def _bwd_tile_pds(q, k, v, do, lse, delta, *, scale, causal, q0, k0):
     """Shared per-tile backward math: (p, ds) for a [Bq, D] q/do tile
     against a [Bk, D] k/v tile with global row/col offsets (q0, k0).
@@ -194,9 +201,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     scale = 1.0 / math.sqrt(d)
-    # lse arrives as [BH, 1, S]; delta built to match
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)[:, None, :]
+    delta = _delta(g, o)                 # [BH, 1, S], matches lse layout
 
     full = lambda b, i: (b, 0, 0)  # noqa: E731
 
@@ -270,8 +275,8 @@ def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
 def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     bh, s, d = q.shape
-    # VMEM-resident streams: q/do/o + dq out (native dtype) + f32 scratch
-    vmem_est = (4 * q.dtype.itemsize + 4) * s * d + 8 * s
+    # VMEM-resident streams: q/do + dq out (native dtype) + f32 scratch
+    vmem_est = (3 * q.dtype.itemsize + 4) * s * d + 8 * s
     if s % block_q == 0 and s % block_k == 0 \
             and vmem_est < _FUSED_BWD_VMEM_CAP:
         return _flash_bwd_fused_bhsd(q, k, v, o, lse, g, causal=causal,
@@ -558,15 +563,16 @@ def ring_block_dkv(q, k, v, do, lse, delta, offs, *, causal, block_q, block_k,
 # The two-kernel backward computes p = exp(s - lse) and ds TWICE (once for
 # dq, once for dk/dv) — 7 tile dots and double the VPU softmax work. This
 # kernel makes ONE pass over the (q-block, k-block) tiles computing all
-# three grads: 5 dots, p/ds once, delta fused in (no XLA prepass streaming
-# dO/O from HBM). Grid is (bh, k-blocks) — sequential on the TensorCore —
-# with k/v/dk/dv streamed per k-block while q/do/o stay VMEM-resident and
-# dq accumulates in persistent f32 scratch across the k-block steps
-# (written out on the last one), keeping the footprint inside the 16 MiB
-# scoped-vmem budget.
+# three grads: 5 dots, p/ds once (delta arrives from a cheap XLA prepass,
+# shared with the two-pass path). Grid is (bh, k-blocks) — sequential on
+# the TensorCore — with k/v/dk/dv streamed per k-block while q/do stay
+# VMEM-resident and dq accumulates in persistent f32 scratch across the
+# k-block steps (written out on the last one), keeping the footprint
+# inside the 16 MiB scoped-vmem budget with headroom for the fusions
+# jax.grad composes around the custom call.
 
-def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                         dq_ref, dk_ref, dv_ref, dq_acc, delta_ref, *,
+def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dk_ref, dv_ref, dq_acc, *,
                          scale, causal, block_q, block_k, seq_len):
     ki = pl.program_id(1)
     n_qb = seq_len // block_q
@@ -574,17 +580,12 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     @pl.when(ki == 0)
     def _init():
-        # delta = rowsum(dO * O) per q block, once per bh slice
-        def dstep(qb, _):
-            do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-            o = o_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-            delta_ref[0, pl.ds(qb * block_q, block_q)] = jnp.sum(do * o,
-                                                                 axis=1)
+        def zstep(qb, _):
             dq_acc[pl.ds(qb * block_q, block_q), :] = jnp.zeros(
                 (block_q, q_ref.shape[2]), jnp.float32)
             return 0
 
-        jax.lax.fori_loop(0, n_qb, dstep, 0)
+        jax.lax.fori_loop(0, n_qb, zstep, 0)
 
     k = k_ref[0]
     v = v_ref[0]
@@ -595,7 +596,7 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         q = q_ref[0, pl.ds(qb * block_q, block_q), :]
         do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         p, ds = _bwd_tile_pds(q, k, v, do, lse, delta, scale=scale,
                               causal=causal, q0=qb * block_q,
                               k0=ki * block_k)
@@ -632,6 +633,10 @@ def _flash_bwd_fused_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
     # the caller guarantees block_q and block_k divide s (the kernel's
     # trip counts bake the divisibility in) — no clamping here
     scale = 1.0 / math.sqrt(d)
+    # delta in a cheap XLA prepass (shared with the two-pass path):
+    # keeping o resident in the kernel pushed the VMEM footprint past the
+    # 16 MiB scoped budget once jax.grad composed copies into it
+    delta = _delta(g, o)
     full = lambda b, i: (b, 0, 0)  # noqa: E731
     return pl.pallas_call(
         functools.partial(_fa_bwd_fused_kernel, scale=scale, causal=causal,
@@ -645,18 +650,18 @@ def _flash_bwd_fused_bhsd(q, k, v, o, lse, g, *, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),   # k
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),   # v
             pl.BlockSpec((1, s, d), full),                      # do
-            pl.BlockSpec((1, s, d), full),                      # o
             pl.BlockSpec((1, 1, s), full),                      # lse
+            pl.BlockSpec((1, 1, s), full),                      # delta
         ],
         out_specs=(pl.BlockSpec((1, s, d), full),               # dq (last)
                    pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
                    pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))),
-        scratch_shapes=[pltpu.VMEM((s, d), jnp.float32),
-                        pltpu.VMEM((1, s), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((s, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, g, o, lse)
+    )(q, k, v, g, lse, delta)
 
 
-# resident streams for the fused backward: q/do/o/dq at [S, D] + f32 dq
-# scratch (k/v/dk/dv stream per k-block); stay inside scoped vmem
-_FUSED_BWD_VMEM_CAP = 12 * 2 ** 20
+# resident streams for the fused backward: q/do/dq at [S, D] + f32 dq
+# scratch (k/v/dk/dv stream per k-block); stay inside scoped vmem with
+# headroom for fusions jax.grad composes around the custom call
+_FUSED_BWD_VMEM_CAP = 10 * 2 ** 20
