@@ -1,0 +1,408 @@
+// Native parameter-server data plane.
+//
+// Reference parity: paddle/fluid/distributed/ps/service/brpc_ps_server.cc
+// (pull/push dense+sparse RPC handlers) + ps/table/common_sparse_table.cc /
+// common_dense_table.cc (SGD tables). Speaks EXACTLY the wire protocol of
+// the python plane (distributed/ps/service.py): header `<B16sqq`
+// (cmd, 16-byte table name, n, dim), one status byte per response, error
+// frames as 0x00 + i64 len + message. A cluster can therefore mix python
+// and native servers freely; the python PsClient drives both.
+//
+// Commands: 1 PULL_SPARSE  ids[n]u64            -> rows[n*dim]f32
+//           2 PUSH_SPARSE  ids[n]u64 g[n*dim]   -> ok        (w -= lr*g)
+//           3 PULL_DENSE                        -> i64 size, i64 shard_lo,
+//                                                  i64 total, w[size]f32
+//           4 PUSH_DENSE   g[n]f32              -> ok        (w -= lr*g)
+//           5 STOP                              -> ok, server exits
+//           6 BARRIER      n participants       -> ok once n arrived
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kPullSparse = 1, kPushSparse = 2, kPullDense = 3,
+                  kPushDense = 4, kStop = 5, kBarrier = 6;
+constexpr int64_t kMaxRows = 1LL << 24;
+constexpr int64_t kMaxDim = 1LL << 16;
+constexpr int64_t kMaxElems = 1LL << 28;
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_err(int fd, const std::string& msg) {
+  uint8_t st = 0;
+  int64_t len = static_cast<int64_t>(msg.size());
+  return write_full(fd, &st, 1) && write_full(fd, &len, 8) &&
+         write_full(fd, msg.data(), msg.size());
+}
+
+// splitmix64 -> two uniforms -> Box-Muller normal; deterministic per
+// (seed, id, j) so a row re-pulled after eviction re-initializes equal
+double hash_unit(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  x = x ^ (x >> 31);
+  return (static_cast<double>(x >> 11) + 0.5) * (1.0 / 9007199254740992.0);
+}
+
+float init_normal(uint64_t seed, uint64_t id, uint64_t j, float std) {
+  double u1 = hash_unit(seed * 0x100000001b3ULL + id * 1315423911ULL + 2 * j);
+  double u2 =
+      hash_unit(seed * 0xcbf29ce484222325ULL + id * 2654435761ULL + 2 * j + 1);
+  double z = std::sqrt(-2.0 * std::log(u1)) * std::cos(6.283185307179586 * u2);
+  return static_cast<float>(z * std);
+}
+
+struct SparseTable {
+  int64_t dim;
+  float lr;
+  float init_std;
+  uint64_t seed;
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<float>> rows;
+
+  std::vector<float>& row(int64_t id) {
+    auto it = rows.find(id);
+    if (it != rows.end()) return it->second;
+    std::vector<float> r(static_cast<size_t>(dim));
+    for (int64_t j = 0; j < dim; ++j)
+      r[static_cast<size_t>(j)] = init_normal(seed, static_cast<uint64_t>(id),
+                                              static_cast<uint64_t>(j),
+                                              init_std);
+    return rows.emplace(id, std::move(r)).first->second;
+  }
+};
+
+struct DenseTable {
+  float lr;
+  int64_t shard_lo = 0;
+  int64_t total = 0;
+  std::mutex mu;
+  std::vector<float> w;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::mutex tables_mu;
+  std::map<std::string, std::unique_ptr<SparseTable>> sparse;
+  std::map<std::string, std::unique_ptr<DenseTable>> dense;
+  // live-connection registry so stop() can unblock and drain handlers
+  // before the Server is freed (no use-after-free on teardown)
+  std::mutex conn_mu;
+  std::condition_variable conn_cv;
+  std::map<int, bool> conns;  // fd -> active
+  // generation-counted barrier (python _barrier parity)
+  std::mutex bar_mu;
+  std::condition_variable bar_cv;
+  int64_t bar_arrived = 0;
+  int64_t bar_gen = 0;
+
+  bool barrier(int64_t n) {
+    std::unique_lock<std::mutex> lk(bar_mu);
+    int64_t gen = bar_gen;
+    if (++bar_arrived >= (n < 1 ? 1 : n)) {
+      bar_arrived = 0;
+      ++bar_gen;
+      bar_cv.notify_all();
+      return true;
+    }
+    bool ok = bar_cv.wait_for(lk, std::chrono::seconds(60),
+                              [&] { return bar_gen != gen || stop; });
+    if (!ok || (stop && bar_gen == gen)) {
+      if (bar_gen == gen) --bar_arrived;
+      return false;
+    }
+    return true;
+  }
+};
+
+void handle_conn(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  {
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    if (s->stop) {
+      ::close(fd);
+      return;
+    }
+    s->conns[fd] = true;
+  }
+  for (;;) {
+    uint8_t hdr[33];
+    if (!read_full(fd, hdr, sizeof(hdr))) break;
+    uint8_t cmd = hdr[0];
+    char namebuf[17] = {0};
+    std::memcpy(namebuf, hdr + 1, 16);
+    std::string name(namebuf);  // NUL-trimmed
+    int64_t n, dim;
+    std::memcpy(&n, hdr + 17, 8);
+    std::memcpy(&dim, hdr + 25, 8);
+    if (n < 0 || n > kMaxRows || dim < 0 || dim > kMaxDim ||
+        n * (dim > 1 ? dim : 1) > kMaxElems) {
+      send_err(fd, "ps: implausible header n=" + std::to_string(n) +
+                       " dim=" + std::to_string(dim));
+      break;
+    }
+    // read the FULL payload before acting so error replies keep the
+    // stream in sync (python server does the same)
+    std::vector<int64_t> ids;
+    std::vector<float> payload;
+    if (cmd == kPullSparse || cmd == kPushSparse) {
+      ids.resize(static_cast<size_t>(n));
+      if (!read_full(fd, ids.data(), static_cast<size_t>(n) * 8)) break;
+    }
+    if (cmd == kPushSparse) {
+      payload.resize(static_cast<size_t>(n * dim));
+      if (!read_full(fd, payload.data(), payload.size() * 4)) break;
+    } else if (cmd == kPushDense) {
+      payload.resize(static_cast<size_t>(n));
+      if (!read_full(fd, payload.data(), payload.size() * 4)) break;
+    }
+
+    if (cmd == kStop) {
+      uint8_t ok = 1;
+      write_full(fd, &ok, 1);
+      s->stop = true;
+      // poke the accept loop
+      ::shutdown(s->listen_fd, SHUT_RDWR);
+      break;
+    }
+    if (cmd == kBarrier) {
+      if (s->barrier(n)) {
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else {
+        send_err(fd, "barrier timed out after 60s (" + std::to_string(n) +
+                         " participants expected)");
+      }
+      continue;
+    }
+
+    SparseTable* st = nullptr;
+    DenseTable* dt = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(s->tables_mu);
+      auto si = s->sparse.find(name);
+      if (si != s->sparse.end()) st = si->second.get();
+      auto di = s->dense.find(name);
+      if (di != s->dense.end()) dt = di->second.get();
+    }
+    if (cmd == kPullSparse || cmd == kPushSparse) {
+      if (!st) {
+        if (!send_err(fd, "ps: unknown table '" + name + "'")) break;
+        continue;
+      }
+      if (cmd == kPullSparse) {
+        std::vector<float> out(static_cast<size_t>(n) *
+                               static_cast<size_t>(st->dim));
+        {
+          std::lock_guard<std::mutex> lk(st->mu);
+          for (int64_t i = 0; i < n; ++i) {
+            auto& r = st->row(ids[static_cast<size_t>(i)]);
+            std::memcpy(out.data() + i * st->dim, r.data(),
+                        static_cast<size_t>(st->dim) * 4);
+          }
+        }
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1) ||
+            !write_full(fd, out.data(), out.size() * 4))
+          break;
+      } else {
+        if (dim != st->dim) {
+          if (!send_err(fd, "ps: push dim mismatch")) break;
+          continue;
+        }
+        {
+          std::lock_guard<std::mutex> lk(st->mu);
+          for (int64_t i = 0; i < n; ++i) {
+            auto& r = st->row(ids[static_cast<size_t>(i)]);
+            const float* g = payload.data() + i * dim;
+            for (int64_t j = 0; j < dim; ++j)
+              r[static_cast<size_t>(j)] -= st->lr * g[j];
+          }
+        }
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      }
+      continue;
+    }
+    if (cmd == kPullDense || cmd == kPushDense) {
+      if (!dt) {
+        if (!send_err(fd, "ps: unknown table '" + name + "'")) break;
+        continue;
+      }
+      if (cmd == kPullDense) {
+        std::lock_guard<std::mutex> lk(dt->mu);
+        uint8_t ok = 1;
+        int64_t size = static_cast<int64_t>(dt->w.size());
+        if (!write_full(fd, &ok, 1) || !write_full(fd, &size, 8) ||
+            !write_full(fd, &dt->shard_lo, 8) ||
+            !write_full(fd, &dt->total, 8) ||
+            !write_full(fd, dt->w.data(), dt->w.size() * 4))
+          break;
+      } else {
+        if (n != static_cast<int64_t>(dt->w.size())) {
+          if (!send_err(fd, "ps: dense grad size mismatch")) break;
+          continue;
+        }
+        {
+          std::lock_guard<std::mutex> lk(dt->mu);
+          for (int64_t i = 0; i < n; ++i)
+            dt->w[static_cast<size_t>(i)] -= dt->lr * payload[i];
+        }
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      }
+      continue;
+    }
+    send_err(fd, "ps: unknown cmd " + std::to_string(cmd));
+    break;
+  }
+  ::close(fd);
+  {
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    s->conns.erase(fd);
+  }
+  s->conn_cv.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ps_native_server_start(int port, int* out_port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(s->listen_fd, 64) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  s->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = s->port;
+  s->accept_thread = std::thread([s] {
+    while (!s->stop) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (s->stop) break;
+        // EMFILE & friends: back off instead of spinning a core
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+        continue;
+      }
+      std::thread(handle_conn, s, fd).detach();
+    }
+  });
+  return s;
+}
+
+int ps_native_add_sparse(void* h, const char* name, long long dim, float lr,
+                         float init_std, long long seed) {
+  auto* s = static_cast<Server*>(h);
+  if (!s || !name || std::strlen(name) > 16 || dim <= 0) return -1;
+  auto t = std::make_unique<SparseTable>();
+  t->dim = dim;
+  t->lr = lr;
+  t->init_std = init_std;
+  t->seed = static_cast<uint64_t>(seed);
+  std::lock_guard<std::mutex> lk(s->tables_mu);
+  // re-registration would free a table in-flight handlers may still hold
+  if (s->sparse.count(name) || s->dense.count(name)) return -2;
+  s->sparse[name] = std::move(t);
+  return 0;
+}
+
+int ps_native_add_dense(void* h, const char* name, long long size, float lr,
+                        long long shard_lo, long long total) {
+  auto* s = static_cast<Server*>(h);
+  if (!s || !name || std::strlen(name) > 16 || size < 0) return -1;
+  auto t = std::make_unique<DenseTable>();
+  t->lr = lr;
+  t->shard_lo = shard_lo;
+  t->total = total > 0 ? total : size;
+  t->w.assign(static_cast<size_t>(size), 0.0f);
+  std::lock_guard<std::mutex> lk(s->tables_mu);
+  if (s->sparse.count(name) || s->dense.count(name)) return -2;
+  s->dense[name] = std::move(t);
+  return 0;
+}
+
+int ps_native_server_port(void* h) {
+  auto* s = static_cast<Server*>(h);
+  return s ? s->port : -1;
+}
+
+void ps_native_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  if (!s) return;
+  s->stop = true;
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {  // wake barrier waiters so their handlers can exit
+    std::lock_guard<std::mutex> lk(s->bar_mu);
+    s->bar_cv.notify_all();
+  }
+  std::unique_lock<std::mutex> lk(s->conn_mu);
+  for (auto& kv : s->conns) ::shutdown(kv.first, SHUT_RDWR);
+  bool drained = s->conn_cv.wait_for(lk, std::chrono::seconds(5),
+                                     [&] { return s->conns.empty(); });
+  lk.unlock();
+  if (!drained) return;  // leak rather than free under a live handler
+  delete s;
+}
+
+}  // extern "C"

@@ -33,7 +33,7 @@ _lock = threading.Lock()
 def _sources():
     return [os.path.join(_CSRC, f)
             for f in ("tcpstore.cpp", "runtime.cpp", "predict_capi.cpp",
-                      "crypto.cpp")]
+                      "crypto.cpp", "ps_server.cpp")]
 
 
 def _src_hash() -> str:
@@ -118,6 +118,20 @@ def _load():
         lib.batch_assemble.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_void_p),
                                        ctypes.c_int64, ctypes.c_int64]
+        lib.ps_native_server_start.restype = ctypes.c_void_p
+        lib.ps_native_server_start.argtypes = [ctypes.c_int,
+                                               ctypes.POINTER(ctypes.c_int)]
+        lib.ps_native_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ps_native_server_port.restype = ctypes.c_int
+        lib.ps_native_server_port.argtypes = [ctypes.c_void_p]
+        lib.ps_native_add_sparse.restype = ctypes.c_int
+        lib.ps_native_add_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_float, ctypes.c_float, ctypes.c_longlong]
+        lib.ps_native_add_dense.restype = ctypes.c_int
+        lib.ps_native_add_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_float, ctypes.c_longlong, ctypes.c_longlong]
         _lib = lib
         return _lib
 

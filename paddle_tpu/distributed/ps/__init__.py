@@ -20,6 +20,7 @@ import numpy as np
 
 from .table import DenseTable, SparseTable  # noqa: F401
 from .service import Communicator, PsClient, PsServer  # noqa: F401
+from .native import NativePsServer  # noqa: F401
 
 
 class PsContext:

@@ -1,0 +1,100 @@
+"""Native (C++) PS server facade.
+
+Reference parity: `ps/service/brpc_ps_server.cc` — the reference's PS data
+plane is native C++; this exposes `csrc/ps_server.cpp` (same wire protocol
+as the python `PsServer`) through the ctypes bridge. A cluster may mix
+python and native servers; the python `PsClient` drives both unchanged.
+
+Scope: the high-QPS data plane (SGD sparse/dense tables, barrier, error
+frames). Rich table features — adam/adagrad slots, CTR accessor, TTL
+shrink, SSD spill, save/load — live in the python tier (`service.PsServer`),
+which remains the full-featured server.
+"""
+from __future__ import annotations
+
+import ctypes
+
+from ... import _native
+from .table import dense_shard_range
+
+
+class NativePsServer:
+    """C++ parameter server bound to 127.0.0.1:<port> (0 = ephemeral)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        if host not in ("127.0.0.1", "localhost"):
+            raise ValueError(
+                "NativePsServer binds loopback only for now; front a "
+                f"non-loopback host ({host!r}) with the python PsServer")
+        lib = _native._load()
+        if not lib:
+            raise RuntimeError(
+                "native PS server requires the C++ toolchain (g++); "
+                "use distributed.ps.PsServer (python) instead")
+        self._lib = lib
+        import threading
+        self._stopped = threading.Event()
+        out_port = ctypes.c_int(0)
+        self._h = lib.ps_native_server_start(int(port),
+                                             ctypes.byref(out_port))
+        if not self._h:
+            raise RuntimeError("native PS server failed to bind")
+        self.host = host
+        self.port = int(out_port.value)
+
+    def add_sparse_table(self, name: str, dim: int, lr: float = 0.01,
+                         init_std: float = 0.01, seed: int = 0,
+                         optimizer: str = "sgd"):
+        if optimizer != "sgd":
+            raise NotImplementedError(
+                "the native data plane ships SGD tables; richer optimizers "
+                "live in the python PsServer")
+        rc = self._lib.ps_native_add_sparse(
+            self._h, name.encode(), int(dim), float(lr), float(init_std),
+            int(seed))
+        if rc == -2:
+            raise ValueError(f"table {name!r} already registered")
+        if rc != 0:
+            raise ValueError(f"add_sparse_table({name!r}) failed")
+
+    def add_dense_table(self, name: str, shape, lr: float = 0.01,
+                        shard=None, optimizer: str = "sgd"):
+        if optimizer != "sgd":
+            raise NotImplementedError(
+                "the native data plane ships SGD tables; richer optimizers "
+                "live in the python PsServer")
+        import numpy as np
+        total = int(np.prod(shape))
+        if shard is not None:
+            i, n = shard
+            if not 0 <= i < n:
+                raise ValueError(f"dense shard index {i} out of range for "
+                                 f"{n} shards")
+            lo, hi = dense_shard_range(total, i, n)
+        else:
+            lo, hi = 0, total
+        rc = self._lib.ps_native_add_dense(
+            self._h, name.encode(), hi - lo, float(lr), lo, total)
+        if rc == -2:
+            raise ValueError(f"table {name!r} already registered")
+        if rc != 0:
+            raise ValueError(f"add_dense_table({name!r}) failed")
+
+    def run(self, block: bool = False):
+        # the accept loop starts at construction; block=True keeps the
+        # caller alive until stop() (python PsServer.run parity)
+        if block:
+            self._stopped.wait()
+        return self
+
+    def stop(self):
+        if self._h:
+            self._lib.ps_native_server_stop(self._h)
+            self._h = None
+        self._stopped.set()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
