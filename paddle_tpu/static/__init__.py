@@ -13,6 +13,8 @@ _STATIC_MODE = [False]
 from ..jit.input_spec import InputSpec  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from .program import Program, default_main_program  # noqa: E402,F401
+from . import passes  # noqa: E402,F401
+from .passes import apply_pass, list_passes, register_pass  # noqa: E402,F401
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):

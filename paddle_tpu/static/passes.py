@@ -1,0 +1,195 @@
+"""User-extensible program passes.
+
+Reference parity: the IR pass framework (`paddle/fluid/framework/ir/pass.h`,
+`PassRegistry`) — the reference rewrites Program/SSA graphs with named,
+registered passes (fuse_*, memory_optimize, ...).
+
+TPU-first redesign: XLA already owns low-level rewriting (fusion, layout,
+DCE), so the surviving extension point is the FUNCTION level, where jax is
+natively composable. A pass is `Callable[[fn], fn]`; it can be a simple
+wrapper (remat, precision casts) or a jaxpr REINTERPRETER that substitutes
+chosen primitives (`make_op_rewrite_pass` — the fuse-pass role: swap an op
+cluster for a custom kernel). `Program.apply_pass(name)` re-lowers through
+the transformed function, so introspection (`ops()`, `op_histogram()`)
+sees the rewritten program.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jex_core
+
+__all__ = ["register_pass", "get_pass", "list_passes", "apply_pass",
+           "make_op_rewrite_pass"]
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str, pass_fn: Callable = None):
+    """Register a function-to-function transform under `name`.
+
+    Usable directly or as a decorator::
+
+        @register_pass("my_pass")
+        def my_pass(fn):
+            def wrapped(*args):
+                return fn(*args)
+            return wrapped
+    """
+    if callable(name):
+        raise TypeError(
+            "register_pass needs a name: use @register_pass(\"my_pass\")")
+    if pass_fn is None:
+        def deco(f):
+            _REGISTRY[name] = f
+            return f
+        return deco
+    _REGISTRY[name] = pass_fn
+    return pass_fn
+
+
+def get_pass(name: str) -> Callable:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown pass {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_passes():
+    return sorted(_REGISTRY)
+
+
+def apply_pass(program, name: str, **options):
+    """Return a NEW Program with the named pass applied to its function."""
+    from .program import Program
+    p = get_pass(name)
+    new_fn = p(program._fn, **options)
+    return Program.from_callable(new_fn, program._arg_specs,
+                                 name=f"{program.name}+{name}")
+
+
+# ---- jaxpr reinterpretation: the op-rewrite (fuse-pass) mechanism ----
+
+def _call_impl(impl, invals, params):
+    """Invoke a rewrite impl with only the eqn params its signature takes
+    (primitives carry params like `accuracy` that impls rarely care about;
+    a **kwargs impl still receives everything)."""
+    import inspect
+    try:
+        sig = inspect.signature(impl)
+    except (TypeError, ValueError):
+        return impl(*invals)
+    if any(p.kind == p.VAR_KEYWORD for p in sig.parameters.values()):
+        return impl(*invals, **params)
+    keep = {k: v for k, v in params.items() if k in sig.parameters}
+    return impl(*invals, **keep)
+
+
+_warned_regions = set()
+
+
+def _warn_if_skipped_region(eqn, rewrites):
+    """Control-flow bodies (scan/while/cond) are not reinterpreted; warn
+    once per primitive when they contain an op the user asked to rewrite,
+    instead of silently leaving it in place."""
+    import warnings
+
+    def sub_jaxprs(params):
+        for v in params.values():
+            for c in (v if isinstance(v, (tuple, list)) else (v,)):
+                if hasattr(c, "jaxpr"):
+                    yield c.jaxpr
+                elif hasattr(c, "eqns"):
+                    yield c
+
+    for sub in sub_jaxprs(eqn.params):
+        for inner in sub.eqns:
+            if inner.primitive.name in rewrites:
+                key = (eqn.primitive.name, inner.primitive.name)
+                if key not in _warned_regions:
+                    _warned_regions.add(key)
+                    warnings.warn(
+                        f"op-rewrite pass: '{inner.primitive.name}' inside "
+                        f"a '{eqn.primitive.name}' body is NOT rewritten "
+                        "(control-flow regions are executed as-is)")
+
+
+def _eval_with_rewrites(jaxpr, consts, rewrites, *args):
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, jex_core.Literal) else env[v]
+
+    def write(v, val):
+        env[v] = val
+
+    for v, c in zip(jaxpr.constvars, consts):
+        write(v, c)
+    for v, a in zip(jaxpr.invars, args):
+        write(v, a)
+    for eqn in jaxpr.eqns:
+        invals = [read(v) for v in eqn.invars]
+        prim = eqn.primitive.name
+        if prim in rewrites:
+            out = _call_impl(rewrites[prim], invals, eqn.params)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        elif "jaxpr" in eqn.params and prim not in ("scan", "while", "cond"):
+            # recurse into single-body regions (pjit/jit, remat/checkpoint,
+            # closed_call, ...) so rewrites apply inside them too
+            inner = eqn.params["jaxpr"]
+            if hasattr(inner, "jaxpr"):        # ClosedJaxpr
+                sub, consts_ = inner.jaxpr, inner.consts
+            else:                              # plain Jaxpr (remat)
+                sub, consts_ = inner, ()
+            outs = _eval_with_rewrites(sub, consts_, rewrites, *invals)
+        else:
+            _warn_if_skipped_region(eqn, rewrites)
+            outs = eqn.primitive.bind(*invals, **eqn.params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+        for v, o in zip(eqn.outvars, outs):
+            write(v, o)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def make_op_rewrite_pass(rewrites: Dict[str, Callable]) -> Callable:
+    """Build a pass substituting jax primitives by name.
+
+    `rewrites` maps primitive names (see `Program.op_histogram()`) to
+    replacement callables invoked as `impl(*invals, **eqn_params)` — the
+    reference's fuse-pass role (swap an op for a bespoke kernel)."""
+
+    def pass_fn(fn):
+        def rewritten(*args):
+            closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+            out = _eval_with_rewrites(closed.jaxpr, closed.consts, rewrites,
+                                      *args)
+            # restore the original fn's output PYTREE, not just arity
+            treedef = jax.tree_util.tree_structure(out_shape)
+            return jax.tree_util.tree_unflatten(treedef, out)
+        return rewritten
+
+    return pass_fn
+
+
+# ---- builtin passes (reference pass-library counterparts) ----
+
+@register_pass("remat")
+def _remat_pass(fn):
+    """Whole-program rematerialization (`memory_optimize_pass` role):
+    backward recomputes instead of saving residuals."""
+    return jax.checkpoint(fn)
+
+
+@register_pass("bf16_io")
+def _bf16_io_pass(fn):
+    """Cast floating inputs to bf16 before the body (fuse_bf16 role)."""
+    def wrapped(*args):
+        cast = [a.astype(jnp.bfloat16)
+                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype,
+                                                          jnp.floating)
+                else a for a in args]
+        return fn(*cast)
+    return wrapped

@@ -144,6 +144,13 @@ class Program:
 
         return Program(pruned, self._arg_specs, name=f"{self.name}_pruned")
 
+    # ---- pass hook (framework/ir PassRegistry role) ----
+    def apply_pass(self, name: str, **options) -> "Program":
+        """Apply a registered program pass; returns a NEW Program
+        (`static.passes.register_pass` is the extension point)."""
+        from .passes import apply_pass
+        return apply_pass(self, name, **options)
+
     # ---- execution ----
     def compile(self):
         if self._compiled is None:

@@ -97,3 +97,155 @@ def test_from_callable_and_repr():
 def test_startup_program_empty():
     sp = static.default_startup_program()
     assert sp.name == "startup"
+
+
+class TestPassFramework:
+    """User-extensible pass hook (framework/ir PassRegistry role)."""
+
+    def _prog(self):
+        import jax.numpy as jnp
+        from paddle_tpu.static import Program
+
+        def f(x, y):
+            return jnp.tanh(x @ y).sum()
+
+        import jax
+        specs = [jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                 jax.ShapeDtypeStruct((8, 4), jnp.float32)]
+        return Program.from_callable(f, specs)
+
+    def test_op_rewrite_pass_substitutes_primitive(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.static import passes
+
+        # fuse-pass role: swap tanh for a rational approximation
+        rewrite = passes.make_op_rewrite_pass(
+            {"tanh": lambda x: x / (1.0 + jnp.abs(x))})
+        passes.register_pass("softsign_for_tanh", rewrite)
+        prog = self._prog()
+        new = prog.apply_pass("softsign_for_tanh")
+        assert prog.has_op("tanh") and not new.has_op("tanh")
+        x = np.random.RandomState(0).randn(4, 8).astype("float32")
+        y = np.random.RandomState(1).randn(8, 4).astype("float32")
+        got = new.run(x, y)
+        want = (x @ y) / (1.0 + np.abs(x @ y))
+        np.testing.assert_allclose(np.asarray(got), want.sum(), rtol=1e-5)
+
+    def test_rewrite_reaches_nested_jit(self):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static import Program, passes
+
+        inner = jax.jit(lambda x: jnp.tanh(x))
+
+        def f(x):
+            return inner(x).sum()
+
+        prog = Program.from_callable(
+            f, [jax.ShapeDtypeStruct((8,), jnp.float32)])
+        rewrite = passes.make_op_rewrite_pass({"tanh": lambda x: x * 2.0})
+        passes.register_pass("tanh2x", rewrite)
+        new = prog.apply_pass("tanh2x")
+        x = np.ones(8, "float32")
+        np.testing.assert_allclose(np.asarray(new.run(x)), 16.0)
+
+    def test_builtin_remat_and_bf16_passes(self):
+        import numpy as np
+        prog = self._prog()
+        x = np.random.RandomState(2).randn(4, 8).astype("float32")
+        y = np.random.RandomState(3).randn(8, 4).astype("float32")
+        base = float(np.asarray(prog.run(x, y)))
+        re = prog.apply_pass("remat")
+        np.testing.assert_allclose(float(np.asarray(re.run(x, y))), base,
+                                   rtol=1e-6)
+        bf = prog.apply_pass("bf16_io")
+        assert abs(float(np.asarray(bf.run(x, y))) - base) < 0.3
+        # the cast pass must actually materialize dtype converts
+        assert any("convert" in op for op in bf.op_histogram())
+
+    def test_unknown_pass_raises_with_listing(self):
+        import pytest
+        from paddle_tpu.static import list_passes
+        prog = self._prog()
+        with pytest.raises(KeyError, match="registered"):
+            prog.apply_pass("nope")
+        assert "remat" in list_passes() and "bf16_io" in list_passes()
+
+    def test_decorator_registration_and_compose(self):
+        import numpy as np
+        from paddle_tpu.static import passes
+
+        @passes.register_pass("scale_out")
+        def scale_out(fn, factor=2.0):
+            def wrapped(*args):
+                return fn(*args) * factor
+            return wrapped
+
+        prog = self._prog()
+        x = np.random.RandomState(4).randn(4, 8).astype("float32")
+        y = np.random.RandomState(5).randn(8, 4).astype("float32")
+        base = float(np.asarray(prog.run(x, y)))
+        doubled = prog.apply_pass("scale_out")
+        np.testing.assert_allclose(float(np.asarray(doubled.run(x, y))),
+                                   2 * base, rtol=1e-6)
+        quad = doubled.apply_pass("scale_out")          # passes compose
+        np.testing.assert_allclose(float(np.asarray(quad.run(x, y))),
+                                   4 * base, rtol=1e-6)
+        opt = prog.apply_pass("scale_out", factor=3.0)  # options
+        np.testing.assert_allclose(float(np.asarray(opt.run(x, y))),
+                                   3 * base, rtol=1e-6)
+
+    def test_rewrite_preserves_pytree_and_composes_with_remat(self):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static import Program, passes
+
+        def f(x):
+            return {"y": jnp.tanh(x), "z": x + 1.0}
+
+        prog = Program.from_callable(
+            f, [jax.ShapeDtypeStruct((4,), jnp.float32)])
+        passes.register_pass("tanh_softsign", passes.make_op_rewrite_pass(
+            {"tanh": lambda x: x / (1.0 + jnp.abs(x))}))
+        new = prog.apply_pass("tanh_softsign")
+        x = np.ones(4, "float32")
+        out = new._fn(jnp.asarray(x))
+        assert isinstance(out, dict) and set(out) == {"y", "z"}
+        np.testing.assert_allclose(np.asarray(out["y"]), 0.5)
+        # op-rewrite reaches inside a remat region (builtin pass compose)
+        rem = prog.apply_pass("remat").apply_pass("tanh_softsign")
+        assert not rem.has_op("tanh")
+
+    def test_bare_decorator_misuse_raises(self):
+        import pytest
+        from paddle_tpu.static import passes
+        with pytest.raises(TypeError, match="needs a name"):
+            @passes.register_pass
+            def oops(fn):
+                return fn
+
+    def test_scan_body_warns_not_silent(self):
+        import warnings
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.static import Program, passes
+
+        def f(x):
+            def body(c, _):
+                return jnp.tanh(c), None
+            out, _ = jax.lax.scan(body, x, None, length=3)
+            return out
+
+        prog = Program.from_callable(
+            f, [jax.ShapeDtypeStruct((4,), jnp.float32)])
+        passes.register_pass("tanh_id", passes.make_op_rewrite_pass(
+            {"tanh": lambda x: x}))
+        new = prog.apply_pass("tanh_id")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            new._fn(jnp.ones(4))
+            assert any("NOT rewritten" in str(x.message) for x in w)
