@@ -1,0 +1,247 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference parity: `python/paddle/fluid/layers/rnn.py` — Decoder (:790),
+BeamSearchDecoder (:866, _beam_search_step/gather_tree semantics),
+dynamic_decode (:1583, dygraph loop at :1340). Exposed as
+`paddle.nn.BeamSearchDecoder` / `paddle.nn.dynamic_decode` like the
+reference's 2.x surface.
+
+TPU-first notes: each decode step is a handful of fused device ops
+(cell step + log_softmax + masked top-k + beam gathers) driven by an eager
+host loop with a device-side `finished` reduction as the stop predicate —
+the reference's dygraph path, with the per-step math batched as
+[batch*beam, ...] so the MXU sees one matmul per step regardless of beam
+width. The backtrace (`gather_tree`) runs on host at finalize.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._dispatch import ensure_tensor, run_op
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+_BeamState = namedtuple("_BeamState",
+                        ["cell_states", "log_probs", "finished", "lengths"])
+_BeamOutput = namedtuple("_BeamOutput", ["scores", "predicted_ids",
+                                         "parent_ids"])
+
+
+class Decoder:
+    """Abstract decode contract (reference Decoder, rnn.py:790)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def gather_tree(step_ids, parent_ids):
+    """Backtrace beam parents: [T, batch, beam] ids + parents -> the full
+    sequences per surviving beam (reference nn.gather_tree op)."""
+    ids = np.asarray(step_ids)
+    parents = np.asarray(parent_ids)
+    T, B, W = ids.shape
+    out = np.zeros_like(ids)
+    for b in range(B):
+        for w in range(W):
+            parent = w
+            for t in range(T - 1, -1, -1):
+                out[t, b, w] = ids[t, b, parent]
+                parent = int(parents[t, b, parent])
+    return out
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding over an RNN cell (reference rnn.py:866).
+
+    The cell's inputs/states ride as [batch * beam_size, ...]; any other
+    per-batch tensor used inside the cell (e.g. attention memory) must be
+    tiled with `tile_beam_merge_with_batch` first.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # ---- beam/batch layout helpers ----
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch * beam_size, ...] (repeat per beam)."""
+        x = ensure_tensor(x)
+        return run_op(lambda a: jnp.repeat(a, beam_size, axis=0), [x],
+                      "tile_beam")
+
+    def _map_states(self, states, fn):
+        if isinstance(states, (tuple, list)):
+            return tuple(self._map_states(s, fn) for s in states)
+        return fn(ensure_tensor(states))
+
+    # ---- Decoder interface ----
+    def initialize(self, initial_cell_states):
+        states = self._map_states(
+            initial_cell_states,
+            lambda s: self.tile_beam_merge_with_batch(s, self.beam_size))
+        first = initial_cell_states
+        while isinstance(first, (tuple, list)):
+            first = first[0]
+        batch = ensure_tensor(first).shape[0]
+        W = self.beam_size
+        # only beam 0 is live initially, or every beam would decode the
+        # same argmax path (reference kInfinite init)
+        log_probs = np.full((batch, W), -1e9, np.float32)
+        log_probs[:, 0] = 0.0
+        start = np.full((batch * W,), self.start_token, np.int64)
+        ids = Tensor(jnp.asarray(start))
+        inputs = self.embedding_fn(ids) if self.embedding_fn else ids
+        state = _BeamState(cell_states=states,
+                           log_probs=jnp.asarray(log_probs),
+                           finished=np.zeros((batch, W), bool),
+                           lengths=np.zeros((batch, W), np.int64))
+        return inputs, state, state.finished.copy()
+
+    def step(self, time, inputs, states: _BeamState, **kwargs):
+        W = self.beam_size
+        cell_out, next_cell = self.cell(inputs, states.cell_states, **kwargs)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        logits = ensure_tensor(logits)
+        V = logits.shape[-1]
+        finished = states.finished                       # host [batch, W]
+        fin_j = jnp.asarray(finished)
+        log_probs_prev = states.log_probs               # [batch, W]
+
+        def score_fn(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1).reshape(-1, W, V)
+            # finished beams emit ONLY end_token at probability 1, so their
+            # score is carried unchanged (reference noend_mask_tensor)
+            mask = jnp.full((V,), -1e9, lp.dtype).at[self.end_token].set(0.0)
+            lp = jnp.where(fin_j[:, :, None], mask[None, None, :], lp)
+            return log_probs_prev[:, :, None] + lp      # [batch, W, V]
+
+        scores = score_fn(logits._value)                 # device
+        flat = scores.reshape(scores.shape[0], W * V)
+        top_scores, top_idx = jax.lax.top_k(flat, W)
+
+        # host copies for bookkeeping/backtrace (int64 on the numpy side:
+        # device int64 truncates to int32 without jax_enable_x64)
+        idx_np = np.asarray(top_idx).astype(np.int64)
+        beam_np = idx_np // V
+        tok_np = idx_np % V
+        fin_gathered = np.take_along_axis(finished, beam_np, axis=1)
+        len_gathered = np.take_along_axis(states.lengths, beam_np, axis=1)
+        next_finished = fin_gathered | (tok_np == self.end_token)
+        next_lengths = len_gathered + (~fin_gathered).astype(np.int64)
+
+        # gather cell states along the beam axis
+        batch = beam_np.shape[0]
+        flat_sel = (np.arange(batch)[:, None] * W + beam_np).reshape(-1)
+        sel = jnp.asarray(flat_sel)
+
+        sel_t = Tensor(sel)
+
+        def gather_state(s):
+            # index rides as a positional input (an array-valued closure
+            # would defeat the eager dispatch cache — see nn/layer/rnn.py)
+            return run_op(lambda a, i: a[i], [s, sel_t], "gather_beam")
+
+        next_cell = self._map_states(next_cell, gather_state)
+
+        next_ids = Tensor(jnp.asarray(tok_np.reshape(-1)))
+        next_inputs = self.embedding_fn(next_ids) if self.embedding_fn \
+            else next_ids
+        out = _BeamOutput(scores=np.asarray(top_scores),
+                          predicted_ids=tok_np, parent_ids=beam_np)
+        next_state = _BeamState(cell_states=next_cell,
+                                log_probs=top_scores,
+                                finished=next_finished,
+                                lengths=next_lengths)
+        return out, next_state, next_inputs, next_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        step_ids = np.stack([o.predicted_ids for o in outputs])   # [T,B,W]
+        parents = np.stack([o.parent_ids for o in outputs])
+        predicted = gather_tree(step_ids, parents)
+        return Tensor(jnp.asarray(predicted)), final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def _default_stack(outputs):
+    """Stack per-step outputs time-major when the decoder has no finalize:
+    arrays stack to [T, ...]; namedtuple outputs stack per field."""
+    first = outputs[0]
+    if hasattr(first, "_fields"):  # namedtuple of arrays
+        return type(first)(*(Tensor(jnp.stack(
+            [jnp.asarray(getattr(o, f)) for o in outputs]))
+            for f in first._fields))
+    return Tensor(jnp.stack([jnp.asarray(
+        o._value if isinstance(o, Tensor) else o) for o in outputs]))
+
+
+def _time_to_batch_major(x):
+    if isinstance(x, Tensor) or hasattr(x, "shape"):
+        return run_op(lambda a: jnp.moveaxis(a, 0, 1), [ensure_tensor(x)],
+                      "transpose")
+    if hasattr(x, "_fields"):
+        return type(x)(*(_time_to_batch_major(v) for v in x))
+    return x
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Step `decoder` until every sequence finishes or `max_step_num`.
+
+    Returns (outputs, final_states) — plus sequence_lengths when
+    `return_length` (reference dynamic_decode, rnn.py:1583). `is_test` is
+    accepted for API parity (eager decode keeps no training state)."""
+    if impute_finished and not decoder.tracks_own_finished:
+        raise NotImplementedError(
+            "impute_finished=True needs finished-state rectification for "
+            "this decoder; implement tracks_own_finished (as "
+            "BeamSearchDecoder does) or decode without imputation")
+    inputs, states, finished = decoder.initialize(inits)
+    seq_lengths = np.zeros(np.shape(finished), np.int64)
+    outputs = []
+    step = 0
+    while not bool(np.all(finished)):
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        if not decoder.tracks_own_finished:
+            seq_lengths += ~np.asarray(finished)
+        outputs.append(out)
+        step += 1
+        if max_step_num is not None and step >= max_step_num:
+            break
+    lengths = getattr(states, "lengths", seq_lengths)
+    try:
+        final_outputs, final_states = decoder.finalize(outputs, states,
+                                                       lengths)
+    except NotImplementedError:
+        final_outputs, final_states = _default_stack(outputs), states
+    if not output_time_major:
+        final_outputs = _time_to_batch_major(final_outputs)
+    if return_length:
+        return final_outputs, final_states, Tensor(jnp.asarray(lengths))
+    return final_outputs, final_states
+

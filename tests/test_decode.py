@@ -1,0 +1,169 @@
+"""BeamSearchDecoder + dynamic_decode (reference fluid/layers/rnn.py:866,
+:1583): brute-force oracle on a toy deterministic LM, finishing/length
+semantics, gather_tree backtrace, GRU/LSTM cells."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.decode import gather_tree
+
+
+class BiasCell(nn.RNNCellBase):
+    """Stateless 'LM': logits depend only on a fixed bias table over the
+    previous token — makes exact enumeration trivial."""
+
+    def __init__(self, table):
+        super().__init__()
+        self.table = paddle.to_tensor(table)  # [V, V] row=prev tok -> logits
+
+    @property
+    def state_shape(self):
+        return (1,)
+
+    def forward(self, ids, states):
+        rows = paddle.index_select(self.table, ids.astype("int64"), axis=0)
+        return rows, states
+
+
+def brute_force_beam(table, start, end, beam, steps):
+    """Exhaustive beam search oracle (tracks the same scoring rules)."""
+    V = table.shape[1]
+    logp = np.log(np.exp(table) / np.exp(table).sum(-1, keepdims=True))
+    beams = [((), start, 0.0, False)]  # (seq, last, score, finished)
+    for _ in range(steps):
+        cand = []
+        for seq, last, score, fin in beams:
+            if fin:
+                cand.append((seq + (end,), last, score, True))
+                continue
+            for v in range(V):
+                cand.append((seq + (v,), v, score + logp[last, v], v == end))
+        cand.sort(key=lambda c: -c[2])
+        beams = cand[:beam]
+        if all(c[3] for c in beams):
+            break
+    return beams
+
+
+class TestBeamSearch:
+    def _table(self):
+        rng = np.random.RandomState(0)
+        return rng.randn(6, 6).astype("float32") * 2.0
+
+    def test_matches_brute_force_oracle(self):
+        table = self._table()
+        cell = BiasCell(table)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=5,
+                                   beam_size=3)
+        init = paddle.to_tensor(np.zeros((1, 1), "float32"))
+        out, state, lengths = nn.dynamic_decode(dec, inits=init,
+                                                max_step_num=4,
+                                                return_length=True)
+        got = np.asarray(out.numpy())[0]          # [T, beam]
+        want = brute_force_beam(table, 0, 5, 3, 4)
+        for w in range(3):
+            seq = tuple(got[:, w][:int(np.asarray(lengths.numpy())[0, w])
+                                  + (1 if 5 in got[:, w] else 0)])
+            # the oracle's w-th best prefix must match the decoded beam
+            want_seq = want[w][0][:len(seq)]
+            assert tuple(want_seq) == seq, (w, seq, want[w])
+
+    def test_all_sequences_reach_end_token(self):
+        # a table where end (tok 5) dominates: everything finishes fast
+        table = np.full((6, 6), -5.0, "float32")
+        table[:, 5] = 5.0
+        cell = BiasCell(table)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=5,
+                                   beam_size=2)
+        init = paddle.to_tensor(np.zeros((3, 1), "float32"))
+        out, state, lengths = nn.dynamic_decode(dec, inits=init,
+                                                max_step_num=10,
+                                                return_length=True)
+        o = np.asarray(out.numpy())
+        ln = np.asarray(lengths.numpy())
+        assert o.shape[1] <= 3                      # stopped early
+        # best beam emits <end> immediately; the runner-up explores one
+        # extra token first (a genuinely different sequence), then ends
+        assert (ln[:, 0] == 1).all() and (o[:, 0, 0] == 5).all()
+        assert (o[np.arange(o.shape[0]), ln[:, 1] - 1, 1] == 5).all()
+
+    def test_gru_cell_end_to_end(self):
+        paddle.seed(0)
+        emb = nn.Embedding(10, 8)
+        cell = nn.GRUCell(8, 8)
+        proj = nn.Linear(8, 10)
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                   beam_size=4, embedding_fn=emb,
+                                   output_fn=proj)
+        enc = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+        out, state, lengths = nn.dynamic_decode(dec, inits=enc,
+                                                max_step_num=6,
+                                                return_length=True)
+        o = np.asarray(out.numpy())
+        assert o.shape[0] == 2 and o.shape[2] == 4 and o.shape[1] <= 6
+        assert (np.asarray(lengths.numpy()) >= 1).all()
+
+    def test_lstm_tuple_states(self):
+        paddle.seed(1)
+        emb = nn.Embedding(10, 8)
+        cell = nn.LSTMCell(8, 8)
+        proj = nn.Linear(8, 10)
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        h = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+        c = paddle.to_tensor(np.zeros((2, 8), "float32"))
+        out, final = nn.dynamic_decode(dec, inits=(h, c), max_step_num=5)
+        assert np.asarray(out.numpy()).shape[2] == 3
+        fh, fc = final.cell_states        # tuple state survives the gathers
+        assert tuple(fh.shape) == (6, 8) and tuple(fc.shape) == (6, 8)
+
+    def test_gather_tree_backtrace(self):
+        # hand-built 2-step tree: step1 ids=[a,b], step2 picks parents [1,0]
+        ids = np.array([[[3, 4]], [[5, 6]]])       # [T=2, B=1, W=2]
+        parents = np.array([[[0, 0]], [[1, 0]]])
+        out = gather_tree(ids, parents)
+        # beam0 at t2 came from parent 1 -> its t1 token is 4
+        assert out[0, 0, 0] == 4 and out[1, 0, 0] == 5
+        assert out[0, 0, 1] == 3 and out[1, 0, 1] == 6
+
+    def test_tile_beam_merge_with_batch(self):
+        x = paddle.to_tensor(np.arange(4, dtype="float32").reshape(2, 2))
+        t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 3)
+        assert tuple(t.shape) == (6, 2)
+        np.testing.assert_allclose(t.numpy()[:3], np.tile(x.numpy()[0], (3, 1)))
+
+    def test_custom_decoder_without_finalize(self):
+        # reference contract: finalize is optional; outputs stack by default
+        class Greedy(nn.Decoder):
+            def __init__(self, table):
+                self.table = np.asarray(table)
+
+            def initialize(self, inits):
+                b = inits.shape[0]
+                return (paddle.to_tensor(np.zeros(b, "int64")),
+                        np.zeros(b, "int64"),
+                        np.zeros(b, bool))
+
+            def step(self, time, inputs, states, **kw):
+                ids = np.asarray(inputs.numpy()).astype(int)
+                nxt = self.table[ids].argmax(-1)
+                fin = nxt == 5
+                return (paddle.to_tensor(nxt), nxt,
+                        paddle.to_tensor(nxt), fin)
+
+        table = np.full((6, 6), -5.0, "float32")
+        table[:, 5] = 5.0
+        out, final, lengths = nn.dynamic_decode(
+            Greedy(table), inits=np.zeros((3, 1), "float32"),
+            max_step_num=4, return_length=True)
+        assert tuple(out.shape) == (3, 1)            # finished in one step
+        assert (np.asarray(lengths.numpy()) == 0).all()  # all finished at t0
+
+    def test_impute_finished_guarded_for_custom_decoders(self):
+        class Dummy(nn.Decoder):
+            pass
+
+        with pytest.raises(NotImplementedError, match="impute_finished"):
+            nn.dynamic_decode(Dummy(), impute_finished=True)
