@@ -229,6 +229,17 @@ class ErnieScanStack(nn.Layer):
 
     def __init__(self, hidden_size, num_heads, intermediate_size, n_layers,
                  remat=True, causal=False):
+        """remat: False = no rematerialization; True = blanket per-layer
+        remat (save only layer boundaries — minimum memory, the choice for
+        HBM-bound pp-stage configs, tests/test_titan_feasibility.py);
+        "dots" = selective checkpoint policy (save MXU/dot outputs +
+        the flash-attention output, recompute elementwise+norm only —
+        the reference recompute meta-optimizer's selective `checkpoints=`
+        contract, fleet/meta_optimizers/recompute_optimizer.py, mapped to
+        jax.checkpoint_policies). Blanket remat recomputes the expensive
+        matmuls too and caps useful-FLOP fraction near 0.75; "dots" trades
+        ~10*h bytes/token/layer of HBM to keep the MXU work single-pass.
+        """
         super().__init__()
         import math as _math
         h, ffn, L = hidden_size, intermediate_size, n_layers
@@ -275,11 +286,15 @@ class ErnieScanStack(nn.Layer):
         hd = H // nh
 
         def ln(v, g, b):
-            mu = jnp.mean(v, -1, keepdims=True)
-            var = jnp.var(v, -1, keepdims=True)
+            # statistics in fp32 (bf16 mean/var over h=4096 loses ~3 bits),
+            # result back in the residual dtype so the scan carry is stable
+            v32 = v.astype(jnp.float32)
+            mu = jnp.mean(v32, -1, keepdims=True)
+            var = jnp.var(v32, -1, keepdims=True)
             # eps matches nn.LayerNorm's default so scan-stack and unrolled
             # ErnieLayer checkpoints are interchangeable
-            return (v - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+            n = ((v32 - mu) * jax.lax.rsqrt(var + 1e-5)).astype(v.dtype)
+            return n * g + b
 
         qkv = x @ qkv_w + qkv_b
         q, k_, v = jnp.split(qkv, 3, axis=-1)
@@ -288,6 +303,11 @@ class ErnieScanStack(nn.Layer):
         v = v.reshape(B, S, nh, hd)
         from ..kernels.flash_attention import flash_attention_arrays
         o = flash_attention_arrays(q, k_, v, causal=self.causal)
+        # named save point for the selective remat policy: the pallas
+        # flash output is not a lax dot, so dots_saveable alone would
+        # recompute the whole attention in the backward pass
+        from jax.ad_checkpoint import checkpoint_name
+        o = checkpoint_name(o, "flash_attn_out")
         o = o.reshape(B, S, H) @ proj_w + proj_b
         x = ln(x + o, ln1_g, ln1_b)
         m = jax.nn.gelu(x @ fc1_w + fc1_b, approximate=False) @ fc2_w + fc2_b
@@ -296,17 +316,37 @@ class ErnieScanStack(nn.Layer):
 
     def forward(self, x):
         from ..ops._dispatch import ensure_tensor, run_op
+        from ..amp.state import amp_enabled, amp_state
         import jax
+        import jax.numpy as jnp
         x = ensure_tensor(x)
         ws = [self.qkv_w, self.qkv_b, self.proj_w, self.proj_b,
               self.fc1_w, self.fc1_b, self.fc2_w, self.fc2_b,
               self.ln1_g, self.ln1_b, self.ln2_g, self.ln2_b]
         remat = self.remat
+        # _layer_fn is raw jnp, below the op-level autocast whitelist: an
+        # fp32 carry would silently promote every dot (and every saved
+        # residual) back to fp32. Capture the ambient AMP dtype at trace
+        # time and pin the scan carry + weights to it.
+        cdtype = jnp.dtype(amp_state().dtype) if amp_enabled() else None
 
         def f(xa, *warrs):
+            if cdtype is not None and xa.dtype != cdtype:
+                xa = xa.astype(cdtype)
+            if cdtype is not None:
+                warrs = tuple(
+                    w.astype(cdtype)
+                    if jnp.issubdtype(w.dtype, jnp.floating) else w
+                    for w in warrs)
             def body(carry, wl):
                 step = self._layer_fn
-                if remat:
+                if remat == "dots":
+                    pol = jax.checkpoint_policies.save_from_both_policies(
+                        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                        jax.checkpoint_policies.save_only_these_names(
+                            "flash_attn_out"))
+                    step = jax.checkpoint(step, policy=pol)
+                elif remat:
                     step = jax.checkpoint(step)
                 return step(carry, wl), None
 

@@ -17,5 +17,7 @@ from .loss import (  # noqa: F401
     nll_loss, binary_cross_entropy, binary_cross_entropy_with_logits, kl_div,
     margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
     triplet_margin_loss, square_error_cost, sigmoid_focal_loss, ctc_loss,
+    margin_cross_entropy,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
+from .vision import grid_sample, affine_grid, temporal_shift  # noqa: F401

@@ -271,3 +271,80 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     from ...ops.manipulation import pad as _pad
     return _pad(x, pad, mode, value, data_format)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC class-center sampling (arXiv:2010.05222).
+
+    Reference parity: `python/paddle/nn/functional/common.py:1636`
+    (class_center_sample over the `class_center_sample` op). Keeps every
+    positive class center in `label`, pads with uniformly sampled negative
+    centers up to `num_samples` (keeps all positives if there are more),
+    and remaps labels to indices into the sampled-center list.
+
+    TPU design: the sample runs HOST-SIDE on the [N] label vector (numpy) —
+    its output length is data-dependent (|positives| can exceed
+    num_samples), which has no stable jit shape, and the op runs once per
+    step on a tiny tensor; the downstream sharded matmul + 
+    margin_cross_entropy are the device work. Randomness draws from the
+    framework generator (core/random), so paddle.seed reproduces the
+    reference's seeded behavior. Multi-rank (PartialFC over mp): each rank
+    calls with its LOCAL num_classes; `rank_offset` positions follow the
+    reference's cumulative remap (labels map into the concatenation of all
+    ranks' sampled lists) via the parallel env when `group` is not None.
+    """
+    import numpy as _np
+    from ...core.tensor import Tensor as _T
+    from ...core import random as _rnd
+
+    lab = _np.asarray(label._value if isinstance(label, _T) else label)
+    lab = lab.reshape(-1).astype(_np.int64)
+    if num_samples > num_classes:
+        from ...core.enforce import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"Expected num_samples <= {num_classes}, got {num_samples}")
+
+    rank, nranks = 0, 1
+    if group is not None:
+        from ...parallel import env as _penv
+        rank = getattr(group, "rank", None)
+        if rank is None:
+            rank = _penv.get_rank() if hasattr(_penv, "get_rank") else 0
+        nranks = getattr(group, "nranks", 1) or 1
+
+    # One base seed drawn once, then every rank deterministically computes
+    # EVERY rank's sampled list (seed derived per rank). All ranks see the
+    # same (all-gathered) labels and the same generator state under
+    # paddle.seed, so the lists — and therefore the cumulative remap —
+    # agree everywhere without a count exchange. (Positions must account
+    # for each rank's negatives too: a negative can sort before a
+    # positive, so positions inside the FULL sampled list are required.)
+    import jax as _jax
+    seed_arr = _np.asarray(
+        _jax.random.key_data(_rnd.default_generator().next_key()))
+    base_seed = int(seed_arr.reshape(-1)[-1]) % (2 ** 31)
+
+    def _rank_sample(r):
+        rlo = r * num_classes
+        pos = _np.unique(lab[(lab >= rlo) & (lab < rlo + num_classes)]) - rlo
+        n_neg = max(0, num_samples - len(pos))
+        if n_neg == 0:
+            return pos
+        rng = _np.random.RandomState((base_seed + r) % (2 ** 31))
+        negatives = _np.setdiff1d(_np.arange(num_classes, dtype=_np.int64),
+                                  pos, assume_unique=True)
+        picked = rng.choice(negatives, size=n_neg, replace=False)
+        return _np.sort(_np.concatenate([pos, picked]))
+
+    all_sampled = [_rank_sample(r) for r in range(nranks)]
+    offsets = _np.cumsum([0] + [len(s) for s in all_sampled])
+    sampled = all_sampled[rank]
+
+    remapped = _np.zeros_like(lab)
+    for r in range(nranks):
+        rlo = r * num_classes
+        sel = (lab >= rlo) & (lab < rlo + num_classes)
+        if sel.any():
+            remapped[sel] = offsets[r] + _np.searchsorted(
+                all_sampled[r], lab[sel] - rlo)
+    return _T(remapped), _T(sampled + (rank * num_classes if nranks > 1 else 0))

@@ -332,3 +332,80 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     label_lengths_v = label_lengths._value if isinstance(
         label_lengths, Tensor) else jnp.asarray(label_lengths)
     return run_op(f, [log_probs], "ctc_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax CE, model-parallel native.
+
+    Reference parity: `python/paddle/nn/functional/loss.py:1107`
+    (margin_cross_entropy over `c_margin_cross_entropy` CUDA op). logits are
+    cosines (normalized feature x normalized class centers), [N, C_local].
+    The target logit becomes cos(m1*theta + m2) - m3, everything scales by
+    `scale`, then softmax CE.
+
+    TPU design: under the manual-collective mp regime (shard_map over the
+    'mp' axis) the class dimension stays sharded — exactly one pmax (row
+    max) and two psums (denominator, picked target logit) cross the mesh,
+    the ParallelCrossEntropy pattern; each rank applies the margin only to
+    targets it owns (equal class shards per rank). Outside mp it is the
+    single-chip op. Gradients flow through the arccos margin transform via
+    autodiff (the reference kernel hand-codes the same derivative).
+    """
+    from jax import lax
+    from ...parallel.collective import _in_spmd
+
+    logits, label = ensure_tensor(logits), ensure_tensor(label)
+    mp = _in_spmd("mp")
+
+    def f(lg, lb):
+        ids = lb.astype(jnp.int32)
+        if ids.ndim == lg.ndim:
+            ids = jnp.squeeze(ids, -1)
+        per = lg.shape[-1]
+        if mp:
+            local = ids - lax.axis_index("mp") * per
+        else:
+            local = ids
+        in_shard = (local >= 0) & (local < per)
+        safe = jnp.where(in_shard, local, 0)
+        target = jnp.take_along_axis(lg, safe[:, None], axis=-1)[:, 0]
+        # shrink the clip by eps: arccos' = -1/sqrt(1-c^2) is -inf at
+        # |c| == 1, and ArcFace training drives target cosines toward 1 —
+        # exact 1.0 (guaranteed eventually in bf16) would NaN every grad
+        theta = jnp.arccos(jnp.clip(target.astype(jnp.float32),
+                                    -1.0 + 1e-6, 1.0 - 1e-6))
+        mod = (jnp.cos(margin1 * theta + margin2) - margin3).astype(lg.dtype)
+        col = lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+        is_target = (col == safe[:, None]) & in_shard[:, None]
+        lg2 = jnp.where(is_target, mod[:, None], lg) * scale
+        # the max shift is numerical-stability only and cancels in the
+        # log-softmax algebra — stop_gradient keeps it out of the vjp
+        # (pmax has no differentiation rule, and none is needed)
+        if mp:
+            vmax = lax.stop_gradient(
+                lax.pmax(jnp.max(lax.stop_gradient(lg2), -1, keepdims=True),
+                         "mp"))
+            ex = jnp.exp(lg2 - vmax)
+            denom = lax.psum(jnp.sum(ex, -1, keepdims=True), "mp")
+        else:
+            vmax = lax.stop_gradient(jnp.max(lg2, -1, keepdims=True))
+            ex = jnp.exp(lg2 - vmax)
+            denom = jnp.sum(ex, -1, keepdims=True)
+        sm = ex / denom
+        picked = jnp.where(
+            in_shard[:, None],
+            jnp.take_along_axis(lg2 - vmax, safe[:, None], axis=-1),
+            jnp.zeros((), lg2.dtype))
+        if mp:
+            picked = lax.psum(picked, "mp")
+        loss = jnp.log(denom) - picked                    # [N, 1]
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        return (loss, sm) if return_softmax else loss
+
+    out = run_op(f, [logits, label], "margin_cross_entropy")
+    return out

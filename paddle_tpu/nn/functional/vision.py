@@ -1,0 +1,163 @@
+"""Spatial sampling functionals: grid_sample / affine_grid / temporal_shift.
+
+Reference parity: `python/paddle/nn/functional/vision.py:122` (grid_sample
+over the grid_sampler op), `affine_grid` (same file), `temporal_shift`
+(`python/paddle/nn/functional/input.py` family / fluid temporal_shift op).
+
+TPU design: the samplers are GATHER problems. Every (n, ho, wo) output
+pixel's four corner taps become flat indices into the [C, H*W] image and
+run as four `jnp.take` gathers batched over N via vmap — XLA lowers these
+to efficient dynamic-gathers; there is no scalar loop and no data-dependent
+shape anywhere, so the ops jit cleanly into larger programs (STN blocks,
+deformable heads).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import ensure_tensor, run_op
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * (size - 1) / 2.0
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(coord, size, align_corners):
+    """Triangle-wave reflection onto the valid range (grid_sampler
+    reflect_coordinates contract: reflect around [0, size-1] when
+    align_corners else [-0.5, size-0.5])."""
+    if size == 1:
+        return jnp.zeros_like(coord)
+    if align_corners:
+        lo, span = 0.0, float(size - 1)
+    else:
+        lo, span = -0.5, float(size)
+    t = jnp.abs(coord - lo)
+    extra = jnp.mod(t, span)
+    flips = jnp.floor(t / span)
+    even = jnp.mod(flips, 2.0) == 0
+    return jnp.where(even, extra + lo, span - extra + lo)
+
+
+def _gather_2d(img_flat, iy, ix, W):
+    """img_flat [C, H*W]; iy/ix int32 [P] -> [C, P]."""
+    return jnp.take(img_flat, iy * W + ix, axis=1)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x [N,C,H,W], grid [N,Hg,Wg,2] with (x, y) in [-1, 1] -> [N,C,Hg,Wg]."""
+    x, grid = ensure_tensor(x), ensure_tensor(grid)
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear|nearest, got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode}")
+
+    def f(xa, ga):
+        N, C, H, W = xa.shape
+        Hg, Wg = ga.shape[1], ga.shape[2]
+        gx = _unnormalize(ga[..., 0].astype(jnp.float32), W, align_corners)
+        gy = _unnormalize(ga[..., 1].astype(jnp.float32), H, align_corners)
+
+        if padding_mode == "reflection":
+            gx = _reflect(gx, W, align_corners)
+            gy = _reflect(gy, H, align_corners)
+        if padding_mode in ("border", "reflection"):
+            gx = jnp.clip(gx, 0.0, W - 1)
+            gy = jnp.clip(gy, 0.0, H - 1)
+
+        def sample_one(img, fx, fy):
+            """img [C,H,W]; fx/fy [P] -> [C,P]."""
+            imgf = img.reshape(C, H * W)
+            if mode == "nearest":
+                ix = jnp.round(fx).astype(jnp.int32)
+                iy = jnp.round(fy).astype(jnp.int32)
+                valid = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+                v = _gather_2d(imgf, jnp.clip(iy, 0, H - 1),
+                               jnp.clip(ix, 0, W - 1), W)
+                if padding_mode == "zeros":
+                    v = jnp.where(valid[None], v, 0.0)
+                return v
+            x0 = jnp.floor(fx)
+            y0 = jnp.floor(fy)
+            wx1 = (fx - x0).astype(img.dtype)
+            wy1 = (fy - y0).astype(img.dtype)
+            x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+            out = jnp.zeros((C, fx.shape[0]), img.dtype)
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    ix, iy = x0i + dx, y0i + dy
+                    w = (wx1 if dx else 1 - wx1) * (wy1 if dy else 1 - wy1)
+                    valid = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+                    v = _gather_2d(imgf, jnp.clip(iy, 0, H - 1),
+                                   jnp.clip(ix, 0, W - 1), W)
+                    if padding_mode == "zeros":
+                        w = jnp.where(valid, w, 0.0)
+                    out = out + v * w[None]
+            return out
+
+        out = jax.vmap(sample_one)(xa, gx.reshape(N, -1), gy.reshape(N, -1))
+        return out.reshape(N, C, Hg, Wg)
+
+    return run_op(f, [x, grid], "grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N,2,3] -> sampling grid [N,H,W,2] for grid_sample."""
+    theta = ensure_tensor(theta)
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy().reshape(-1)]
+    N, _, H, W = [int(v) for v in out_shape]
+
+    def f(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], -1).reshape(1, H * W, 3)  # [1,HW,3]
+        # coordinate math must not round through the MXU's bf16 path
+        grid = jnp.einsum("nhk,nck->nhc", jnp.broadcast_to(
+            base, (th.shape[0], H * W, 3)).astype(th.dtype), th,
+            precision=jax.lax.Precision.HIGHEST)
+        return grid.reshape(th.shape[0], H, W, 2)
+
+    return run_op(f, [theta], "affine_grid")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift (fluid `temporal_shift` op): [N*T, C, H, W] with
+    the first shift_ratio*C channels shifted t-1 <- t, the next block
+    t+1 <- t, rest unchanged; zero padding at the clip edges."""
+    x = ensure_tensor(x)
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"bad data_format {data_format}")
+
+    def f(xa):
+        a = xa
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        NT, C, H, W = a.shape
+        T = seg_num
+        N = NT // T
+        a = a.reshape(N, T, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.concatenate(
+            [a[:, 1:, :c1], jnp.zeros_like(a[:, :1, :c1])], axis=1)
+        bwd = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, c1:c2]), a[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([fwd, bwd, a[:, :, c2:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return run_op(f, [x], "temporal_shift")
