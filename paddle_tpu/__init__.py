@@ -95,3 +95,72 @@ def is_grad_enabled_():
 def device_guard(*a, **kw):  # static-graph relic; no-op on TPU
     import contextlib
     return contextlib.nullcontext()
+
+
+# ---- remaining top-level parity surface (reference paddle/__init__.py) ----
+# paddle.dtype: the type OF dtype objects (isinstance(x.dtype, paddle.dtype))
+import numpy as _np
+dtype = _np.dtype
+from .core.place import CUDAPinnedPlace, NPUPlace  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
+from .parallel.data_parallel import DataParallel  # noqa: F401
+
+# CUDA rng-state aliases: the rng state is backend-agnostic here (one
+# jax PRNG key chain), matching set/get_cuda_rng_state call sites
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ fatal-signal dumpers
+    (`paddle/fluid/platform/init.cc` SignalHandle); python/XLA runtimes
+    leave process signal handling to the host."""
+    return None
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor print formatting (`python/paddle/tensor/to_string.py`):
+    forwards to numpy's printoptions, which Tensor.__repr__ uses."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def check_shape(shape):
+    """Validate a shape argument (fluid check_shape utility): ints or a
+    1-D integer tensor, -1 allowed once for inferred dims."""
+    vals = shape.tolist() if hasattr(shape, "tolist") else list(shape)
+    n_infer = 0
+    for v in vals:
+        if not isinstance(v, (int,)) and not float(v).is_integer():
+            raise TypeError(f"shape entries must be integers, got {v!r}")
+        if int(v) == -1:
+            n_infer += 1
+    if n_infer > 1:
+        raise ValueError("only one dimension may be -1")
+    return True
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader (`python/paddle/batch.py`)."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

@@ -109,3 +109,18 @@ def is_compiled_with_tpu() -> bool:
 
 def device_count() -> int:
     return jax.device_count()
+
+
+class CUDAPinnedPlace(Place):
+    """Accepted for API compat (pinned host memory has no TPU analogue —
+    host staging buffers are runtime-managed); treated as host placement."""
+
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class NPUPlace(Place):
+    """Accepted for API compat; maps onto the single accelerator backend."""
+
+    def __init__(self, idx=0):
+        super().__init__("npu", idx)

@@ -137,3 +137,41 @@ def maxout(x, groups, axis=1, name=None):
 def glu(x, axis=-1, name=None):
     x = ensure_tensor(x)
     return run_op(lambda a: jax.nn.glu(a, axis=axis), [x], "glu")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    """x if x > threshold else 0 (`nn/functional/activation.py`
+    thresholded_relu)."""
+    x = ensure_tensor(x)
+    return run_op(lambda a: jnp.where(a > threshold, a, 0.0).astype(a.dtype),
+                  [x], "thresholded_relu")
+
+
+def _inplace_variant(x, out):
+    """paddle's `op_` inplace contract on immutable XLA buffers: the result
+    rebinds the INPUT tensor's storage (so existing holders observe the
+    update) and autograd continues through the returned tensor's tape node
+    — identical numerics, one extra buffer during the op."""
+    x._value = out._value
+    x.stop_gradient = out.stop_gradient
+    return out
+
+
+def relu_(x, name=None):
+    x = ensure_tensor(x)
+    return _inplace_variant(x, relu(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return _inplace_variant(x, elu(x, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return _inplace_variant(x, softmax(x, axis=axis, dtype=dtype))
+
+
+def tanh_(x, name=None):
+    x = ensure_tensor(x)
+    return _inplace_variant(x, tanh(x))

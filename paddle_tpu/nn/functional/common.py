@@ -347,4 +347,193 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         if sel.any():
             remapped[sel] = offsets[r] + _np.searchsorted(
                 all_sampled[r], lab[sel] - rlo)
-    return _T(remapped), _T(sampled + (rank * num_classes if nranks > 1 else 0))
+    # sampled centers are LOCAL indices in [0, num_classes) — PartialFC
+    # gathers them from this rank's local weight shard (reference
+    # common.py:1636 multi-GPU example output)
+    return _T(remapped), _T(sampled)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths [*, 1?] -> [..., maxlen] 0/1 mask
+    (`fluid/layers/sequence_lod.py:1324`)."""
+    import jax.numpy as jnp
+    from ...ops._dispatch import ensure_tensor as _et, nondiff_op
+    x = _et(x)
+    ml = maxlen if maxlen is not None else int(np.max(np.asarray(x._value)))
+
+    def f(a):
+        rng = jnp.arange(ml)
+        return (rng < a[..., None]).astype(dtype)
+
+    return nondiff_op(f, [x])
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Embed the last dim as (offset) diagonals of new matrices
+    (`nn/functional/extension.py` diag_embed)."""
+    import jax.numpy as jnp
+    from ...ops._dispatch import ensure_tensor as _et, run_op
+    x = _et(input)
+
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out_ndim = a.ndim + 1
+        d1 = dim1 % out_ndim
+        d2 = dim2 % out_ndim
+        m = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        m = m.at[..., r, c].set(a)
+        # m currently has the matrix at the LAST two axes; move to (d1, d2)
+        perm = list(range(out_ndim - 2))
+        # insert axis positions
+        order = []
+        src = 0
+        for i in range(out_ndim):
+            if i == d1:
+                order.append(out_ndim - 2)
+            elif i == d2:
+                order.append(out_ndim - 1)
+            else:
+                order.append(perm[src])
+                src += 1
+        return jnp.transpose(m, order)
+
+    return run_op(f, [x], "diag_embed")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W (`nn/functional/common.py` zeropad2d): padding
+    [left, right, top, bottom]."""
+    import jax.numpy as jnp
+    from ...ops._dispatch import ensure_tensor as _et, run_op
+    x = _et(x)
+    l, r, t, b = [int(v) for v in (padding.numpy() if hasattr(padding, "numpy")
+                                   else padding)]
+
+    def f(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (t, b), (l, r)))
+        return jnp.pad(a, ((0, 0), (t, b), (l, r), (0, 0)))
+
+    return run_op(f, [x], "zeropad2d")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im: inverse of unfold (`nn/functional/common.py:1803`). x
+    [N, C*kh*kw, L] -> [N, C, H, W] by scatter-adding the patch columns
+    back — implemented as ONE scatter-add over precomputed static index
+    maps (no scalar loops; XLA lowers to an efficient scatter on TPU)."""
+    import jax.numpy as jnp
+    from ...ops._dispatch import ensure_tensor as _et, run_op
+    x = _et(x)
+    to2 = lambda v: [v, v] if isinstance(v, int) else list(v)  # noqa: E731
+    oh, ow = to2(output_sizes)
+    kh, kw = to2(kernel_sizes)
+    sh, sw = to2(strides)
+    ph, pw = to2(paddings)
+    dh, dw = to2(dilations)
+    lh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def f(a):
+        N, ckk, L = a.shape
+        C = ckk // (kh * kw)
+        if L != lh * lw:
+            from ...core.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"fold: L={L} does not match computed {lh}*{lw}")
+        a = a.reshape(N, C, kh, kw, lh, lw)
+        # target row/col for each (ki, li) pair, with padding offset
+        ky = np.arange(kh) * dh
+        kx = np.arange(kw) * dw
+        ly = np.arange(lh) * sh
+        lx = np.arange(lw) * sw
+        rows = ky[:, None] + ly[None, :] - ph        # [kh, lh]
+        cols = kx[:, None] + lx[None, :] - pw        # [kw, lw]
+        out = jnp.zeros((N, C, oh + 2 * max(ph, 0) + kh * dh,
+                         ow + 2 * max(pw, 0) + kw * dw), a.dtype)
+        # scatter into a padded canvas with shifted coords, then crop —
+        # keeps every index in-bounds without per-element masks
+        out = out.at[:, :, rows[:, None, :, None] + ph,
+                     cols[None, :, None, :] + pw].add(
+            jnp.transpose(a, (0, 1, 2, 3, 4, 5)))
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return run_op(f, [x], "fold")
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (`fluid/layers/nn.py:15226` gather_tree):
+    ids/parents [T, B, beam] -> full predicted sequences per beam, walking
+    parent pointers backwards from the last step (one lax.scan, reversed)."""
+    import jax
+    import jax.numpy as jnp
+    from ...ops._dispatch import ensure_tensor as _et, nondiff_op
+    ids_t, par_t = _et(ids), _et(parents)
+
+    def f(a, p):
+        T, B, K = a.shape
+        binc = jnp.arange(B)[:, None]
+
+        def step(beam_sel, xs):
+            ids_row, par_row = xs          # [B, K]
+            out = ids_row[binc, beam_sel]
+            nxt = par_row[binc, beam_sel]
+            return nxt, out
+
+        init = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+        _, outs = jax.lax.scan(step, init, (a[::-1], p[::-1]))
+        return outs[::-1]
+
+    return nondiff_op(lambda a, p: f(a, p), [ids_t, par_t])
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention over a CSR pattern
+    (`nn/functional/sparse_attention.py:23`). q/k/v [B, H, S, D]; offsets
+    [B, H, S+1]; columns [B, H, nnz].
+
+    TPU note: the CSR pattern becomes a dense boolean mask and the softmax
+    runs masked — on TPU the MXU prefers the dense masked form at these
+    sizes (the reference's CUDA kernel exists to exploit CSR on SIMT);
+    long-sequence sparse patterns should use the flash/ring kernels
+    instead, which skip masked BLOCKS structurally."""
+    import jax
+    import jax.numpy as jnp
+    from ...ops._dispatch import ensure_tensor as _et, run_op
+    import math as _math
+    q, k, v = _et(query), _et(key), _et(value)
+    off = np.asarray(_et(sparse_csr_offset)._value)
+    col = np.asarray(_et(sparse_csr_columns)._value)
+
+    def f(qa, ka, va, *rest):
+        B, H, S, D = qa.shape
+        # vectorized CSR -> dense mask: one scatter over all nnz entries
+        mask = np.zeros((B, H, S, S), bool)
+        counts = np.diff(off, axis=-1)                 # [B, H, S]
+        rows = np.repeat(np.tile(np.arange(S), B * H), counts.reshape(-1))
+        bh = np.repeat(np.arange(B * H), counts.sum(-1).reshape(-1))
+        mask.reshape(B * H, S, S)[bh, rows, col.reshape(-1)] = True
+        m = jnp.asarray(mask)
+        s = jnp.einsum("bhsd,bhtd->bhst", qa, ka,
+                       preferred_element_type=jnp.float32) / _math.sqrt(D)
+        i = 0
+        if key_padding_mask is not None:
+            kpm = rest[i]; i += 1
+            m = m & (kpm[:, None, None, :] > 0)
+        if attn_mask is not None:
+            am = rest[i]; i += 1
+            m = m & (am[None, None] > 0) if am.ndim == 2 else m & (am > 0)
+        s = jnp.where(m, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(m.any(-1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhst,bhtd->bhsd", p.astype(va.dtype), va,
+                          preferred_element_type=jnp.float32).astype(qa.dtype)
+
+    extra = [_et(key_padding_mask)] if key_padding_mask is not None else []
+    extra += [_et(attn_mask)] if attn_mask is not None else []
+    return run_op(f, [q, k, v, *extra], "sparse_attention")

@@ -409,3 +409,109 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
 
     out = run_op(f, [logits, label], "margin_cross_entropy")
     return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2*|X∩Y| / (|X|+|Y|) per sample, meaned
+    (`fluid/layers/nn.py:7195`): label is int class ids [..., 1]."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(a, lb):
+        ids = lb.astype(jnp.int32)
+        if ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        oh = jax.nn.one_hot(ids, a.shape[-1], dtype=a.dtype)
+        axes = tuple(range(1, a.ndim))
+        inse = jnp.sum(a * oh, axis=axes)
+        denom = jnp.sum(a, axis=axes) + jnp.sum(oh, axis=axes)
+        return jnp.mean(1 - inse * 2 / (denom + epsilon))
+
+    return run_op(f, [input, label], "dice_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """-y*log(p+eps) - (1-y)*log(1-p+eps) (log_loss_op)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1.0 - y) * jnp.log(1.0 - p + epsilon)
+
+    return run_op(f, [input, label], "log_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair metric loss (`fluid/layers/loss.py:1666`): soft-label CE over
+    the anchor/positive similarity matrix + L2 on the embeddings."""
+    anchor, positive, labels = (ensure_tensor(anchor), ensure_tensor(positive),
+                                ensure_tensor(labels))
+
+    def f(a, p, lb):
+        n = lb.shape[0]
+        eq = (lb[:, None] == lb[None, :]).astype(a.dtype)
+        soft = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) \
+            * 0.25 * l2_reg
+        sim = a @ p.T
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce_rows = -jnp.sum(soft * logp, axis=-1)      # [N]
+        # reference: reduce_sum(labels * softmax_ce, 0) then mean — the
+        # soft labels reweight each row's CE before averaging
+        ce = jnp.mean(jnp.sum(soft * ce_rows[:, None], axis=0))
+        return l2 + ce
+
+    return run_op(f, [anchor, positive, labels], "npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (`nn/functional/loss.py` hsigmoid_loss over
+    hierarchical_sigmoid_op; default complete-binary-tree coding from
+    matrix_bit_code.h SimpleCode: c = label + num_classes, node(bit) =
+    (c >> (bit+1)) - 1, branch(bit) = c & (1 << bit)).
+
+    input [N, D]; weight [num_classes-1, D]; returns [N, 1]. Custom trees
+    via path_table/path_code [N, L] (entries < 0 are padding). The bit walk
+    is a static loop over max code length with per-sample masks — no
+    data-dependent shapes, jits whole."""
+    input, label, weight = (ensure_tensor(input), ensure_tensor(label),
+                            ensure_tensor(weight))
+    extra = []
+    if bias is not None:
+        extra.append(ensure_tensor(bias))
+    pt = ensure_tensor(path_table)._value if path_table is not None else None
+    pc = ensure_tensor(path_code)._value if path_code is not None else None
+
+    def f(x, lb, w, *rest):
+        b = rest[0] if bias is not None else None
+        ids = lb.astype(jnp.int32)
+        if ids.ndim == 2:
+            ids = ids[:, 0]
+        if pt is not None:
+            nodes = pt.astype(jnp.int32)              # [N, L]
+            bits = pc.astype(x.dtype)
+            live = (nodes >= 0)
+            nodes_safe = jnp.maximum(nodes, 0)
+        else:
+            c = ids + num_classes                      # [N]
+            L = int(2 * num_classes - 1).bit_length() - 1
+            js = jnp.arange(L)
+            nodes = (c[:, None] >> (js[None, :] + 1)) - 1
+            bits = ((c[:, None] >> js[None, :]) & 1).astype(x.dtype)
+            # get_length = FindLastSet(c) - 1: bit j participates iff
+            # j < floor(log2(c))
+            length = (jnp.floor(jnp.log2(c.astype(jnp.float32)))
+                      ).astype(jnp.int32)
+            live = js[None, :] < length[:, None]
+            nodes_safe = jnp.clip(nodes, 0, num_classes - 2)
+        wsel = w[nodes_safe]                           # [N, L, D]
+        pre = jnp.einsum("nd,nld->nl", x, wsel)
+        if b is not None:
+            pre = pre + b.reshape(-1)[nodes_safe]
+        # sum over live bits of softplus(pre) - bit*pre  (= -log sigmoid
+        # of the signed branch logit)
+        term = jax.nn.softplus(pre) - bits * pre
+        loss = jnp.sum(jnp.where(live, term, 0.0), axis=1, keepdims=True)
+        return loss
+
+    return run_op(f, [input, label, weight, *extra], "hsigmoid_loss")

@@ -64,18 +64,27 @@ def _pool(x, nd, kernel, stride, padding, reducer, init, ceil_mode, exclusive=Tr
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 1, kernel_size, stride, padding,
+                                   ceil_mode, data_format)
     return _pool(x, 1, kernel_size, stride, padding, "max", None, ceil_mode,
                  data_format=data_format)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 2, kernel_size, stride, padding,
+                                   ceil_mode, data_format)
     return _pool(x, 2, kernel_size, stride, padding, "max", None, ceil_mode,
                  data_format=data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 3, kernel_size, stride, padding,
+                                   ceil_mode, data_format)
     return _pool(x, 3, kernel_size, stride, padding, "max", None, ceil_mode,
                  data_format=data_format)
 
@@ -149,3 +158,112 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, 3, output_size, "max", "NCDHW")
+
+
+def _max_pool_with_mask(x, nd, kernel, stride, padding, ceil_mode=False,
+                        data_format="NCHW"):
+    """Max pool that also returns the argmax mask (flat index into the
+    input's spatial extent per channel — the max_pool_with_index op
+    contract consumed by max_unpool). Implemented as a static loop over
+    the kernel offsets: each offset is one strided slice of the padded
+    input, stacked and argmaxed — no data-dependent shapes. NCHW-family
+    layouts only (the reference's with-index op is NCHW-only too)."""
+    import itertools
+    if not data_format.upper().startswith("NC"):
+        from ...core.enforce import InvalidArgumentError
+        raise InvalidArgumentError(
+            "max_pool with return_mask requires a channel-first layout "
+            f"(got data_format={data_format!r}) — the reference "
+            "max_poolNd_with_index op is NCHW-only too")
+    x = ensure_tensor(x)
+    k = _tuplize(kernel, nd)
+    s = _tuplize(stride if stride is not None else kernel, nd)
+    p = _tuplize(padding, nd)
+
+    def f(a):
+        spatial = a.shape[2:]
+        if ceil_mode:
+            out_sp = [-(-(spatial[i] + 2 * p[i] - k[i]) // s[i]) + 1
+                      for i in range(nd)]
+        else:
+            out_sp = [(spatial[i] + 2 * p[i] - k[i]) // s[i] + 1
+                      for i in range(nd)]
+        neg = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
+        # right pad covers both the kernel overhang and any ceil_mode
+        # partial window (whole-window -inf taps can never win the argmax)
+        ap = jnp.pad(a, [(0, 0), (0, 0)]
+                     + [(p[i], p[i] + k[i] + s[i]) for i in range(nd)],
+                     constant_values=neg)
+        vals, idxs = [], []
+        for off in itertools.product(*[range(k[i]) for i in range(nd)]):
+            sl = (slice(None), slice(None)) + tuple(
+                slice(off[i], off[i] + s[i] * out_sp[i], s[i]) for i in range(nd))
+            vals.append(ap[sl])
+            # global flat index of this tap for every output position
+            coord = [jnp.arange(out_sp[i]) * s[i] + off[i] - p[i]
+                     for i in range(nd)]
+            flat = 0
+            for i in range(nd):
+                shape1 = [1] * nd
+                shape1[i] = out_sp[i]
+                flat = flat * spatial[i] + coord[i].reshape(shape1)
+            vals_shape = (1, 1) + tuple(out_sp)
+            idxs.append(jnp.broadcast_to(flat.reshape(vals_shape),
+                                         a.shape[:2] + tuple(out_sp)))
+        vstack = jnp.stack(vals)                 # [K, N, C, *out]
+        istack = jnp.stack(idxs)
+        arg = jnp.argmax(vstack, axis=0)
+        out = jnp.take_along_axis(vstack, arg[None], axis=0)[0]
+        mask = jnp.take_along_axis(istack, arg[None], axis=0)[0]
+        return out, mask.astype(jnp.int32)
+
+    return run_op(f, [x], f"max_pool{nd}d_with_index")
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
+                data_format):
+    """Scatter pooled values back to their argmax positions
+    (`python/paddle/nn/layer/pooling.py:1215` MaxUnPool family /
+    unpool op). Zeros elsewhere; duplicate indices follow scatter order."""
+    x, indices = ensure_tensor(x), ensure_tensor(indices)
+    k = _tuplize(kernel_size, nd)
+    s = _tuplize(stride if stride is not None else kernel_size, nd)
+    p = _tuplize(padding, nd)
+    idx_v = indices._value
+
+    def f(a):
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(v) for v in output_size)[-nd:]
+        else:
+            out_sp = tuple((in_sp[i] - 1) * s[i] - 2 * p[i] + k[i]
+                           for i in range(nd))
+        N, C = a.shape[0], a.shape[1]
+        P = int(np.prod(in_sp))
+        tot = int(np.prod(out_sp))
+        af = a.reshape(N * C, P)
+        idxf = idx_v.reshape(N * C, P).astype(jnp.int32)
+        out = jnp.zeros((N * C, tot), a.dtype)
+        out = out.at[jnp.arange(N * C)[:, None], idxf].set(af, mode="drop")
+        return out.reshape((N, C) + out_sp)
+
+    return run_op(f, [x], f"max_unpool{nd}d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)

@@ -159,3 +159,13 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self.groups, self.axis)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self._threshold = threshold
+
+    def forward(self, x):
+        from ..functional.activation import thresholded_relu
+        return thresholded_relu(x, self._threshold)

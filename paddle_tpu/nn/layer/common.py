@@ -210,3 +210,38 @@ class Bilinear(Layer):
 
     def forward(self, x1, x2):
         return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Fold(Layer):
+    """col2im layer over F.fold (`python/paddle/nn/layer/common.py` Fold)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        from ..functional.common import fold
+        return fold(x, *self._args)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs
+    (`python/paddle/nn/layer/distance.py` PairwiseDistance)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._p, self._eps, self._keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from ...ops._dispatch import ensure_tensor, run_op
+        import jax.numpy as jnp
+        x, y = ensure_tensor(x), ensure_tensor(y)
+        p, eps, keep = self._p, self._eps, self._keepdim
+
+        def f(a, b):
+            d = a - b + eps
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1,
+                                     keepdims=keep), 1.0 / p)
+
+        return run_op(f, [x, y], "pairwise_distance")

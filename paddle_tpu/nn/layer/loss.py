@@ -132,3 +132,33 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classification head
+    (`python/paddle/nn/layer/loss.py` HSigmoidLoss): owns the
+    [num_classes-1, feature_size] internal-node weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if num_classes < 2:
+            from ...core.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"HSigmoidLoss requires num_classes >= 2, got {num_classes}")
+        from .. import initializer
+        import math as _m
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        k = 1.0 / _m.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size),
+            default_initializer=initializer.Uniform(-k, k))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_classes - 1, 1),
+            default_initializer=initializer.Uniform(-k, k))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from ..functional.loss import hsigmoid_loss
+        return hsigmoid_loss(input, label, self._num_classes, self.weight,
+                             self.bias, path_table, path_code)

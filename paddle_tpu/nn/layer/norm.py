@@ -157,6 +157,29 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Standalone spectral normalization module: forward(weight) returns
+    weight / sigma_max estimated by `power_iters` rounds of power
+    iteration on persistent u/v buffers (`python/paddle/nn/layer/norm.py`
+    SpectralNorm over spectral_norm_op)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned (round 2)")
+        import numpy as _np
+        self._dim, self._power_iters, self._eps = dim, power_iters, eps
+        h = int(weight_shape[dim])
+        w = int(_np.prod(weight_shape)) // h
+        rng = _np.random.RandomState(0)
+        u = rng.randn(h).astype("float32")
+        v = rng.randn(w).astype("float32")
+        self._u = u / max(float(_np.linalg.norm(u)), eps)
+        self._v = v / max(float(_np.linalg.norm(v)), eps)
+
+    def forward(self, weight):
+        from ...ops._dispatch import ensure_tensor
+        from ..utils import spectral_normalize
+        weight = ensure_tensor(weight)
+        out, self._u, self._v = spectral_normalize(
+            weight, self._u, dim=self._dim,
+            n_power_iterations=self._power_iters, eps=self._eps)
+        return out

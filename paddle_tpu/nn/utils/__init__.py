@@ -1,3 +1,13 @@
+"""nn.utils: vectorize helpers + hook-based weight reparametrizations.
+
+Reference parity: `python/paddle/nn/utils/weight_norm_hook.py:155`
+(weight_norm/remove_weight_norm) and `spectral_norm_hook.py:131`
+(spectral_norm) — forward-pre-hook reparametrizations: the layer's weight
+parameter is replaced by derived parameters, and every forward recomputes
+the effective weight from them so the optimizer trains the derived
+parameters. The recomputation is pure jnp traced through the tape, so it
+jits into TrainStep like any other op.
+"""
 from ..clip import clip_grad_norm_  # noqa: F401
 
 
@@ -17,13 +27,147 @@ def vector_to_parameters(vec, parameters, name=None):
         off += n
 
 
+def _norm_except_dim(v, dim):
+    """L2 norm reduced over every axis except `dim` (kept) — the
+    weight_norm_hook norm_except_dim contract. dim=None -> full norm."""
+    from ...ops._dispatch import run_op
+    import jax.numpy as jnp
+
+    def f(a):
+        if dim is None:
+            return jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2)).astype(a.dtype)
+        axes = tuple(i for i in range(a.ndim) if i != dim)
+        return jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2,
+                                axis=axes, keepdims=True)).astype(a.dtype)
+
+    return run_op(f, [v], "norm_except_dim")
+
+
+class _WeightNorm:
+    def __init__(self, name, dim):
+        self.name, self.dim = name, dim
+
+    def compute_weight(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        norm = _norm_except_dim(v, self.dim)
+        return v * (g / norm)
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute_weight(layer))
+
+
 def weight_norm(layer, name="weight", dim=0):
-    raise NotImplementedError("weight_norm: planned (round 2)")
+    """w = g * v/||v|| with g = ||w|| along `dim` (None -> scalar norm)."""
+    from ...core.tensor import Parameter
+    if name not in layer._parameters:
+        raise ValueError(f"weight_norm: layer has no parameter {name!r}")
+    for h in layer._forward_pre_hooks.values():
+        if isinstance(h, _WeightNorm) and h.name == name:
+            raise RuntimeError(f"weight_norm already applied to {name!r}")
+    w = layer._parameters.pop(name)
+    fn = _WeightNorm(name, dim)
+    g = _norm_except_dim(w, dim)
+    layer.add_parameter(name + "_g", Parameter(g._value))
+    layer.add_parameter(name + "_v", Parameter(w._value))
+    handle = layer.register_forward_pre_hook(fn)
+    fn._handle = handle
+    fn(layer, None)          # effective weight available before 1st forward
+    return layer
 
 
 def remove_weight_norm(layer, name="weight"):
-    raise NotImplementedError("weight_norm: planned (round 2)")
+    from ...core.tensor import Parameter
+    for key, h in list(layer._forward_pre_hooks.items()):
+        if isinstance(h, _WeightNorm) and h.name == name:
+            w = h.compute_weight(layer)
+            del layer._forward_pre_hooks[key]
+            del layer._parameters[name + "_g"]
+            del layer._parameters[name + "_v"]
+            layer.__dict__.pop(name, None)
+            layer.add_parameter(name, Parameter(w._value))
+            return layer
+    raise ValueError(f"weight_norm of {name!r} not found in {layer}")
 
 
-def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
-    raise NotImplementedError("spectral_norm: planned (round 2)")
+def _spectral_mat(w_arr, dim):
+    """Matricize with `dim` leading (reshape target of the power iteration)."""
+    import jax.numpy as jnp
+    import numpy as np
+    xp = np if isinstance(w_arr, np.ndarray) else jnp
+    if dim != 0:
+        perm = (dim,) + tuple(i for i in range(w_arr.ndim) if i != dim)
+        w_arr = xp.transpose(w_arr, perm)
+    return w_arr.reshape(w_arr.shape[0], -1)
+
+
+def spectral_normalize(w, u, *, dim, n_power_iterations, eps):
+    """Shared core of nn.utils.spectral_norm and nn.SpectralNorm: run the
+    power iteration HOST-SIDE on the current value (u/v are no-grad
+    persistent state, as in the reference op), then divide the weight by
+    sigma = u^T W v inside the traced graph so gradients flow through W.
+    Returns (normalized_tensor, new_u, new_v)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ...ops._dispatch import run_op
+
+    wm = np.asarray(_spectral_mat(np.asarray(w._value), dim), dtype=np.float32)
+    uv = np.asarray(u, dtype=np.float32)
+    vv = None
+    for _ in range(max(n_power_iterations, 1)):
+        vv = wm.T @ uv
+        vv = vv / max(float(np.linalg.norm(vv)), eps)
+        uv = wm @ vv
+        uv = uv / max(float(np.linalg.norm(uv)), eps)
+    uc, vc = jnp.asarray(uv), jnp.asarray(vv)
+
+    def f(wa):
+        m = _spectral_mat(wa.astype(jnp.float32), dim)
+        sigma = uc @ (m @ vc)
+        return (wa.astype(jnp.float32) / sigma).astype(wa.dtype)
+
+    return run_op(f, [w], "spectral_norm"), uv, vv
+
+
+class _SpectralNorm:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def _mat(self, w_arr):
+        return _spectral_mat(w_arr, self.dim)
+
+    def compute_weight(self, layer):
+        w = getattr(layer, self.name + "_orig")
+        u = getattr(layer, "_" + self.name + "_u")
+        out, new_u, _ = spectral_normalize(
+            w, u, dim=self.dim, n_power_iterations=self.n, eps=self.eps)
+        object.__setattr__(layer, "_" + self.name + "_u", new_u)
+        return out
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute_weight(layer))
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """w / sigma_max(w) via power iteration (spectral_norm_hook.py:131)."""
+    from ...core.tensor import Parameter
+    import numpy as np
+    if name not in layer._parameters:
+        raise ValueError(f"spectral_norm: layer has no parameter {name!r}")
+    if dim is None:
+        # Linear weights are [in, out] -> spectral dim 1; conv [out, ...] -> 0
+        dim = 1 if type(layer).__name__ == "Linear" else 0
+    w = layer._parameters.pop(name)
+    fn = _SpectralNorm(name, n_power_iterations, eps, dim)
+    layer.add_parameter(name + "_orig", Parameter(w._value))
+    h = int(np.asarray(fn._mat(w._value)).shape[0])
+    u0 = np.random.RandomState(0).randn(h).astype(np.float32)
+    object.__setattr__(layer, "_" + name + "_u", u0 / np.linalg.norm(u0))
+    handle = layer.register_forward_pre_hook(fn)
+    fn._handle = handle
+    fn(layer, None)
+    return layer

@@ -172,3 +172,29 @@ def clip_by_norm(x, max_norm, name=None):
         return jnp.where(n > max_norm, a * (max_norm / jnp.maximum(n, 1e-12)), a)
 
     return run_op(f, [x], "clip_by_norm")
+
+
+def complex(real, imag, name=None):
+    """Build a complex tensor from real + imaginary parts
+    (`python/paddle/tensor/creation.py` complex)."""
+    import jax as _jax
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return run_op(lambda r, i: _jax.lax.complex(r, i), [real, imag], "complex")
+
+
+def is_complex(x):
+    import jax.numpy as jnp
+    x = ensure_tensor(x)
+    return jnp.issubdtype(x._value.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    import jax.numpy as jnp
+    x = ensure_tensor(x)
+    return jnp.issubdtype(x._value.dtype, jnp.floating)
+
+
+def is_integer(x):
+    import jax.numpy as jnp
+    x = ensure_tensor(x)
+    return jnp.issubdtype(x._value.dtype, jnp.integer)

@@ -168,7 +168,33 @@ def cross(x, y, axis=9, name=None):
 
 
 def householder_product(x, tau, name=None):
-    raise NotImplementedError("householder_product: planned (round 2)")
+    """Q = H_0 H_1 ... H_{n-1} from compact Householder reflectors
+    (`python/paddle/tensor/linalg.py` householder_product over the orgqr
+    LAPACK contract): x [*, m, n] holds v_i below the diagonal of column
+    i (implicit unit diagonal), tau [*, n] the scalar factors; returns the
+    first n columns of Q [*, m, n]. Static python loop over the n
+    reflectors — each step is one rank-1 update (matmul-shaped, MXU-
+    friendly); batches broadcast through."""
+    x, tau = ensure_tensor(x), ensure_tensor(tau)
+
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        batch = a.shape[:-2]
+        eye = jnp.broadcast_to(jnp.eye(m, n, dtype=a.dtype),
+                               batch + (m, n))
+        rows = jnp.arange(m)
+        q = eye
+        for i in reversed(range(n)):
+            v = jnp.where((rows > i)[..., None],
+                          a[..., :, i:i + 1], 0.0)
+            v = v.at[..., i, 0].set(1.0) if not batch else \
+                v.at[..., i, :].set(1.0)
+            ti = t[..., i:i + 1, None] if t.ndim > 1 else t[i]
+            # H_i @ q = q - tau_i * v (v^T q)
+            q = q - ti * v @ (jnp.swapaxes(v, -1, -2) @ q)
+        return q
+
+    return run_op(f, [x, tau], "householder_product")
 
 
 def corrcoef(x, rowvar=True, name=None):

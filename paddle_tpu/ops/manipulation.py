@@ -499,3 +499,40 @@ def unstack(x, axis=0, num=None, name=None):
     outs = run_op(lambda a: tuple(jnp.squeeze(s, axis) for s in
                                   jnp.split(a, n, axis)), [x], "unstack")
     return list(outs)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Slice a sub-box: out[i] = x[offsets[i] : offsets[i]+shape[i]]
+    (`python/paddle/tensor/manipulation.py` crop / crop_tensor op).
+    shape entries of -1 keep the rest of that dim; offsets default 0."""
+    x = ensure_tensor(x)
+    get = lambda v: [int(i) for i in (v.numpy().reshape(-1) if hasattr(v, "numpy")
+                                      else v)]  # noqa: E731
+    shp = get(shape) if shape is not None else list(x.shape)
+    offs = get(offsets) if offsets is not None else [0] * len(shp)
+
+    def f(a):
+        import builtins
+        sl = tuple(builtins.slice(o, a.shape[i] if s == -1 else o + s)
+                   for i, (o, s) in enumerate(zip(offs, shp)))
+        return a[sl]
+
+    return run_op(f, [x], "crop")
+
+
+def reverse(x, axis, name=None):
+    """Flip along axes (fluid reverse op; alias surface of flip)."""
+    return flip(x, axis)
+
+
+def shape(input):
+    """Runtime shape as an int32 tensor (`paddle.shape` / shape op)."""
+    from ._dispatch import nondiff_op
+    input = ensure_tensor(input)
+    return nondiff_op(lambda a: jnp.asarray(a.shape, jnp.int32), [input])
+
+
+def tolist(x):
+    """Nested python list of the tensor's values (utility in
+    `python/paddle/tensor/to_string.py` family)."""
+    return ensure_tensor(x).tolist()

@@ -437,3 +437,39 @@ def cdist(x, y, p=2.0, name=None, **kw):
 
 def broadcast_shape(x_shape, y_shape):
     return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (`python/paddle/tensor/math.py:971`
+    add_n over sum_op)."""
+    import functools
+    import operator
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    ts = [ensure_tensor(t) for t in inputs]
+    return run_op(lambda *arrs: functools.reduce(operator.add, arrs),
+                  ts, "add_n")
+
+
+def increment(x, value=1.0, name=None):
+    """x + value, rebinding x's storage (fluid increment op semantics —
+    the static-graph loop counter primitive)."""
+    x = ensure_tensor(x)
+    out = run_op(lambda a: a + jnp.asarray(value, a.dtype), [x], "increment")
+    x._value = out._value
+    return out
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each sub-tensor along `axis` to p-norm <= max_norm
+    (`python/paddle/tensor/math.py` renorm)."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        axes = tuple(i for i in range(a.ndim) if i != axis)
+        norm = jnp.sum(jnp.abs(a.astype(jnp.float32)) ** p, axis=axes,
+                       keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norm > max_norm, max_norm / (norm + 1e-7), 1.0)
+        return (a.astype(jnp.float32) * factor).astype(a.dtype)
+
+    return run_op(f, [x], "renorm")

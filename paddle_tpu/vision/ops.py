@@ -140,6 +140,13 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         dg = deformable_groups
         Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
         Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        want_off = (N, 2 * dg * kh * kw, Ho, Wo)
+        if tuple(off.shape) != want_off:
+            from ..core.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"deform_conv2d: offset shape {tuple(off.shape)} != "
+                f"expected {want_off} (2*deformable_groups*kh*kw offsets "
+                "per output position)")
         off = off.reshape(N, dg, kh * kw, 2, Ho, Wo)
         base_y = (jnp.arange(Ho) * sh - ph)[:, None]          # [Ho,1]
         base_x = (jnp.arange(Wo) * sw - pw)[None, :]          # [1,Wo]

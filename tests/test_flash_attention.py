@@ -132,3 +132,32 @@ def test_mixed_dtype_inputs_promoted():
     out = flash_attention_arrays(q, k, v, causal=True, block_q=128, block_k=128)
     assert out.shape == (b, s, h, d)
     assert out.dtype == jnp.float32
+
+
+class TestFusedBackwardParity:
+    def test_fused_matches_two_pass(self):
+        """The fused single-pass backward is the tested-equal alternative
+        to the default two-pass path — their gradients must agree (shared
+        _bwd_tile_pds math, independent loop structures)."""
+        import importlib
+        fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        bh, s, d = 2, 256, 32
+        bq = bk = 128
+        q = jnp.asarray(rng.randn(bh, s, d).astype(np.float32) * 0.2)
+        k = jnp.asarray(rng.randn(bh, s, d).astype(np.float32) * 0.2)
+        v = jnp.asarray(rng.randn(bh, s, d).astype(np.float32) * 0.2)
+        g = jnp.asarray(rng.randn(bh, s, d).astype(np.float32))
+        for causal in (False, True):
+            out, lse = fa._flash_fwd_bhsd(q, k, v, causal=causal, block_q=bq,
+                                          block_k=bk, interpret=True)
+            two = fa._flash_bwd_bhsd(q, k, v, out, lse, g, causal=causal,
+                                     block_q=bq, block_k=bk, interpret=True)
+            fused = fa._flash_bwd_fused_bhsd(q, k, v, out, lse, g,
+                                             causal=causal, block_q=bq,
+                                             block_k=bk, interpret=True)
+            for a, b, nm in zip(two, fused, ("dq", "dk", "dv")):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                    err_msg=f"{nm} mismatch (causal={causal})")

@@ -267,8 +267,55 @@ def _norm_case(case):
     return name, op, arrs, kw, gi
 
 
+# round-5 additions: spatial samplers, detection heads, margin softmax,
+# fold, hierarchical softmax, householder — FD-checked like everything else
+# grid points pinned to cell midpoints (fractional part ~0.4): central
+# differences across a bilinear floor() kink would disagree with the
+# (correct) one-sided analytic gradient
+_g_rng = np.random.RandomState(77)
+_g_ix = _g_rng.randint(0, 4, (1, 3, 3)) + 0.4     # W=5 -> coords in [0,4]
+_g_iy = _g_rng.randint(0, 3, (1, 3, 3)) + 0.4     # H=4
+_r5_grid = (r(1, 2, 4, 5),
+            np.stack([_g_ix * 2 / 4 - 1, _g_iy * 2 / 3 - 1],
+                     -1).astype("float32"))
+_r5_off = r(1, 8, 4, 5, lo=-0.45, hi=0.45) + 0.12
+R5 = [
+    ("grid_sample", F.grid_sample, [_r5_grid[0], _r5_grid[1]]),
+    ("affine_grid", lambda t: F.affine_grid(t, [1, 2, 3, 4]),
+     [r(1, 2, 3)], None, [0]),
+    ("deform_conv2d",
+     lambda x, o, w: __import__("paddle_tpu").vision.ops.deform_conv2d(
+         x, o, w),
+     [r(1, 2, 5, 6), _r5_off, r(3, 2, 2, 2, lo=-0.5, hi=0.5)]),
+    ("fold", lambda x: F.fold(x, [3, 3], [2, 2]), [r(1, 8, 4)], None, [0]),
+    ("margin_ce",
+     lambda lg: F.margin_cross_entropy(
+         lg, paddle.to_tensor(np.array([0, 2], "int64"))),
+     [r(2, 4, lo=-0.7, hi=0.7)], None, [0]),
+    ("hsigmoid",
+     lambda x, w: F.hsigmoid_loss(
+         x, paddle.to_tensor(np.array([1, 4], "int64")), 6, w),
+     [r(2, 3), r(5, 3)]),
+    ("dice", lambda x: F.dice_loss(
+        x, paddle.to_tensor(np.array([[0], [2]], "int64"))),
+     [r(2, 3, lo=0.1, hi=0.9)], None, [0]),
+    ("log_loss_fd", lambda x: F.log_loss(
+        x, paddle.to_tensor((r(2, 1) > 0).astype("float32"))),
+     [r(2, 1, lo=0.2, hi=0.8)], None, [0]),
+    ("npair", lambda a, p: F.npair_loss(
+        a, p, paddle.to_tensor(np.array([0, 1], "int64"))),
+     [r(2, 4), r(2, 4)]),
+    ("householder", paddle.linalg.householder_product,
+     [r(4, 2), r(2, lo=0.1, hi=0.9)]),
+    ("temporal_shift", lambda x: F.temporal_shift(x, 2, 0.25),
+     [r(4, 4, 2, 2)], None, [0]),
+    ("renorm_fd", lambda x: paddle.renorm(x, 2.0, 0, 1.0),
+     [distinct(3, 4)], None, [0]),
+    ("thresholded_relu", F.thresholded_relu, [distinct(2, 3) * 2], None, [0]),
+]
+
 ALL = [_norm_case(c) for c in
-       UNARY + BINARY + REDUCE + LINALG + MANIP + ACT + NORM_CONV + LOSS]
+       UNARY + BINARY + REDUCE + LINALG + MANIP + ACT + NORM_CONV + LOSS + R5]
 
 
 @pytest.mark.parametrize("name,op,arrs,kw,gi", ALL, ids=[c[0] for c in ALL])

@@ -141,3 +141,28 @@ class TestClassCenterSample:
         label = paddle.to_tensor(np.array([1, 2], dtype=np.int64))
         with pytest.raises(Exception):
             F.class_center_sample(label, 4, 10)
+
+    def test_multi_rank_local_indices_and_consistent_remap(self):
+        """PartialFC contract: every rank returns LOCAL sampled indices in
+        [0, num_classes) (they gather from the local weight shard), and
+        all ranks agree on the remapped labels (cumulative positions into
+        the concatenation of per-rank sampled lists)."""
+
+        class G0:
+            rank, nranks = 0, 2
+
+        class G1:
+            rank, nranks = 1, 2
+
+        lab = np.array([6, 1, 2, 5], dtype=np.int64)   # classes split 4/4
+        paddle.seed(11)
+        r0, s0 = F.class_center_sample(paddle.to_tensor(lab), 4, 2, group=G0())
+        paddle.seed(11)
+        r1, s1 = F.class_center_sample(paddle.to_tensor(lab), 4, 2, group=G1())
+        assert (r0.numpy() == r1.numpy()).all()
+        for s in (s0.numpy(), s1.numpy()):
+            assert s.min() >= 0 and s.max() < 4
+        # remap resolves through the concatenated [rank0 | rank1] lists
+        concat = np.concatenate([s0.numpy(), s1.numpy() + 4])
+        for l, m in zip(lab, r0.numpy()):
+            assert concat[m] == l, (l, m, concat)

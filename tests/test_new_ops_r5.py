@@ -1,0 +1,354 @@
+"""Round-5 API additions: spatial samplers, fold/unpool, hsigmoid, yolo
+loss, reparametrizations, top-level stragglers.
+
+Oracles: torch (cpu) for grid_sample/affine_grid/fold/max_unpool/
+householder_product; hand numpy implementations of the documented
+algorithms elsewhere (the reference kernels are CUDA/C++; the numpy
+oracles here re-state the published math, e.g. SimpleCode bit paths).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as vops
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pm", ["zeros", "border", "reflection"])
+    def test_matches_torch(self, mode, pm):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 5, 7).astype(np.float32)
+        g = rng.rand(2, 4, 6, 2).astype(np.float32) * 2.4 - 1.2
+        for ac in (True, False):
+            want = tF.grid_sample(torch.tensor(x), torch.tensor(g), mode=mode,
+                                  padding_mode=pm, align_corners=ac).numpy()
+            got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                                mode=mode, padding_mode=pm,
+                                align_corners=ac).numpy()
+            np.testing.assert_allclose(got, want, atol=3e-5)
+
+    def test_gradients_flow(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(1, 2, 4, 4).astype(np.float32),
+                             stop_gradient=False)
+        g = paddle.to_tensor((rng.rand(1, 3, 3, 2) * 1.6 - 0.8)
+                             .astype(np.float32), stop_gradient=False)
+        F.grid_sample(x, g).sum().backward()
+        assert np.isfinite(np.asarray(x.gradient())).all()
+        assert np.abs(np.asarray(g.gradient())).sum() > 0
+
+
+class TestAffineGrid:
+    def test_matches_torch(self):
+        th = np.random.RandomState(2).randn(2, 2, 3).astype(np.float32)
+        for ac in (True, False):
+            want = tF.affine_grid(torch.tensor(th), (2, 3, 4, 5),
+                                  align_corners=ac).numpy()
+            got = F.affine_grid(paddle.to_tensor(th), [2, 3, 4, 5],
+                                align_corners=ac).numpy()
+            np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestFoldUnpool:
+    def test_fold_matches_torch(self):
+        rng = np.random.RandomState(3)
+        cases = [((2, 12, 9), (4, 4), (2, 2), 1, 0, 1),
+                 ((1, 18, 9), (6, 6), (3, 3), 2, 1, 1),
+                 ((1, 8, 4), (5, 5), (2, 2), 2, 0, 2)]
+        for shp, os_, ks, st, pd, dl in cases:
+            x = rng.randn(*shp).astype(np.float32)
+            got = F.fold(paddle.to_tensor(x), list(os_), list(ks),
+                         strides=st, paddings=pd, dilations=dl).numpy()
+            want = tF.fold(torch.tensor(x), os_, ks, stride=st, padding=pd,
+                           dilation=dl).numpy()
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_fold_layer_and_grad(self):
+        x = paddle.to_tensor(
+            np.random.rand(1, 8, 4).astype(np.float32), stop_gradient=False)
+        out = nn.Fold([3, 3], [2, 2])(x)
+        out.sum().backward()
+        # every patch element lands exactly once in the scatter-add sum
+        np.testing.assert_allclose(np.asarray(x.gradient()), 1.0)
+
+    @pytest.mark.parametrize("nd", [1, 2, 3])
+    def test_max_unpool_roundtrip(self, nd):
+        rng = np.random.RandomState(4)
+        shape = {1: (2, 3, 10), 2: (2, 3, 8, 8), 3: (1, 2, 6, 6, 6)}[nd]
+        x = rng.randn(*shape).astype(np.float32)
+        pool = getattr(F, f"max_pool{nd}d")
+        unpool = getattr(F, f"max_unpool{nd}d")
+        tpool = getattr(tF, f"max_pool{nd}d")
+        tunpool = getattr(tF, f"max_unpool{nd}d")
+        out, mask = pool(paddle.to_tensor(x), 2, 2, return_mask=True)
+        to, tm = tpool(torch.tensor(x), 2, 2, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), to.numpy())
+        assert (mask.numpy() == tm.numpy()).all()
+        got = unpool(out, mask, 2, 2).numpy()
+        want = tunpool(to, tm, 2, 2).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_max_unpool_layerwrappers(self):
+        x = paddle.to_tensor(np.random.rand(1, 2, 6, 6).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        up = nn.MaxUnPool2D(2, 2)(out, mask)
+        assert up.shape == [1, 2, 6, 6]
+
+
+class TestHSigmoid:
+    @staticmethod
+    def _oracle(x, label, K, w, b):
+        out = np.zeros((x.shape[0], 1))
+        for n in range(x.shape[0]):
+            c = int(label[n]) + K
+            for j in range(c.bit_length() - 1):
+                node = (c >> (j + 1)) - 1
+                bit = float((c >> j) & 1)
+                pre = x[n] @ w[node] + (b[node] if b is not None else 0.0)
+                out[n, 0] += np.log1p(np.exp(pre)) - bit * pre
+        return out
+
+    def test_matches_simplecode_oracle(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(6, 5).astype(np.float32) * 0.5
+        lab = rng.randint(0, 11, (6,)).astype(np.int64)
+        w = rng.randn(10, 5).astype(np.float32) * 0.3
+        b = rng.randn(10).astype(np.float32) * 0.1
+        got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lab), 11,
+                              paddle.to_tensor(w), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(got, self._oracle(x, lab, 11, w, b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_layer_trains(self):
+        paddle.seed(0)
+        head = nn.HSigmoidLoss(8, 16)
+        feat = nn.Linear(4, 8)
+        opt = paddle.optimizer.Adam(
+            parameters=head.parameters() + feat.parameters(),
+            learning_rate=1e-2)
+        x = paddle.to_tensor(np.random.rand(16, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randint(0, 16, (16,)).astype(np.int64))
+        first = last = None
+        for _ in range(12):
+            loss = head(feat(x), y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first
+
+
+class TestReparametrizations:
+    def test_weight_norm_identity_and_train(self):
+        paddle.seed(1)
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, dim=0)
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        # reparametrized forward == original at init
+        np.testing.assert_allclose(
+            lin(x).numpy(),
+            x.numpy() @ w0 + lin.bias.numpy(), rtol=1e-5, atol=1e-6)
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names \
+            and "weight" not in names
+        opt = paddle.optimizer.SGD(parameters=lin.parameters(),
+                                   learning_rate=0.1)
+        (lin(x) ** 2).mean().backward()
+        gv = lin.weight_v.gradient()
+        assert gv is not None and np.abs(np.asarray(gv)).sum() > 0
+        opt.step()
+        nn.utils.remove_weight_norm(lin)
+        assert "weight" in dict(lin.named_parameters())
+
+    def test_spectral_norm_unit_sigma(self):
+        paddle.seed(2)
+        lin = nn.Linear(6, 5)
+        nn.utils.spectral_norm(lin, n_power_iterations=20)
+        lin(paddle.to_tensor(np.random.rand(1, 6).astype(np.float32)))
+        w = lin.weight.numpy()
+        assert abs(np.linalg.svd(w, compute_uv=False)[0] - 1.0) < 1e-3
+
+    def test_spectral_norm_module(self):
+        w = paddle.to_tensor(
+            np.random.RandomState(3).randn(5, 4).astype(np.float32))
+        sn = nn.SpectralNorm(w.shape, dim=0, power_iters=20)
+        out = sn(w)
+        assert abs(np.linalg.svd(out.numpy(), compute_uv=False)[0] - 1) < 1e-3
+
+
+class TestYoloLoss:
+    def test_finite_and_descends(self):
+        rng = np.random.RandomState(6)
+        paddle.seed(3)
+        x = paddle.to_tensor(rng.randn(2, 27, 8, 8).astype(np.float32) * 0.1,
+                             stop_gradient=False)
+        gtb = paddle.to_tensor(np.array(
+            [[[0.5, 0.5, 0.3, 0.4], [0.2, 0.3, 0.1, 0.1]]] * 2, np.float32))
+        gtl = paddle.to_tensor(np.array([[1, 2]] * 2, np.int64))
+        loss = vops.yolo_loss(
+            x, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
+            anchor_mask=[0, 1, 2], class_num=4, ignore_thresh=0.7,
+            downsample_ratio=32)
+        assert loss.shape == [2] and np.isfinite(loss.numpy()).all()
+        loss.sum().backward()
+        g = np.asarray(x.gradient())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_invalid_gt_ignored(self):
+        rng = np.random.RandomState(7)
+        x = paddle.to_tensor(rng.randn(1, 27, 4, 4).astype(np.float32) * 0.1)
+        gt0 = paddle.to_tensor(np.zeros((1, 3, 4), np.float32))  # all invalid
+        gl0 = paddle.to_tensor(np.zeros((1, 3), np.int64))
+        l0 = vops.yolo_loss(x, gt0, gl0, anchors=[10, 13, 16, 30, 33, 23],
+                            anchor_mask=[0, 1, 2], class_num=4,
+                            ignore_thresh=0.7, downsample_ratio=32)
+        # only the negative-objectness term survives
+        obj = np.asarray(x.numpy()).reshape(1, 3, 9, 4, 4)[:, :, 4]
+        want = (np.maximum(obj, 0) - 0 + np.log1p(np.exp(-np.abs(obj)))).sum()
+        np.testing.assert_allclose(float(l0.numpy()[0]), want, rtol=1e-5)
+
+
+class TestTopLevelStragglers:
+    def test_add_n_increment_renorm_reverse_crop(self):
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        b = paddle.to_tensor(np.full((2, 2), 2.0, np.float32))
+        np.testing.assert_allclose(paddle.add_n([a, b]).numpy(), 3.0)
+        c = paddle.to_tensor(np.zeros((1,), np.float32))
+        paddle.increment(c, 2.5)
+        np.testing.assert_allclose(c.numpy(), [2.5])
+        w = paddle.to_tensor(np.array([[3.0, 4.0], [0.3, 0.4]], np.float32))
+        rn = paddle.renorm(w, 2.0, 0, 1.0).numpy()
+        assert np.linalg.norm(rn[0]) <= 1.0 + 1e-5
+        np.testing.assert_allclose(np.linalg.norm(rn[1]),
+                                   np.linalg.norm(w.numpy()[1]), rtol=1e-5)
+        r = paddle.reverse(paddle.to_tensor(np.arange(4)), [0])
+        assert r.numpy().tolist() == [3, 2, 1, 0]
+        x = paddle.to_tensor(np.arange(24).reshape(2, 3, 4))
+        cr = paddle.crop(x, shape=[1, 2, -1], offsets=[1, 0, 2])
+        assert cr.shape == [1, 2, 2]
+        np.testing.assert_allclose(cr.numpy(), x.numpy()[1:2, 0:2, 2:])
+
+    def test_complex_and_dtype_predicates(self):
+        z = paddle.complex(paddle.to_tensor(np.ones(2, np.float32)),
+                           paddle.to_tensor(np.full(2, 2.0, np.float32)))
+        assert paddle.is_complex(z)
+        assert not paddle.is_complex(paddle.to_tensor(np.ones(2)))
+        assert paddle.is_floating_point(paddle.to_tensor(np.ones(2, np.float32)))
+        assert paddle.is_integer(paddle.to_tensor(np.ones(2, np.int32)))
+        np.testing.assert_allclose(z.numpy().real, 1.0)
+        np.testing.assert_allclose(z.numpy().imag, 2.0)
+
+    def test_shape_tolist_batch_paramattr(self):
+        x = paddle.to_tensor(np.zeros((2, 5), np.float32))
+        assert paddle.shape(x).numpy().tolist() == [2, 5]
+        assert paddle.tolist(paddle.to_tensor(np.array([1, 2]))) == [1, 2]
+        rd = paddle.batch(lambda: iter(range(7)), 3)
+        batches = list(rd())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+        rd2 = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert list(rd2()) == [[0, 1, 2], [3, 4, 5]]
+        pa = paddle.ParamAttr(name="w", learning_rate=0.5, need_clip=False)
+        assert pa.learning_rate == 0.5 and not pa.need_clip
+        assert paddle.check_shape([2, -1, 3])
+        with pytest.raises(ValueError):
+            paddle.check_shape([-1, -1])
+
+    def test_inplace_activations(self):
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        out = F.relu_(x)
+        np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+        np.testing.assert_allclose(out.numpy(), [0.0, 2.0])
+        t = paddle.to_tensor(np.array([0.5], np.float32))
+        F.tanh_(t)
+        np.testing.assert_allclose(t.numpy(), np.tanh(0.5), rtol=1e-6)
+
+    def test_householder_product_matches_torch(self):
+        rng = np.random.RandomState(8)
+        a = rng.randn(5, 3).astype(np.float32)
+        tq, ttau = torch.geqrf(torch.tensor(a))
+        want = torch.linalg.householder_product(tq, ttau).numpy()
+        got = paddle.linalg.householder_product(
+            paddle.to_tensor(tq.numpy()), paddle.to_tensor(ttau.numpy())).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        ab = rng.randn(2, 4, 3).astype(np.float32)
+        tq2, tt2 = torch.geqrf(torch.tensor(ab))
+        want2 = torch.linalg.householder_product(tq2, tt2).numpy()
+        got2 = paddle.linalg.householder_product(
+            paddle.to_tensor(tq2.numpy()), paddle.to_tensor(tt2.numpy())).numpy()
+        np.testing.assert_allclose(got2, want2, atol=1e-5)
+
+
+class TestMiscFunctional:
+    def test_dice_log_npair(self):
+        inp = np.eye(4, dtype=np.float32)[None].repeat(2, 0)
+        lb = np.arange(4)[None, :, None].repeat(2, 0)
+        assert float(F.dice_loss(paddle.to_tensor(inp.reshape(2, 4, 4)),
+                                 paddle.to_tensor(lb)).numpy()) < 1e-4
+        p = paddle.to_tensor(np.array([0.2, 0.9], np.float32))
+        y = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        np.testing.assert_allclose(
+            F.log_loss(p, y).numpy(),
+            [-np.log(0.8 + 1e-4), -np.log(0.9 + 1e-4)], rtol=1e-5)
+        rng = np.random.RandomState(9)
+        a = rng.randn(4, 8).astype(np.float32)
+        nl = F.npair_loss(paddle.to_tensor(a),
+                          paddle.to_tensor(a + 0.01),
+                          paddle.to_tensor(np.arange(4)))
+        assert np.isfinite(float(nl.numpy()))
+
+    def test_sequence_mask_diag_embed_zeropad(self):
+        sm = F.sequence_mask(paddle.to_tensor(np.array([2, 0, 4])),
+                             maxlen=5).numpy()
+        assert sm.tolist() == [[1, 1, 0, 0, 0], [0, 0, 0, 0, 0],
+                               [1, 1, 1, 1, 0]]
+        d = np.random.RandomState(10).randn(2, 3).astype(np.float32)
+        for off, d1, d2 in ((0, -2, -1), (1, -2, -1), (-1, 0, 1)):
+            got = F.diag_embed(paddle.to_tensor(d), off, d1, d2).numpy()
+            want = torch.diag_embed(torch.tensor(d), off, d1, d2).numpy()
+            np.testing.assert_allclose(got, want)
+        zp = F.zeropad2d(paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32)),
+                         [1, 0, 2, 1]).numpy()
+        assert zp.shape == (1, 1, 5, 3)
+        assert zp.sum() == 4.0 and zp[0, 0, 2, 1] == 1.0
+
+    def test_gather_tree(self):
+        ids = np.array([[[2, 2], [6, 1]], [[3, 9], [5, 1]], [[0, 1], [9, 0]]])
+        par = np.array([[[0, 0], [0, 0]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]])
+        got = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(par))
+        assert got.numpy().tolist() == [[[2, 2], [1, 6]], [[3, 3], [5, 1]],
+                                        [[0, 1], [9, 0]]]
+
+    def test_sparse_attention_matches_dense_on_full_pattern(self):
+        rng = np.random.RandomState(11)
+        B, H, S, D = 1, 2, 4, 8
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        # full CSR pattern == dense softmax attention
+        off = np.tile(np.arange(0, S * S + 1, S), (B, H, 1)).astype(np.int32)
+        col = np.tile(np.tile(np.arange(S), S), (B, H, 1)).astype(np.int32)
+        got = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(q),
+                                 paddle.to_tensor(q), paddle.to_tensor(off),
+                                 paddle.to_tensor(col)).numpy()
+        s = q @ q.transpose(0, 1, 3, 2) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, p @ q, rtol=2e-4, atol=1e-5)
+
+    def test_thresholded_relu_and_pairwise_distance(self):
+        x = paddle.to_tensor(np.array([0.5, 1.5, -2.0], np.float32))
+        np.testing.assert_allclose(F.thresholded_relu(x).numpy(),
+                                   [0.0, 1.5, 0.0])
+        assert isinstance(nn.ThresholdedReLU(), nn.Layer)
+        a = np.random.RandomState(12).randn(3, 4).astype(np.float32)
+        b = np.random.RandomState(13).randn(3, 4).astype(np.float32)
+        got = nn.PairwiseDistance(p=2.0)(paddle.to_tensor(a),
+                                         paddle.to_tensor(b)).numpy()
+        want = torch.nn.PairwiseDistance(p=2.0)(torch.tensor(a),
+                                                torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
