@@ -9,12 +9,24 @@
 // and native servers freely; the python PsClient drives both.
 //
 // Commands: 1 PULL_SPARSE  ids[n]u64            -> rows[n*dim]f32
-//           2 PUSH_SPARSE  ids[n]u64 g[n*dim]   -> ok        (w -= lr*g)
+//           2 PUSH_SPARSE  ids[n]u64 g[n*dim]   -> ok  (table's optimizer)
 //           3 PULL_DENSE                        -> i64 size, i64 shard_lo,
 //                                                  i64 total, w[size]f32
-//           4 PUSH_DENSE   g[n]f32              -> ok        (w -= lr*g)
+//           4 PUSH_DENSE   g[n]f32              -> ok  (table's optimizer)
 //           5 STOP                              -> ok, server exits
 //           6 BARRIER      n participants       -> ok once n arrived
+//           7 PUSH_SHOW_CLICK ids[n]u64 shows[n]f32 clicks[n]f32 -> ok
+//             (CTR accessor statistics, ctr_accessor.cc UpdateShowClick)
+//           8 DECAY                             -> ok  (daily time decay)
+//           9 SHRINK                            -> ok, i64 evicted
+//          10 ADD_SPARSE   cfg (table-config negotiation: f32 lr, f32
+//             init_std, i64 seed, u8 opt{0 sgd,1 adagrad,2 adam}, u8
+//             has_ctr, f32 b1, f32 b2, f32 eps, f32 show_decay, f32
+//             click_coeff, f32 del_thresh, f32 ttl_days) -> ok
+//          11 ADD_DENSE    cfg (f32 lr, i64 shard_lo, i64 total, u8 opt,
+//             f32 b1, f32 b2, f32 eps)          -> ok
+// Optimizer numerics mirror the python tier's _SGDRule/_AdagradRule/
+// _AdamRule (distributed/ps/table.py) so mixed clusters converge equally.
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -38,7 +50,10 @@
 namespace {
 
 constexpr uint8_t kPullSparse = 1, kPushSparse = 2, kPullDense = 3,
-                  kPushDense = 4, kStop = 5, kBarrier = 6;
+                  kPushDense = 4, kStop = 5, kBarrier = 6,
+                  kPushShowClick = 7, kDecay = 8, kShrink = 9,
+                  kAddSparse = 10, kAddDense = 11;
+constexpr uint8_t kOptSgd = 0, kOptAdagrad = 1, kOptAdam = 2;
 constexpr int64_t kMaxRows = 1LL << 24;
 constexpr int64_t kMaxDim = 1LL << 16;
 constexpr int64_t kMaxElems = 1LL << 28;
@@ -90,23 +105,79 @@ float init_normal(uint64_t seed, uint64_t id, uint64_t j, float std) {
   return static_cast<float>(z * std);
 }
 
+struct OptCfg {
+  uint8_t type = kOptSgd;
+  float b1 = 0.9f, b2 = 0.999f, eps = 1e-8f;
+};
+
+struct CtrCfg {
+  bool enabled = false;
+  float show_decay = 0.98f, click_coeff = 8.0f;
+  float del_thresh = 0.8f, ttl_days = 30.0f;
+};
+
+struct SparseRow {
+  std::vector<float> w;
+  std::vector<float> s1;  // adagrad g2 / adam m
+  std::vector<float> s2;  // adam v
+  float t = 0.0f;         // adam per-row step (lazy adam contract)
+  float show = 0.0f, click = 0.0f, unseen = 0.0f;  // ctr stats
+};
+
 struct SparseTable {
   int64_t dim;
   float lr;
   float init_std;
   uint64_t seed;
+  OptCfg opt;
+  CtrCfg ctr;
   std::mutex mu;
-  std::unordered_map<int64_t, std::vector<float>> rows;
+  std::unordered_map<int64_t, SparseRow> rows;
 
-  std::vector<float>& row(int64_t id) {
+  SparseRow& row(int64_t id) {
     auto it = rows.find(id);
     if (it != rows.end()) return it->second;
-    std::vector<float> r(static_cast<size_t>(dim));
+    SparseRow r;
+    r.w.resize(static_cast<size_t>(dim));
     for (int64_t j = 0; j < dim; ++j)
-      r[static_cast<size_t>(j)] = init_normal(seed, static_cast<uint64_t>(id),
-                                              static_cast<uint64_t>(j),
-                                              init_std);
+      r.w[static_cast<size_t>(j)] = init_normal(
+          seed, static_cast<uint64_t>(id), static_cast<uint64_t>(j), init_std);
+    if (opt.type == kOptAdagrad) r.s1.assign(static_cast<size_t>(dim), 0.0f);
+    if (opt.type == kOptAdam) {
+      r.s1.assign(static_cast<size_t>(dim), 0.0f);
+      r.s2.assign(static_cast<size_t>(dim), 0.0f);
+    }
     return rows.emplace(id, std::move(r)).first->second;
+  }
+
+  // python _RULES numerics (table.py): float32 arithmetic throughout
+  void apply(SparseRow& r, const float* g) {
+    switch (opt.type) {
+      case kOptAdagrad:
+        for (int64_t j = 0; j < dim; ++j) {
+          size_t k = static_cast<size_t>(j);
+          r.s1[k] += g[j] * g[j];
+          r.w[k] -= lr * g[j] / (std::sqrt(r.s1[k]) + opt.eps);
+        }
+        break;
+      case kOptAdam: {
+        r.t += 1.0f;
+        float bc1 = 1.0f - std::pow(opt.b1, r.t);
+        float bc2 = 1.0f - std::pow(opt.b2, r.t);
+        for (int64_t j = 0; j < dim; ++j) {
+          size_t k = static_cast<size_t>(j);
+          r.s1[k] = opt.b1 * r.s1[k] + (1.0f - opt.b1) * g[j];
+          r.s2[k] = opt.b2 * r.s2[k] + (1.0f - opt.b2) * g[j] * g[j];
+          float mhat = r.s1[k] / bc1;
+          float vhat = r.s2[k] / bc2;
+          r.w[k] -= lr * mhat / (std::sqrt(vhat) + opt.eps);
+        }
+        break;
+      }
+      default:
+        for (int64_t j = 0; j < dim; ++j)
+          r.w[static_cast<size_t>(j)] -= lr * g[j];
+    }
   }
 };
 
@@ -114,8 +185,46 @@ struct DenseTable {
   float lr;
   int64_t shard_lo = 0;
   int64_t total = 0;
+  OptCfg opt;
+  float t = 0.0f;
   std::mutex mu;
-  std::vector<float> w;
+  std::vector<float> w, s1, s2;
+
+  void ensure_slots() {
+    if (opt.type == kOptAdagrad && s1.size() != w.size())
+      s1.assign(w.size(), 0.0f);
+    if (opt.type == kOptAdam && s1.size() != w.size()) {
+      s1.assign(w.size(), 0.0f);
+      s2.assign(w.size(), 0.0f);
+    }
+  }
+
+  void apply(const float* g, int64_t n) {
+    ensure_slots();
+    switch (opt.type) {
+      case kOptAdagrad:
+        for (int64_t i = 0; i < n; ++i) {
+          size_t k = static_cast<size_t>(i);
+          s1[k] += g[i] * g[i];
+          w[k] -= lr * g[i] / (std::sqrt(s1[k]) + opt.eps);
+        }
+        break;
+      case kOptAdam: {
+        t += 1.0f;
+        float bc1 = 1.0f - std::pow(opt.b1, t);
+        float bc2 = 1.0f - std::pow(opt.b2, t);
+        for (int64_t i = 0; i < n; ++i) {
+          size_t k = static_cast<size_t>(i);
+          s1[k] = opt.b1 * s1[k] + (1.0f - opt.b1) * g[i];
+          s2[k] = opt.b2 * s2[k] + (1.0f - opt.b2) * g[i] * g[i];
+          w[k] -= lr * (s1[k] / bc1) / (std::sqrt(s2[k] / bc2) + opt.eps);
+        }
+        break;
+      }
+      default:
+        for (int64_t i = 0; i < n; ++i) w[static_cast<size_t>(i)] -= lr * g[i];
+    }
+  }
 };
 
 struct Server {
@@ -187,7 +296,7 @@ void handle_conn(Server* s, int fd) {
     // stream in sync (python server does the same)
     std::vector<int64_t> ids;
     std::vector<float> payload;
-    if (cmd == kPullSparse || cmd == kPushSparse) {
+    if (cmd == kPullSparse || cmd == kPushSparse || cmd == kPushShowClick) {
       ids.resize(static_cast<size_t>(n));
       if (!read_full(fd, ids.data(), static_cast<size_t>(n) * 8)) break;
     }
@@ -197,6 +306,95 @@ void handle_conn(Server* s, int fd) {
     } else if (cmd == kPushDense) {
       payload.resize(static_cast<size_t>(n));
       if (!read_full(fd, payload.data(), payload.size() * 4)) break;
+    } else if (cmd == kPushShowClick) {
+      payload.resize(static_cast<size_t>(n) * 2);  // shows then clicks
+      if (!read_full(fd, payload.data(), payload.size() * 4)) break;
+    }
+    // table-config negotiation frames (fixed-size config payloads)
+    if (cmd == kAddSparse) {
+      uint8_t cfg[46];  // lr,std f32 | seed i64 | opt,ctr u8 | 7x f32
+      if (!read_full(fd, cfg, sizeof(cfg))) break;
+      float lr, istd, b1, b2, eps, sdec, ccoef, dth, ttl;
+      int64_t seed;
+      std::memcpy(&lr, cfg + 0, 4);
+      std::memcpy(&istd, cfg + 4, 4);
+      std::memcpy(&seed, cfg + 8, 8);
+      uint8_t optid = cfg[16], hasctr = cfg[17];
+      std::memcpy(&b1, cfg + 18, 4);
+      std::memcpy(&b2, cfg + 22, 4);
+      std::memcpy(&eps, cfg + 26, 4);
+      std::memcpy(&sdec, cfg + 30, 4);
+      std::memcpy(&ccoef, cfg + 34, 4);
+      std::memcpy(&dth, cfg + 38, 4);
+      std::memcpy(&ttl, cfg + 42, 4);
+      if (optid > kOptAdam || dim <= 0) {
+        if (!send_err(fd, "ps: bad sparse table config")) break;
+        continue;
+      }
+      auto t = std::make_unique<SparseTable>();
+      t->dim = dim;
+      t->lr = lr;
+      t->init_std = istd;
+      t->seed = static_cast<uint64_t>(seed);
+      t->opt.type = optid;
+      t->opt.b1 = b1;
+      t->opt.b2 = b2;
+      t->opt.eps = eps;
+      t->ctr.enabled = hasctr != 0;
+      t->ctr.show_decay = sdec;
+      t->ctr.click_coeff = ccoef;
+      t->ctr.del_thresh = dth;
+      t->ctr.ttl_days = ttl;
+      {
+        std::lock_guard<std::mutex> lk(s->tables_mu);
+        if (s->sparse.count(name) || s->dense.count(name)) {
+          if (!send_err(fd, "ps: table '" + name + "' already registered"))
+            break;
+          continue;
+        }
+        s->sparse[name] = std::move(t);
+      }
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+      continue;
+    }
+    if (cmd == kAddDense) {
+      uint8_t cfg[33];  // lr f32 | shard_lo,total i64 | opt u8 | b1,b2,eps
+      if (!read_full(fd, cfg, sizeof(cfg))) break;
+      float lr, b1, b2, eps;
+      int64_t lo, total;
+      std::memcpy(&lr, cfg + 0, 4);
+      std::memcpy(&lo, cfg + 4, 8);
+      std::memcpy(&total, cfg + 12, 8);
+      uint8_t optid = cfg[20];
+      std::memcpy(&b1, cfg + 21, 4);
+      std::memcpy(&b2, cfg + 25, 4);
+      std::memcpy(&eps, cfg + 29, 4);
+      if (optid > kOptAdam || n < 0) {
+        if (!send_err(fd, "ps: bad dense table config")) break;
+        continue;
+      }
+      auto t = std::make_unique<DenseTable>();
+      t->lr = lr;
+      t->shard_lo = lo;
+      t->total = total > 0 ? total : n;
+      t->opt.type = optid;
+      t->opt.b1 = b1;
+      t->opt.b2 = b2;
+      t->opt.eps = eps;
+      t->w.assign(static_cast<size_t>(n), 0.0f);
+      {
+        std::lock_guard<std::mutex> lk(s->tables_mu);
+        if (s->sparse.count(name) || s->dense.count(name)) {
+          if (!send_err(fd, "ps: table '" + name + "' already registered"))
+            break;
+          continue;
+        }
+        s->dense[name] = std::move(t);
+      }
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+      continue;
     }
 
     if (cmd == kStop) {
@@ -239,7 +437,7 @@ void handle_conn(Server* s, int fd) {
           std::lock_guard<std::mutex> lk(st->mu);
           for (int64_t i = 0; i < n; ++i) {
             auto& r = st->row(ids[static_cast<size_t>(i)]);
-            std::memcpy(out.data() + i * st->dim, r.data(),
+            std::memcpy(out.data() + i * st->dim, r.w.data(),
                         static_cast<size_t>(st->dim) * 4);
           }
         }
@@ -253,16 +451,82 @@ void handle_conn(Server* s, int fd) {
           continue;
         }
         {
+          // accumulate duplicate ids before applying — ONE optimizer step
+          // per key, matching the python SparseTable.push contract (for
+          // adam/adagrad a per-occurrence loop would advance the slots
+          // twice and break mixed-cluster numeric parity)
           std::lock_guard<std::mutex> lk(st->mu);
+          std::unordered_map<int64_t, std::vector<float>> acc;
           for (int64_t i = 0; i < n; ++i) {
-            auto& r = st->row(ids[static_cast<size_t>(i)]);
+            auto& a = acc[ids[static_cast<size_t>(i)]];
             const float* g = payload.data() + i * dim;
-            for (int64_t j = 0; j < dim; ++j)
-              r[static_cast<size_t>(j)] -= st->lr * g[j];
+            if (a.empty())
+              a.assign(g, g + dim);
+            else
+              for (int64_t j = 0; j < dim; ++j) a[static_cast<size_t>(j)] += g[j];
+          }
+          for (auto& kv : acc) {
+            auto& r = st->row(kv.first);
+            st->apply(r, kv.second.data());
           }
         }
         uint8_t ok = 1;
         if (!write_full(fd, &ok, 1)) break;
+      }
+      continue;
+    }
+    if (cmd == kPushShowClick || cmd == kDecay || cmd == kShrink) {
+      if (!st) {
+        if (!send_err(fd, "ps: unknown table '" + name + "'")) break;
+        continue;
+      }
+      if (!st->ctr.enabled) {
+        if (!send_err(fd, "ps: table '" + name + "' has no ctr accessor"))
+          break;
+        continue;
+      }
+      if (cmd == kPushShowClick) {
+        // ctr_accessor.cc UpdateShowClick: bump counters, reset unseen
+        std::lock_guard<std::mutex> lk(st->mu);
+        const float* shows = payload.data();
+        const float* clicks = payload.data() + n;
+        for (int64_t i = 0; i < n; ++i) {
+          auto& r = st->row(ids[static_cast<size_t>(i)]);
+          r.show += shows[i];
+          r.click += clicks[i];
+          r.unseen = 0.0f;
+        }
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else if (cmd == kDecay) {
+        // UpdateTimeDecay (daily): decay counters, age rows
+        std::lock_guard<std::mutex> lk(st->mu);
+        for (auto& kv : st->rows) {
+          kv.second.show *= st->ctr.show_decay;
+          kv.second.click *= st->ctr.show_decay;
+          kv.second.unseen += 1.0f;
+        }
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else {
+        // Table::Shrink: evict low-score / expired rows
+        int64_t evicted = 0;
+        {
+          std::lock_guard<std::mutex> lk(st->mu);
+          for (auto it = st->rows.begin(); it != st->rows.end();) {
+            const auto& r = it->second;
+            float score = r.show + st->ctr.click_coeff * r.click;
+            if (score < st->ctr.del_thresh ||
+                r.unseen > st->ctr.ttl_days) {
+              it = st->rows.erase(it);
+              ++evicted;
+            } else {
+              ++it;
+            }
+          }
+        }
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1) || !write_full(fd, &evicted, 8)) break;
       }
       continue;
     }
@@ -287,8 +551,7 @@ void handle_conn(Server* s, int fd) {
         }
         {
           std::lock_guard<std::mutex> lk(dt->mu);
-          for (int64_t i = 0; i < n; ++i)
-            dt->w[static_cast<size_t>(i)] -= dt->lr * payload[i];
+          dt->apply(payload.data(), n);
         }
         uint8_t ok = 1;
         if (!write_full(fd, &ok, 1)) break;
@@ -300,10 +563,13 @@ void handle_conn(Server* s, int fd) {
   }
   ::close(fd);
   {
+    // erase AND notify under the lock: stop()'s wait_for could otherwise
+    // observe conns.empty() between our unlock and notify, delete the
+    // Server, and leave this notify_all touching a freed cv
     std::lock_guard<std::mutex> lk(s->conn_mu);
     s->conns.erase(fd);
+    s->conn_cv.notify_all();
   }
-  s->conn_cv.notify_all();
 }
 
 }  // namespace
@@ -373,6 +639,57 @@ int ps_native_add_dense(void* h, const char* name, long long size, float lr,
   t->lr = lr;
   t->shard_lo = shard_lo;
   t->total = total > 0 ? total : size;
+  t->w.assign(static_cast<size_t>(size), 0.0f);
+  std::lock_guard<std::mutex> lk(s->tables_mu);
+  if (s->sparse.count(name) || s->dense.count(name)) return -2;
+  s->dense[name] = std::move(t);
+  return 0;
+}
+
+int ps_native_add_sparse_v2(void* h, const char* name, long long dim,
+                            float lr, float init_std, long long seed,
+                            int opt_id, float b1, float b2, float eps,
+                            int has_ctr, float show_decay, float click_coeff,
+                            float del_thresh, float ttl_days) {
+  auto* s = static_cast<Server*>(h);
+  if (!s || !name || std::strlen(name) > 16 || dim <= 0 || opt_id < 0 ||
+      opt_id > kOptAdam)
+    return -1;
+  auto t = std::make_unique<SparseTable>();
+  t->dim = dim;
+  t->lr = lr;
+  t->init_std = init_std;
+  t->seed = static_cast<uint64_t>(seed);
+  t->opt.type = static_cast<uint8_t>(opt_id);
+  t->opt.b1 = b1;
+  t->opt.b2 = b2;
+  t->opt.eps = eps;
+  t->ctr.enabled = has_ctr != 0;
+  t->ctr.show_decay = show_decay;
+  t->ctr.click_coeff = click_coeff;
+  t->ctr.del_thresh = del_thresh;
+  t->ctr.ttl_days = ttl_days;
+  std::lock_guard<std::mutex> lk(s->tables_mu);
+  if (s->sparse.count(name) || s->dense.count(name)) return -2;
+  s->sparse[name] = std::move(t);
+  return 0;
+}
+
+int ps_native_add_dense_v2(void* h, const char* name, long long size,
+                           float lr, long long shard_lo, long long total,
+                           int opt_id, float b1, float b2, float eps) {
+  auto* s = static_cast<Server*>(h);
+  if (!s || !name || std::strlen(name) > 16 || size < 0 || opt_id < 0 ||
+      opt_id > kOptAdam)
+    return -1;
+  auto t = std::make_unique<DenseTable>();
+  t->lr = lr;
+  t->shard_lo = shard_lo;
+  t->total = total > 0 ? total : size;
+  t->opt.type = static_cast<uint8_t>(opt_id);
+  t->opt.b1 = b1;
+  t->opt.b2 = b2;
+  t->opt.eps = eps;
   t->w.assign(static_cast<size_t>(size), 0.0f);
   std::lock_guard<std::mutex> lk(s->tables_mu);
   if (s->sparse.count(name) || s->dense.count(name)) return -2;

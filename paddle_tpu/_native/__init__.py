@@ -132,6 +132,18 @@ def _load():
         lib.ps_native_add_dense.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
             ctypes.c_float, ctypes.c_longlong, ctypes.c_longlong]
+        lib.ps_native_add_sparse_v2.restype = ctypes.c_int
+        lib.ps_native_add_sparse_v2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_float, ctypes.c_float, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float]
+        lib.ps_native_add_dense_v2.restype = ctypes.c_int
+        lib.ps_native_add_dense_v2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_float, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float]
         _lib = lib
         return _lib
 

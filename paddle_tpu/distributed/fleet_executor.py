@@ -77,10 +77,14 @@ class Interceptor:
     def send(self, dst: int, kind: str, payload=None, micro=-1):
         self.bus.send(Message(self.iid, dst, kind, payload, micro))
 
-    def join(self):
-        self.bus.send(Message(-1, self.iid, "stop"))
+    def join(self, send_stop: bool = True):
+        # send_stop=False: a remote carrier owns shutdown (its broadcast
+        # stop message ends the loop) — sending our own here would kill
+        # the actor with microbatches still queued behind backpressure
+        if send_stop:
+            self.bus.send(Message(-1, self.iid, "stop"))
         if self._thread is not None:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=120)
         if self._error is not None:
             raise RuntimeError(
                 f"interceptor {self.iid} failed") from self._error
@@ -231,3 +235,239 @@ class DistModel:
         micros = np.array_split(np.asarray(x), n_micro)
         outs = self._fleet.run(micros)
         return np.concatenate([np.asarray(o) for o in outs], axis=0)
+
+
+# ---- cross-process message bus + carrier ------------------------------------
+# The reference's MessageBus spans hosts over brpc (message_bus.cc: every
+# Carrier registers its interceptor ids; InterceptorMessage routes by id).
+# Here the transport is a per-process TCP listener + cached client sockets,
+# with endpoints rendezvoused through the TCPStore (the same bootstrap path
+# the collective env uses). Frames are length-prefixed pickles — a trusted
+# control plane inside one training cluster, like the reference's RPC.
+
+class DistMessageBus(MessageBus):
+    """Message bus whose interceptors live across processes.
+
+    owner_of: interceptor id -> rank. Local ids route to in-process
+    queues; remote ids serialize over a socket to the owning rank's
+    listener. Every rank must construct the bus (it publishes its
+    endpoint under `fleetbus/{rank}` and resolves its peers').
+    """
+
+    def __init__(self, store, rank: int, nranks: int, owner_of: Dict[int, int],
+                 host: str = "127.0.0.1"):
+        super().__init__()
+        import pickle
+        import socket as _socket
+        import struct as _struct
+        import time as _time
+        self._pickle, self._struct, self._socket = pickle, _struct, _socket
+        self.rank, self.nranks = rank, nranks
+        self.owner_of = dict(owner_of)
+        self._conns: Dict[int, object] = {}
+        self._conn_lock = threading.Lock()       # guards the conn MAP only
+        self._peer_locks: Dict[int, threading.Lock] = {}  # serialize frames
+        self._stop = threading.Event()
+
+        self._lsock = _socket.socket()
+        self._lsock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, 0))
+        self._lsock.listen(16)
+        self._port = self._lsock.getsockname()[1]
+        store.set(f"fleetbus/{rank}", f"{host}:{self._port}")
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+        self.endpoints: Dict[int, str] = {}
+        deadline = _time.time() + 60
+        for r in range(nranks):
+            if r == rank:
+                continue
+            while True:
+                try:
+                    self.endpoints[r] = store.get(f"fleetbus/{r}").decode()
+                    break
+                except KeyError:
+                    if _time.time() > deadline:
+                        raise TimeoutError(
+                            f"fleet bus: rank {r} endpoint never appeared")
+                    _time.sleep(0.05)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn):
+        import struct as _struct
+        try:
+            while True:
+                hdr = b""
+                while len(hdr) < 8:
+                    chunk = conn.recv(8 - len(hdr))
+                    if not chunk:
+                        return
+                    hdr += chunk
+                (ln,) = _struct.unpack("<q", hdr)
+                data = b""
+                while len(data) < ln:
+                    chunk = conn.recv(min(1 << 20, ln - len(data)))
+                    if not chunk:
+                        return
+                    data += chunk
+                src, dst, kind, payload, micro = self._pickle.loads(data)
+                msg = Message(src, dst, kind, payload, micro)
+                # local delivery (register() may race: wait for the inbox)
+                q = self._inboxes.get(msg.dst)
+                if q is None:
+                    import time as _time
+                    for _ in range(600):
+                        q = self._inboxes.get(msg.dst)
+                        if q is not None:
+                            break
+                        _time.sleep(0.05)
+                if q is None:
+                    continue  # undeliverable after grace: drop (stop race)
+                q.put(msg)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _peer_lock(self, r: int) -> threading.Lock:
+        with self._conn_lock:
+            lk = self._peer_locks.get(r)
+            if lk is None:
+                lk = self._peer_locks[r] = threading.Lock()
+            return lk
+
+    def _remote_sock(self, r: int):
+        # caller holds the PER-PEER lock; _conn_lock only guards the map,
+        # so one slow peer's connect/send cannot head-of-line block sends
+        # to every other peer
+        with self._conn_lock:
+            sk = self._conns.get(r)
+        if sk is None:
+            host, port = self.endpoints[r].rsplit(":", 1)
+            sk = self._socket.create_connection((host, int(port)),
+                                                timeout=60)
+            sk.setsockopt(self._socket.IPPROTO_TCP,
+                          self._socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                self._conns[r] = sk
+        return sk
+
+    def send(self, msg: Message):
+        owner = self.owner_of.get(msg.dst, self.rank)
+        if owner == self.rank:
+            self._inboxes[msg.dst].put(msg)
+            return
+        # serialize as a plain tuple: Message's defining module may be
+        # loaded under a different name in the peer (spec-loaded runners)
+        data = self._pickle.dumps(
+            (msg.src, msg.dst, msg.kind, msg.payload, msg.micro),
+            protocol=self._pickle.HIGHEST_PROTOCOL)
+        with self._peer_lock(owner):
+            sk = self._remote_sock(owner)
+            sk.sendall(self._struct.pack("<q", len(data)) + data)
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for sk in self._conns.values():
+                try:
+                    sk.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+class DistFleetExecutor:
+    """Carrier spanning processes: each rank hosts the interceptors of the
+    stages it owns; microbatches stream across the bus exactly as in the
+    single-process FleetExecutor (same ComputeInterceptor credit protocol,
+    reference carrier.cc + compute_interceptor.cc over message_bus.cc).
+
+    stage_owner: stage index -> rank. The sink interceptor (id =
+    n_stages) lives with the LAST stage's rank and that rank's run()
+    returns the ordered outputs; other ranks return None. The sink owner
+    broadcasts the stop control messages, so every rank's run() joins
+    cleanly (dist_model.cc's run-then-drain contract).
+    """
+
+    def __init__(self, my_stages: Dict[int, Callable], n_stages: int,
+                 stage_owner: Dict[int, int], bus: DistMessageBus,
+                 max_inflight: int = 2):
+        self.my_stages = dict(my_stages)
+        self.n_stages = n_stages
+        self.stage_owner = dict(stage_owner)
+        self.bus = bus
+        self.max_inflight = max_inflight
+        self.sink_id = n_stages
+        self.sink_owner = stage_owner[n_stages - 1]
+        owner_map = dict(stage_owner)
+        owner_map[self.sink_id] = self.sink_owner
+        bus.owner_of.update(owner_map)
+
+    def run(self, microbatches: Optional[Sequence] = None, n_micro: int = 0,
+            timeout: float = 120.0):
+        rank = self.bus.rank
+        n_micro = len(microbatches) if microbatches is not None else n_micro
+        if n_micro <= 0:
+            raise ValueError("run() needs microbatches or n_micro")
+        actors: List[Interceptor] = []
+        for sid, fn in self.my_stages.items():
+            actors.append(ComputeInterceptor(
+                sid, self.bus, fn,
+                downstream=(sid + 1) if sid + 1 < self.n_stages
+                else self.sink_id,
+                upstream=(sid - 1) if sid > 0 else None,
+                max_inflight=self.max_inflight))
+        sink = None
+        if rank == self.sink_owner:
+            sink = SinkInterceptor(self.sink_id, self.bus, n_micro,
+                                   upstream=self.n_stages - 1)
+            actors.append(sink)
+        for a in actors:
+            a.start()
+        if self.stage_owner[0] == rank:
+            if microbatches is None:
+                raise ValueError("the stage-0 rank must supply microbatches")
+            for m, payload in enumerate(microbatches):
+                self.bus.send(Message(-1, 0, "data", payload, m))
+        import time as _time
+        if sink is not None:
+            deadline = _time.time() + timeout
+            while not sink.done.is_set():
+                if any(a._error is not None for a in actors):
+                    break
+                if _time.time() > deadline:
+                    for sid in range(self.n_stages + 1):   # incl. the sink
+                        self.bus.send(Message(-1, sid, "stop"))
+                    raise TimeoutError("DistFleetExecutor: did not drain")
+                sink.done.wait(0.01)
+            # broadcast stop to EVERY stage cluster-wide, then our sink
+            for sid in range(self.n_stages):
+                self.bus.send(Message(-1, sid, "stop"))
+        first = None
+        for a in actors:
+            try:
+                # only the sink owner originates stops (broadcast above);
+                # other ranks wait for those to arrive over the bus
+                a.join(send_stop=(sink is not None and a is sink))
+            except RuntimeError as e:
+                first = first or e
+        if first is not None:
+            raise first
+        if sink is not None:
+            return [sink.results[m] for m in range(n_micro)]
+        return None

@@ -1,0 +1,105 @@
+"""GraphTable — node/edge store + neighbor sampling on the PS plane.
+
+Reference parity: `paddle/fluid/distributed/ps/table/common_graph_table.h:355`
+(GraphTable: edge lists per node with optional weights, node features,
+`random_sample_neighbors`, `random_sample_nodes`, `get_node_feat`) — the
+GNN-sampling backend PGL drives through the PS service.
+
+TPU-first contract: `sample_neighbors` returns FIXED-SHAPE [n, k] id/weight
+arrays (pad id -1), sampling with replacement — downstream GNN minibatch
+programs keep static shapes and jit without data-dependent padding logic.
+The store itself is host-side (the reference's is too — graph sampling is
+a CPU-side service feeding the accelerator).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class GraphTable:
+    def __init__(self, weighted: bool = True, feat_dim: int = 0, seed: int = 0):
+        self._lock = threading.Lock()
+        self.weighted = weighted
+        self.feat_dim = int(feat_dim)
+        self._adj: Dict[int, List[int]] = {}
+        self._w: Dict[int, List[float]] = {}
+        self._feat: Dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(seed)
+
+    # ---- construction (load_edges / load_nodes roles) ----
+    def add_edges(self, src, dst, weight=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        w = (np.asarray(weight, np.float32).reshape(-1) if weight is not None
+             else np.ones(len(src), np.float32))
+        if not (len(src) == len(dst) == len(w)):
+            raise ValueError("add_edges: src/dst/weight length mismatch")
+        with self._lock:
+            for s, d, wt in zip(src, dst, w):
+                self._adj.setdefault(int(s), []).append(int(d))
+                self._w.setdefault(int(s), []).append(float(wt))
+                self._adj.setdefault(int(d), self._adj.get(int(d), []))
+
+    def set_node_feat(self, ids, feats):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        feats = np.asarray(feats, np.float32).reshape(len(ids), -1)
+        if self.feat_dim and feats.shape[1] != self.feat_dim:
+            raise ValueError(
+                f"feat dim {feats.shape[1]} != table feat_dim {self.feat_dim}")
+        with self._lock:
+            for i, f in zip(ids, feats):
+                self._feat[int(i)] = f.copy()
+
+    # ---- queries ----
+    def n_nodes(self) -> int:
+        with self._lock:
+            return len(self._adj)
+
+    def neighbors(self, node: int):
+        with self._lock:
+            return (list(self._adj.get(int(node), [])),
+                    list(self._w.get(int(node), [])))
+
+    def sample_neighbors(self, ids, k: int):
+        """[n] ids -> ([n, k] neighbor ids, [n, k] weights); pad -1/0.
+        Weighted tables sample proportionally to edge weight (reference
+        WeightedSampler); unweighted uniformly; always with replacement."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.full((len(ids), k), -1, np.int64)
+        ow = np.zeros((len(ids), k), np.float32)
+        with self._lock:
+            for r, i in enumerate(ids):
+                nbrs = self._adj.get(int(i))
+                if not nbrs:
+                    continue
+                w = np.asarray(self._w[int(i)], np.float64)
+                p = w / w.sum() if self.weighted and w.sum() > 0 else None
+                sel = self._rng.choice(len(nbrs), size=k, replace=True, p=p)
+                out[r] = np.asarray(nbrs, np.int64)[sel]
+                ow[r] = np.asarray(self._w[int(i)], np.float32)[sel]
+        return out, ow
+
+    def random_sample_nodes(self, k: int):
+        with self._lock:
+            pool = np.fromiter(self._adj.keys(), np.int64, len(self._adj))
+        if len(pool) == 0:
+            return np.empty(0, np.int64)
+        return self._rng.choice(pool, size=min(k, len(pool)), replace=False)
+
+    def get_node_feat(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        d = self.feat_dim or (next(iter(self._feat.values())).shape[0]
+                              if self._feat else 0)
+        out = np.zeros((len(ids), d), np.float32)
+        with self._lock:
+            for r, i in enumerate(ids):
+                f = self._feat.get(int(i))
+                if f is not None:
+                    out[r, :len(f)] = f
+        return out
+
+    def state(self):
+        return {"adj": self._adj, "w": self._w, "feat": self._feat}

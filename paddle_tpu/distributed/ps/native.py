@@ -5,10 +5,11 @@ plane is native C++; this exposes `csrc/ps_server.cpp` (same wire protocol
 as the python `PsServer`) through the ctypes bridge. A cluster may mix
 python and native servers; the python `PsClient` drives both unchanged.
 
-Scope: the high-QPS data plane (SGD sparse/dense tables, barrier, error
-frames). Rich table features — adam/adagrad slots, CTR accessor, TTL
-shrink, SSD spill, save/load — live in the python tier (`service.PsServer`),
-which remains the full-featured server.
+Scope: the high-QPS data plane — sgd/adagrad/adam sparse+dense tables
+with per-row optimizer slots, the CTR accessor (show/click stats, time
+decay, TTL/score shrink), barrier, error frames, and remote table-config
+negotiation. SSD spill and save/load remain python-tier features
+(`service.PsServer`).
 """
 from __future__ import annotations
 
@@ -44,25 +45,37 @@ class NativePsServer:
 
     def add_sparse_table(self, name: str, dim: int, lr: float = 0.01,
                          init_std: float = 0.01, seed: int = 0,
-                         optimizer: str = "sgd"):
-        if optimizer != "sgd":
+                         optimizer: str = "sgd", accessor=None,
+                         beta1: float = 0.9, beta2: float = 0.999,
+                         eps: float = 1e-8, show_decay_rate: float = 0.98,
+                         click_coeff: float = 8.0,
+                         delete_threshold: float = 0.8,
+                         ttl_days: float = 30.0):
+        opt_ids = {"sgd": 0, "adagrad": 1, "adam": 2, "lazy_adam": 2}
+        if optimizer not in opt_ids:
             raise NotImplementedError(
-                "the native data plane ships SGD tables; richer optimizers "
-                "live in the python PsServer")
-        rc = self._lib.ps_native_add_sparse(
+                f"native PS optimizer {optimizer!r} (have {sorted(opt_ids)})")
+        if accessor not in (None, "ctr"):
+            raise TypeError(f"unknown accessor {accessor!r}")
+        rc = self._lib.ps_native_add_sparse_v2(
             self._h, name.encode(), int(dim), float(lr), float(init_std),
-            int(seed))
+            int(seed), opt_ids[optimizer], float(beta1), float(beta2),
+            float(eps), 1 if accessor == "ctr" else 0,
+            float(show_decay_rate), float(click_coeff),
+            float(delete_threshold), float(ttl_days))
         if rc == -2:
             raise ValueError(f"table {name!r} already registered")
         if rc != 0:
             raise ValueError(f"add_sparse_table({name!r}) failed")
 
     def add_dense_table(self, name: str, shape, lr: float = 0.01,
-                        shard=None, optimizer: str = "sgd"):
-        if optimizer != "sgd":
+                        shard=None, optimizer: str = "sgd",
+                        beta1: float = 0.9, beta2: float = 0.999,
+                        eps: float = 1e-8):
+        opt_ids = {"sgd": 0, "adagrad": 1, "adam": 2}
+        if optimizer not in opt_ids:
             raise NotImplementedError(
-                "the native data plane ships SGD tables; richer optimizers "
-                "live in the python PsServer")
+                f"native PS optimizer {optimizer!r} (have {sorted(opt_ids)})")
         import numpy as np
         total = int(np.prod(shape))
         if shard is not None:
@@ -73,8 +86,9 @@ class NativePsServer:
             lo, hi = dense_shard_range(total, i, n)
         else:
             lo, hi = 0, total
-        rc = self._lib.ps_native_add_dense(
-            self._h, name.encode(), hi - lo, float(lr), lo, total)
+        rc = self._lib.ps_native_add_dense_v2(
+            self._h, name.encode(), hi - lo, float(lr), lo, total,
+            opt_ids[optimizer], float(beta1), float(beta2), float(eps))
         if rc == -2:
             raise ValueError(f"table {name!r} already registered")
         if rc != 0:

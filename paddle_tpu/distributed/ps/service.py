@@ -38,6 +38,17 @@ CMD_PULL_DENSE = 3
 CMD_PUSH_DENSE = 4
 CMD_STOP = 5
 CMD_BARRIER = 6
+CMD_PUSH_SHOW_CLICK = 7
+CMD_DECAY = 8
+CMD_SHRINK = 9
+CMD_ADD_SPARSE = 10      # table-config negotiation (optimizer + accessor)
+CMD_ADD_DENSE = 11
+CMD_SAMPLE_NEIGHBORS = 12   # graph table: ids[n] -> [n, k] ids + weights
+CMD_NODE_FEAT = 13          # graph table: ids[n] -> [n, feat_dim] f32
+
+_OPT_IDS = {"sgd": 0, "adagrad": 1, "adam": 2, "lazy_adam": 2}
+_SPARSE_CFG = struct.Struct("<ffqBBfffffff")   # lr,std,seed,opt,ctr,b1,b2,eps,sdec,ccoef,dth,ttl
+_DENSE_CFG = struct.Struct("<fqqBfff")          # lr,shard_lo,total,opt,b1,b2,eps
 _ST_OK = b"\x01"
 _ST_ERR = b"\x00"
 
@@ -99,6 +110,12 @@ class PsServer:
     def add_dense_table(self, name, shape, **kw):
         _tname(name)
         self._tables[name] = DenseTable(shape, **kw)
+        return self._tables[name]
+
+    def add_graph_table(self, name, **kw):
+        from .graph_table import GraphTable
+        _tname(name)
+        self._tables[name] = GraphTable(**kw)
         return self._tables[name]
 
     def table(self, name):
@@ -170,6 +187,16 @@ class PsServer:
                     ).reshape(n, dim)
                 elif cmd == CMD_PUSH_DENSE:
                     grads = np.frombuffer(_recv_exact(conn, 4 * n), np.float32)
+                elif cmd == CMD_PUSH_SHOW_CLICK:
+                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+                    grads = np.frombuffer(
+                        _recv_exact(conn, 4 * n * 2), np.float32)
+                elif cmd == CMD_ADD_SPARSE:
+                    cfg_raw = _recv_exact(conn, _SPARSE_CFG.size)
+                elif cmd == CMD_ADD_DENSE:
+                    cfg_raw = _recv_exact(conn, _DENSE_CFG.size)
+                elif cmd in (CMD_SAMPLE_NEIGHBORS, CMD_NODE_FEAT):
+                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
                 try:
                     if cmd == CMD_STOP:
                         conn.sendall(_ST_OK)
@@ -177,6 +204,39 @@ class PsServer:
                         return
                     if cmd == CMD_BARRIER:
                         self._barrier(int(n))
+                        conn.sendall(_ST_OK)
+                        continue
+                    if cmd == CMD_ADD_SPARSE:
+                        (lr, istd, seed, opt, ctr, b1, b2, eps, sdec, ccoef,
+                         dth, ttl) = _SPARSE_CFG.unpack(cfg_raw)
+                        if name in self._tables:
+                            raise ValueError(
+                                f"ps: table {name!r} already registered")
+                        opt_name = {0: "sgd", 1: "adagrad", 2: "adam"}[opt]
+                        kw = {}
+                        if ctr:
+                            kw = dict(accessor="ctr", show_decay_rate=sdec,
+                                      click_coeff=ccoef,
+                                      delete_threshold=dth, ttl_days=ttl)
+                        self.add_sparse_table(
+                            name, int(dim), optimizer=opt_name, lr=lr,
+                            init_std=istd, seed=int(seed), beta1=b1,
+                            beta2=b2, eps=eps, **kw)
+                        conn.sendall(_ST_OK)
+                        continue
+                    if cmd == CMD_ADD_DENSE:
+                        lr, lo, total, opt, b1, b2, eps = \
+                            _DENSE_CFG.unpack(cfg_raw)
+                        if name in self._tables:
+                            raise ValueError(
+                                f"ps: table {name!r} already registered")
+                        opt_name = {0: "sgd", 1: "adagrad", 2: "adam"}[opt]
+                        tbl = self.add_dense_table(name, (int(n),),
+                                                   optimizer=opt_name, lr=lr,
+                                                   beta1=b1, beta2=b2,
+                                                   eps=eps)
+                        tbl.shard_range = (int(lo), int(lo) + int(n))
+                        tbl.total_size = int(total) if total > 0 else int(n)
                         conn.sendall(_ST_OK)
                         continue
                     tbl = self._tables.get(name)
@@ -200,9 +260,31 @@ class PsServer:
                     elif cmd == CMD_PUSH_DENSE:
                         tbl.push(grads.reshape(tbl.w.shape))
                         conn.sendall(_ST_OK)
+                    elif cmd == CMD_PUSH_SHOW_CLICK:
+                        tbl.push_show_click(ids, grads[:n], grads[n:])
+                        conn.sendall(_ST_OK)
+                    elif cmd == CMD_DECAY:
+                        tbl.decay()
+                        conn.sendall(_ST_OK)
+                    elif cmd == CMD_SHRINK:
+                        evicted = tbl.shrink()
+                        conn.sendall(_ST_OK + _LEN.pack(int(evicted)))
+                    elif cmd == CMD_SAMPLE_NEIGHBORS:
+                        nb, w = tbl.sample_neighbors(ids, int(dim))
+                        conn.sendall(_ST_OK + nb.astype(np.int64).tobytes()
+                                     + w.astype(np.float32).tobytes())
+                    elif cmd == CMD_NODE_FEAT:
+                        f = tbl.get_node_feat(ids).astype(np.float32)
+                        conn.sendall(_ST_OK + _LEN.pack(f.shape[1])
+                                     + f.tobytes())
                     else:
                         raise ValueError(f"ps: unknown command {cmd}")
-                except (KeyError, ValueError, PsError) as e:
+                except (KeyError, ValueError, PsError, AttributeError,
+                        TypeError) as e:
+                    # AttributeError/TypeError: a table-op aimed at a table
+                    # type without that surface (e.g. DECAY on a dense
+                    # table) must produce a protocol error frame — the C++
+                    # server answers the same request with one
                     _send_err(conn, str(e))
         except (ConnectionError, OSError):
             pass
@@ -419,6 +501,149 @@ class PsClient:
         finally:
             for s, _ in shards:
                 self._locks[s].release()
+
+    # -- CTR accessor ops (ctr_accessor.cc role over the wire) --
+    def push_show_click(self, table: str, ids, shows, clicks):
+        """Bump per-row show/click statistics on the owning servers."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        shows = np.asarray(shows, np.float32).reshape(-1)
+        clicks = np.asarray(clicks, np.float32).reshape(-1)
+        shards = self._shard_sel(ids)
+        for s, sel in shards:
+            self._locks[s].acquire()
+        try:
+            self._send_all(shards, lambda s, sel: (
+                _HDR.pack(CMD_PUSH_SHOW_CLICK, _tname(table), len(sel), 0)
+                + ids[sel].tobytes() + shows[sel].tobytes()
+                + clicks[sel].tobytes()))
+            self._recv_all(shards, None)
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
+
+    def _simple_cmd_all(self, cmd, table, recv_extra=None):
+        """Fire `cmd` at every server; returns the per-server extras."""
+        shards = [(i, None) for i in range(len(self.endpoints))]
+        outs = [None] * len(self.endpoints)
+        for s, _ in shards:
+            self._locks[s].acquire()
+        try:
+            self._send_all(shards, lambda s, sel: _HDR.pack(
+                cmd, _tname(table), 0, 0))
+
+            def recv_one(s, sel, sk):
+                if recv_extra is not None:
+                    outs[s] = recv_extra(sk)
+
+            self._recv_all(shards, recv_one)
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
+        return outs
+
+    def decay(self, table: str):
+        """One show/click time-decay cycle on every server."""
+        self._simple_cmd_all(CMD_DECAY, table)
+
+    def shrink(self, table: str) -> int:
+        """Evict low-score/expired rows everywhere; total evicted."""
+        outs = self._simple_cmd_all(
+            CMD_SHRINK, table,
+            recv_extra=lambda sk: _LEN.unpack(_recv_exact(sk, 8))[0])
+        return int(np.sum([o or 0 for o in outs]))
+
+    # -- table-config negotiation (the reference ships TableAccessor
+    #    configs to every server at fleet init; these do it per table) --
+    def create_sparse_table(self, table: str, dim: int, optimizer="sgd",
+                            lr=0.01, init_std=0.01, seed=0, accessor=None,
+                            show_decay_rate=0.98, click_coeff=8.0,
+                            delete_threshold=0.8, ttl_days=30.0,
+                            beta1=0.9, beta2=0.999, eps=1e-8):
+        cfg = _SPARSE_CFG.pack(
+            lr, init_std, int(seed), _OPT_IDS[optimizer],
+            1 if accessor == "ctr" else 0, beta1, beta2, eps,
+            show_decay_rate, click_coeff, delete_threshold, float(ttl_days))
+        shards = [(i, None) for i in range(len(self.endpoints))]
+        for s, _ in shards:
+            self._locks[s].acquire()
+        try:
+            self._send_all(shards, lambda s, sel: _HDR.pack(
+                CMD_ADD_SPARSE, _tname(table), 0, dim) + cfg)
+            self._recv_all(shards, None)
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
+        self.register_sparse_dim(table, dim)
+
+    def create_dense_table(self, table: str, total: int, optimizer="sgd",
+                           lr=0.01, beta1=0.9, beta2=0.999, eps=1e-8):
+        from .table import dense_shard_range
+        n_srv = len(self.endpoints)
+        for i in range(n_srv):
+            lo, hi = dense_shard_range(int(total), i, n_srv)
+            cfg = _DENSE_CFG.pack(lr, lo, int(total), _OPT_IDS[optimizer],
+                                  beta1, beta2, eps)
+            with self._locks[i]:
+                sk = self._sock(i)
+                sk.sendall(_HDR.pack(CMD_ADD_DENSE, _tname(table), hi - lo, 0)
+                           + cfg)
+                _check_status(sk)
+
+    # -- graph table (common_graph_table.h role) --
+    def sample_neighbors(self, table: str, ids, k: int):
+        """[n] node ids -> ([n, k] neighbor ids, [n, k] weights); nodes
+        route to their owning server (id % n_servers, like sparse rows)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        shards = self._shard_sel(ids)
+        nb = np.full((len(ids), k), -1, np.int64)
+        w = np.zeros((len(ids), k), np.float32)
+        for s, sel in shards:
+            self._locks[s].acquire()
+        try:
+            self._send_all(shards, lambda s, sel: (
+                _HDR.pack(CMD_SAMPLE_NEIGHBORS, _tname(table), len(sel), k)
+                + ids[sel].tobytes()))
+
+            def recv_one(s, sel, sk):
+                nb[sel] = np.frombuffer(
+                    _recv_exact(sk, 8 * len(sel) * k), np.int64
+                ).reshape(len(sel), k)
+                w[sel] = np.frombuffer(
+                    _recv_exact(sk, 4 * len(sel) * k), np.float32
+                ).reshape(len(sel), k)
+
+            self._recv_all(shards, recv_one)
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
+        return nb, w
+
+    def node_feat(self, table: str, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        shards = self._shard_sel(ids)
+        parts = {}
+        for s, sel in shards:
+            self._locks[s].acquire()
+        try:
+            self._send_all(shards, lambda s, sel: (
+                _HDR.pack(CMD_NODE_FEAT, _tname(table), len(sel), 0)
+                + ids[sel].tobytes()))
+
+            def recv_one(s, sel, sk):
+                (d,) = _LEN.unpack(_recv_exact(sk, 8))
+                parts[s] = (sel, np.frombuffer(
+                    _recv_exact(sk, 4 * len(sel) * d), np.float32
+                ).reshape(len(sel), d))
+
+            self._recv_all(shards, recv_one)
+        finally:
+            for s, _ in shards:
+                self._locks[s].release()
+        d = max(p.shape[1] for _, p in parts.values())
+        out = np.zeros((len(ids), d), np.float32)
+        for sel, p in parts.values():
+            out[sel, :p.shape[1]] = p
+        return out
 
     def barrier(self, n_trainers: int = 1):
         """Block until `n_trainers` clients reach this point (coordinated by

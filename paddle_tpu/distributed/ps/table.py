@@ -18,7 +18,7 @@ import numpy as np
 
 
 class _SGDRule:
-    def __init__(self, lr=0.01):
+    def __init__(self, lr=0.01, **_unused_hyper):
         self.lr = lr
 
     def slots(self, dim):
@@ -30,7 +30,7 @@ class _SGDRule:
 
 
 class _AdagradRule:
-    def __init__(self, lr=0.01, eps=1e-8):
+    def __init__(self, lr=0.01, eps=1e-8, **_unused_hyper):
         self.lr = lr
         self.eps = eps
 
@@ -47,7 +47,8 @@ class _AdamRule:
     """Dense/sparse adam with per-row moments and per-row step counter
     (adam_op.h dense path / common_sparse_table adam accessor)."""
 
-    def __init__(self, lr=0.01, beta1=0.9, beta2=0.999, eps=1e-8):
+    def __init__(self, lr=0.01, beta1=0.9, beta2=0.999, eps=1e-8,
+                 **_unused_hyper):
         self.lr, self.b1, self.b2, self.eps = lr, beta1, beta2, eps
 
     def slots(self, dim):
@@ -128,7 +129,7 @@ class DenseTable:
     server-0 bandwidth/memory pinch point."""
 
     def __init__(self, shape, optimizer="sgd", lr=0.01, initializer=None,
-                 shard=None):
+                 shard=None, beta1=0.9, beta2=0.999, eps=1e-8):
         self._lock = threading.Lock()
         total = int(np.prod(shape))
         self.total_size = total
@@ -150,7 +151,8 @@ class DenseTable:
             # decorrelated streams
             rng = np.random.default_rng(self.shard_range[0])
             self.w = rng.normal(0, 0.01, myshape).astype(np.float32)
-        self._rule = _RULES[optimizer](lr=lr)
+        self._rule = _RULES[optimizer](lr=lr, beta1=beta1, beta2=beta2,
+                                       eps=eps)
         self._slots = self._rule.slots(self.w.shape)
 
     def pull(self):
@@ -174,12 +176,14 @@ class SparseTable:
     optimizer slots (common_sparse_table role)."""
 
     def __init__(self, dim, optimizer="sgd", lr=0.01, init_std=0.01, seed=0,
-                 accessor=None, **accessor_kw):
+                 accessor=None, beta1=0.9, beta2=0.999, eps=1e-8,
+                 **accessor_kw):
         self.dim = dim
         self._lock = threading.Lock()
         self._rows: Dict[int, np.ndarray] = {}
         self._slots: Dict[int, dict] = {}
-        self._rule = _RULES[optimizer](lr=lr)
+        self._rule = _RULES[optimizer](lr=lr, beta1=beta1, beta2=beta2,
+                                       eps=eps)
         self._init_std = init_std
         self._rng = np.random.default_rng(seed)
         # accessor="ctr": per-row show/click stats + decay/shrink eviction

@@ -454,9 +454,10 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
         sim = a @ p.T
         logp = jax.nn.log_softmax(sim, axis=-1)
         ce_rows = -jnp.sum(soft * logp, axis=-1)      # [N]
-        # reference: reduce_sum(labels * softmax_ce, 0) then mean — the
-        # soft labels reweight each row's CE before averaging
-        ce = jnp.mean(jnp.sum(soft * ce_rows[:, None], axis=0))
+        # the reference's reduce_mean(reduce_sum(labels * ce, 0)) is
+        # algebraically mean(ce_rows): soft rows sum to 1, so the double
+        # sum collapses — skip the O(N^2) reweighting product
+        ce = jnp.mean(ce_rows)
         return l2 + ce
 
     return run_op(f, [anchor, positive, labels], "npair_loss")

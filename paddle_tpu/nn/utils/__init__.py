@@ -159,8 +159,17 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     if name not in layer._parameters:
         raise ValueError(f"spectral_norm: layer has no parameter {name!r}")
     if dim is None:
-        # Linear weights are [in, out] -> spectral dim 1; conv [out, ...] -> 0
-        dim = 1 if type(layer).__name__ == "Linear" else 0
+        # reference spectral_norm_hook: dim=1 for Linear and ConvTranspose
+        # (their out-axis is second), dim=0 otherwise — by isinstance so
+        # subclasses resolve correctly
+        from ..layer.common import Linear
+        try:
+            from ..layer.conv import (Conv1DTranspose, Conv2DTranspose,
+                                      Conv3DTranspose)
+            transposed = (Conv1DTranspose, Conv2DTranspose, Conv3DTranspose)
+        except ImportError:
+            transposed = ()
+        dim = 1 if isinstance(layer, (Linear,) + transposed) else 0
     w = layer._parameters.pop(name)
     fn = _SpectralNorm(name, n_power_iterations, eps, dim)
     layer.add_parameter(name + "_orig", Parameter(w._value))

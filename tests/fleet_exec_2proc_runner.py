@@ -1,0 +1,59 @@
+"""Two-process FleetExecutor runner (executed by test_fleet_executor.py).
+
+Rank 0 hosts pipeline stage 0 and feeds microbatches; rank 1 hosts stage 1
+and the sink, applies its stage, and prints the collected outputs. The
+interceptor messages cross the process boundary over the DistMessageBus
+(TCPStore rendezvous) — the reference's brpc message_bus.cc role.
+"""
+import json
+import os
+import sys
+
+rank = int(sys.argv[1])
+store_port = int(sys.argv[2])
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "ptpu_native", os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "_native", "__init__.py"))
+_native = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_native)
+TCPStore = _native.TCPStore
+
+# the bus module is import-light (no jax at import time)
+_fspec = importlib.util.spec_from_file_location(
+    "ptpu_fleet_exec", os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "distributed",
+        "fleet_executor.py"))
+fe = importlib.util.module_from_spec(_fspec)
+_fspec.loader.exec_module(fe)
+
+store = TCPStore("127.0.0.1", store_port, is_master=(rank == 0),
+                 world_size=2, timeout=60)
+
+stage_owner = {0: 0, 1: 1}
+bus = fe.DistMessageBus(store, rank, 2, stage_owner)
+
+if rank == 0:
+    my_stages = {0: lambda x: x * 2.0}
+else:
+    my_stages = {1: lambda x: x + 1.0}
+
+ex = fe.DistFleetExecutor(my_stages, n_stages=2, stage_owner=stage_owner,
+                          bus=bus, max_inflight=2)
+
+micro = [np.full((2,), float(i), np.float32) for i in range(5)] \
+    if rank == 0 else None
+out = ex.run(microbatches=micro, n_micro=5, timeout=60)
+bus.close()
+if rank == 1:
+    print(json.dumps({"rank": rank,
+                      "outs": [o.tolist() for o in out]}))
+else:
+    assert out is None
+    print(json.dumps({"rank": rank, "outs": None}))

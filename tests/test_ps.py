@@ -502,3 +502,52 @@ class TestDenseShardValidation:
         from paddle_tpu.distributed.ps.table import DenseTable
         with pytest.raises(ValueError, match="out of range"):
             DenseTable((8,), shard=(2, 2))
+
+
+class TestGraphTable:
+    """Minimal GraphTable on the PS plane (common_graph_table.h:355 role):
+    node/edge store, weighted neighbor sampling with FIXED [n,k] output
+    shapes (TPU-friendly static shapes), node features, sharded service."""
+
+    def test_local_table_sampling_and_feats(self):
+        from paddle_tpu.distributed.ps.graph_table import GraphTable
+        g = GraphTable(weighted=True, feat_dim=3, seed=0)
+        g.add_edges([0, 0, 0, 1], [1, 2, 3, 0],
+                    weight=[1.0, 1.0, 98.0, 1.0])
+        g.set_node_feat([0, 1], [[1, 2, 3], [4, 5, 6]])
+        assert g.n_nodes() == 4
+        nb, w = g.sample_neighbors([0, 1, 9], k=64)
+        assert nb.shape == (3, 64) and w.shape == (3, 64)
+        # heavy edge 0->3 dominates the weighted sample
+        assert (nb[0] == 3).mean() > 0.7
+        assert (nb[1] == 0).all() and (nb[2] == -1).all()
+        f = g.get_node_feat([1, 0, 7])
+        np.testing.assert_allclose(f[0], [4, 5, 6])
+        np.testing.assert_allclose(f[2], 0.0)
+        nodes = g.random_sample_nodes(2)
+        assert len(nodes) == 2 and len(set(nodes.tolist())) == 2
+
+    def test_sharded_graph_service(self):
+        from paddle_tpu.distributed.ps import PsClient, PsServer
+        servers = [PsServer() for _ in range(2)]
+        try:
+            # node id % 2 routes to its owner — each server holds its half
+            for i, srv in enumerate(servers):
+                g = srv.add_graph_table("g", weighted=False, feat_dim=2)
+                srv.run()
+            servers[0].table("g").add_edges([0, 2], [2, 4])
+            servers[1].table("g").add_edges([1, 3], [3, 5])
+            servers[0].table("g").set_node_feat([0, 2], [[1, 1], [2, 2]])
+            servers[1].table("g").set_node_feat([1, 3], [[3, 3], [4, 4]])
+            client = PsClient([f"{s.host}:{s.port}" for s in servers])
+            nb, w = client.sample_neighbors("g", [0, 1, 2, 3], k=4)
+            assert nb.shape == (4, 4)
+            assert (nb[0] == 2).all() and (nb[1] == 3).all()
+            assert (nb[2] == 4).all() and (nb[3] == 5).all()
+            feats = client.node_feat("g", [0, 1, 2, 3])
+            np.testing.assert_allclose(
+                feats, [[1, 1], [3, 3], [2, 2], [4, 4]])
+            client.close()
+        finally:
+            for s in servers:
+                s.stop()

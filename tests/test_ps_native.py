@@ -147,3 +147,156 @@ class TestNativeServer:
         c.pull_sparse("emb", [1])   # connection now open and idle
         s.stop()                     # drains/unblocks the handler
         c.close()
+
+
+class TestNativeRichTables:
+    """r5: the native plane runs adam/adagrad + the CTR accessor and the
+    wire-level table-config negotiation — matching the python tier's
+    numerics so mixed clusters converge identically."""
+
+    def test_sparse_adam_matches_python_plane(self):
+        from paddle_tpu.distributed.ps.table import SparseTable
+        srv = NativePsServer()
+        srv.add_sparse_table("emb", dim=4, lr=0.1, seed=3, optimizer="adam")
+        client = PsClient([f"{srv.host}:{srv.port}"])
+        client.register_sparse_dim("emb", 4)
+        try:
+            ids = np.array([1, 5, 9], np.int64)
+            init = client.pull_sparse("emb", ids).copy()
+            # python oracle seeded with the SAME initial rows
+            pytab = SparseTable(4, optimizer="adam", lr=0.1)
+            with pytab._lock:
+                for i, r in zip(ids, init):
+                    pytab._rows[int(i)] = r.copy()
+                    pytab._slots[int(i)] = pytab._rule.slots(4)
+            rng = np.random.RandomState(0)
+            for _ in range(5):
+                g = rng.randn(3, 4).astype(np.float32)
+                client.push_sparse("emb", ids, g)
+                pytab.push(ids, g)
+            # duplicate ids in one push: both planes must accumulate the
+            # gradients and take ONE adam step per key
+            dup_ids = np.array([1, 1, 5], np.int64)
+            g = rng.randn(3, 4).astype(np.float32)
+            client.push_sparse("emb", dup_ids, g)
+            pytab.push(dup_ids, g)
+            got = client.pull_sparse("emb", ids)
+            want = pytab.pull(ids)
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_dense_adam_matches_python_plane(self):
+        from paddle_tpu.distributed.ps.table import DenseTable
+        srv = NativePsServer()
+        srv.add_dense_table("fc", (6,), lr=0.05, optimizer="adam")
+        client = PsClient([f"{srv.host}:{srv.port}"])
+        try:
+            pytab = DenseTable((6,), optimizer="adam", lr=0.05)
+            pytab.set(client.pull_dense("fc"))
+            rng = np.random.RandomState(1)
+            for _ in range(4):
+                g = rng.randn(6).astype(np.float32)
+                client.push_dense("fc", g)
+                pytab.push(g)
+            np.testing.assert_allclose(client.pull_dense("fc"), pytab.pull(),
+                                       rtol=2e-5, atol=1e-6)
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_ctr_accessor_decay_shrink(self):
+        srv = NativePsServer()
+        srv.add_sparse_table("ctr", dim=2, lr=0.1, accessor="ctr",
+                             delete_threshold=0.8, ttl_days=3.0)
+        client = PsClient([f"{srv.host}:{srv.port}"])
+        client.register_sparse_dim("ctr", 2)
+        try:
+            ids = np.array([1, 2, 3], np.int64)
+            client.pull_sparse("ctr", ids)      # materialize rows
+            # row 1 gets strong signal, row 2 weak, row 3 none
+            client.push_show_click("ctr", [1], [10.0], [3.0])
+            client.push_show_click("ctr", [2], [0.5], [0.0])
+            assert client.shrink("ctr") >= 1    # rows 2+3 under threshold
+            # row 1 survives and keeps its stats through decay cycles
+            for _ in range(4):
+                client.decay("ctr")
+            # after 4 decays (> ttl 3) with no new shows, row 1 expires too
+            assert client.shrink("ctr") >= 1
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_ctr_parity_with_python_server(self):
+        """Same show/click/decay/shrink sequence on a python and a native
+        server must evict the same rows."""
+        seq = [([1], [10.0], [2.0]), ([2], [0.6], [0.0]),
+               ([3], [0.1], [0.0])]
+
+        def drive(server):
+            client = PsClient([f"{server.host}:{server.port}"])
+            client.register_sparse_dim("t", 2)
+            try:
+                client.pull_sparse("t", np.array([1, 2, 3], np.int64))
+                for ids, sh, ck in seq:
+                    client.push_show_click("t", ids, sh, ck)
+                client.decay("t")
+                return client.shrink("t")
+            finally:
+                client.close()
+
+        py = PsServer()
+        py.add_sparse_table("t", 2, accessor="ctr", delete_threshold=0.8)
+        py.run()
+        n_py = drive(py)
+        py.stop()
+        nat = NativePsServer()
+        nat.add_sparse_table("t", dim=2, accessor="ctr",
+                             delete_threshold=0.8)
+        n_nat = drive(nat)
+        nat.stop()
+        assert n_py == n_nat == 2   # rows 2 and 3 fall under the threshold
+
+    def test_wire_table_config_negotiation(self):
+        """create_sparse_table/create_dense_table configure a BLANK native
+        server over the wire; pushes then run the negotiated optimizer."""
+        srv = NativePsServer()                  # no local tables
+        client = PsClient([f"{srv.host}:{srv.port}"])
+        try:
+            client.create_sparse_table("emb", 3, optimizer="adagrad", lr=0.2)
+            client.create_dense_table("fc", 4, optimizer="adam", lr=0.1)
+            ids = np.array([7], np.int64)
+            r0 = client.pull_sparse("emb", ids).copy()
+            g = np.ones((1, 3), np.float32)
+            client.push_sparse("emb", ids, g)
+            # adagrad step: w -= lr * g / (sqrt(g^2) + eps) = lr
+            np.testing.assert_allclose(client.pull_sparse("emb", ids),
+                                       r0 - 0.2, rtol=1e-5)
+            w0 = client.pull_dense("fc").copy()
+            client.push_dense("fc", np.ones(4, np.float32))
+            # adam first step = -lr (bias-corrected)
+            np.testing.assert_allclose(client.pull_dense("fc"), w0 - 0.1,
+                                       rtol=1e-4)
+            # double-registration errors cleanly over the wire
+            with pytest.raises(PsError, match="already registered"):
+                client.create_sparse_table("emb", 3)
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_wire_negotiation_python_server_parity(self):
+        """The same negotiation frames configure the python server."""
+        py = PsServer()
+        py.run()
+        client = PsClient([f"{py.host}:{py.port}"])
+        try:
+            client.create_sparse_table("emb", 3, optimizer="adam", lr=0.1,
+                                       accessor="ctr")
+            ids = np.array([4], np.int64)
+            client.pull_sparse("emb", ids)
+            client.push_show_click("emb", ids, [5.0], [1.0])
+            assert client.shrink("emb") == 0    # well above threshold
+        finally:
+            client.close()
+            py.stop()
