@@ -382,6 +382,8 @@ void handle_conn(Server* s, int fd) {
       t->opt.b1 = b1;
       t->opt.b2 = b2;
       t->opt.eps = eps;
+      // zero init matches the python DenseTable default (initializer=None
+      // -> zeros, table.py) so wire-negotiated mixed clusters agree
       t->w.assign(static_cast<size_t>(n), 0.0f);
       {
         std::lock_guard<std::mutex> lk(s->tables_mu);

@@ -163,6 +163,12 @@ def _ambient_key():
 
 
 def _fn_key(fn):
+    # INVARIANT (ADVICE r4): this key freezes closure cells, defaults and
+    # the fixed _ambient_key() tuple, but NOT module-level globals. Op fns
+    # routed through the dispatch cache must not read mutable globals
+    # outside _ambient_key — any new config flag an op fn consults at
+    # trace time MUST be added to _ambient_key, or a cached executable
+    # traced under the old value would silently replay after it changes.
     code = getattr(fn, "__code__", None)
     if code is None:
         return ("fn", _freeze(fn), _ambient_key())
@@ -209,15 +215,24 @@ class _JitVJP:
 
     `inexact` (when set) marks which of the op's positional inputs were
     differentiated; integer/bool inputs got no cotangent slot and are
-    reported as None (their tape entries are stop_gradient and skipped)."""
+    reported as None (their tape entries are stop_gradient and skipped).
+    `treedef` (when set) is the NESTED output structure of the traced
+    function: the tape stores flat leaf tensors, so the flat cotangents
+    are unflattened back before hitting the raw vjp (static-program
+    captures of layers returning nested tuples, e.g. LSTM's
+    (out, (h, c)))."""
 
-    __slots__ = ("raw", "inexact")
+    __slots__ = ("raw", "inexact", "treedef")
 
-    def __init__(self, raw, inexact=None):
+    def __init__(self, raw, inexact=None, treedef=None):
         self.raw = raw
         self.inexact = inexact
+        self.treedef = treedef
 
     def __call__(self, cts):
+        if self.treedef is not None:
+            flat = list(cts) if isinstance(cts, tuple) else [cts]
+            cts = jax.tree_util.tree_unflatten(self.treedef, flat)
         try:
             part = _bwd_apply()(self.raw, cts)
         except _BAILOUT_ERRORS:
@@ -342,6 +357,106 @@ def _dense_cot(c):
     return c.to_dense() if isinstance(c, SelectedRows) else c
 
 
+# ---- fused tape walk ---------------------------------------------------
+# The eager walk dispatches one jitted vjp per node (plus per-leaf adds):
+# on a remote/tunnel target that is one RTT per op. When the whole tape is
+# _JitVJP nodes (the common repeated-training-step shape), the walk itself
+# is pure orchestration of arrays — so it can run INSIDE one jit, keyed by
+# the tape's structure: each step's tensors are new objects, but the
+# wiring (who feeds whom) repeats, and the vjp residual pytrees ride in as
+# jit arguments. One executable per backward instead of N.
+_FUSED_BWD_CACHE: dict = {}
+_FUSED_BWD_MAX = 256
+
+
+def _fused_backward_try(root, grad, ordered):
+    """Returns list of (leaf_tensor, grad_array) or None if ineligible."""
+    from .selected_rows import SelectedRows
+    # slot assignment: every tensor seen gets an integer slot
+    slots: dict = {}
+    tensors_by_slot: dict = {}
+
+    def slot_of(t):
+        s = slots.get(id(t))
+        if s is None:
+            s = slots[id(t)] = len(slots)
+            tensors_by_slot[s] = t
+        return s
+
+    structure = []
+
+    for node in ordered:
+        if not isinstance(node.vjp_fn, _JitVJP):
+            return None
+        for t in node.inputs:
+            if (not t.stop_gradient and t._node is None
+                    and getattr(t, "_hooks", ())):
+                return None        # leaf hooks: keep the eager walk
+            if isinstance(t.grad, SelectedRows):
+                return None
+        out_slots = tuple(
+            (slot_of(t), tuple(t._value.shape), str(t._value.dtype))
+            for t in node.outputs)
+        in_slots = tuple(
+            (slot_of(t), bool(t.stop_gradient), t._node is None,
+             str(t._value.dtype))
+            for t in node.inputs)
+        structure.append((node.name, node.vjp_fn.inexact,
+                          node.vjp_fn.treedef, out_slots, in_slots))
+
+    key = (len(slots), slot_of(root), tuple(structure))
+    fn = _FUSED_BWD_CACHE.get(key)
+    if fn is None:
+        if len(_FUSED_BWD_CACHE) >= _FUSED_BWD_MAX:
+            _FUSED_BWD_CACHE.clear()
+        struct = tuple(structure)
+        root_slot = slot_of(root)
+
+        def walk(g_root, raws):
+            cot: dict = {root_slot: g_root}
+            leaf_out: dict = {}
+            for (name, inexact, treedef, out_slots, in_slots), raw in zip(
+                    struct, raws):
+                out_cots = []
+                any_live = False
+                for s, shp, dt in out_slots:
+                    c = cot.pop(s, None)
+                    if c is None:
+                        c = jnp.zeros(shp, dt)
+                    else:
+                        any_live = True
+                    out_cots.append(c)
+                if not any_live:
+                    continue
+                if treedef is not None:
+                    part = raw(jax.tree_util.tree_unflatten(treedef,
+                                                            out_cots))
+                else:
+                    part = raw(tuple(out_cots) if len(out_cots) > 1
+                               else out_cots[0])
+                if inexact is not None:
+                    it = iter(part)
+                    part = tuple(next(it) if f else None for f in inexact)
+                for (s, stop, is_leaf, dt), c in zip(in_slots, part):
+                    if stop or c is None:
+                        continue
+                    if is_leaf:
+                        c = c.astype(dt) if str(c.dtype) != dt else c
+                        leaf_out[s] = (leaf_out[s] + c) if s in leaf_out \
+                            else c
+                    else:
+                        cot[s] = (cot[s] + c) if s in cot else c
+            return leaf_out
+
+        fn = _FUSED_BWD_CACHE[key] = jax.jit(walk)
+    raws = [n.vjp_fn.raw for n in ordered]
+    try:
+        leaf_grads = fn(grad, raws)
+    except _BAILOUT_ERRORS:
+        return None
+    return [(tensors_by_slot[s], g) for s, g in leaf_grads.items()]
+
+
 def backward(root, grad=None, retain_graph: bool = False):
     """Run the tape backward from `root` (paddle.Tensor.backward parity)."""
     if root._node is None:
@@ -361,6 +476,19 @@ def backward(root, grad=None, retain_graph: bool = False):
         grad = grad._value
 
     ordered = _collect([root._node])
+
+    fused = _fused_backward_try(root, grad, ordered)
+    if fused is not None:
+        for t, g in fused:
+            t.grad = g if t.grad is None else t.grad + g
+        if not retain_graph:
+            for n in ordered:
+                for t in n.outputs:
+                    t._node = None
+                n.vjp_fn = None
+                n.inputs = n.outputs = ()
+                _STATE.live.discard(n)
+        return
 
     cot: dict = {id(root): grad}
     with no_grad():

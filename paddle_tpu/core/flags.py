@@ -51,6 +51,10 @@ def flag(name: str) -> Any:
 
 # ---- core flags (names kept from the reference where they exist) ----
 define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (operator.cc:1171)")
+define_flag("eager_auto_jit", True,
+            "promote a repeatedly-called top-level Layer to its captured "
+            "static program (step-chain capture: one executable per fwd "
+            "and per bwd instead of per-op dispatch)")
 define_flag("use_standalone_executor", True, "new-executor opt-in (executor.py:1392)")
 define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (unused on TPU; XLA owns buffers)")
 define_flag("allocator_strategy", "auto_growth", "host allocator strategy name")

@@ -51,7 +51,7 @@ class NativePsServer:
                          click_coeff: float = 8.0,
                          delete_threshold: float = 0.8,
                          ttl_days: float = 30.0):
-        opt_ids = {"sgd": 0, "adagrad": 1, "adam": 2, "lazy_adam": 2}
+        from .table import OPT_WIRE_IDS as opt_ids
         if optimizer not in opt_ids:
             raise NotImplementedError(
                 f"native PS optimizer {optimizer!r} (have {sorted(opt_ids)})")
@@ -72,7 +72,7 @@ class NativePsServer:
                         shard=None, optimizer: str = "sgd",
                         beta1: float = 0.9, beta2: float = 0.999,
                         eps: float = 1e-8):
-        opt_ids = {"sgd": 0, "adagrad": 1, "adam": 2}
+        from .table import OPT_WIRE_IDS as opt_ids
         if optimizer not in opt_ids:
             raise NotImplementedError(
                 f"native PS optimizer {optimizer!r} (have {sorted(opt_ids)})")

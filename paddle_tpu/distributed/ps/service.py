@@ -46,7 +46,7 @@ CMD_ADD_DENSE = 11
 CMD_SAMPLE_NEIGHBORS = 12   # graph table: ids[n] -> [n, k] ids + weights
 CMD_NODE_FEAT = 13          # graph table: ids[n] -> [n, feat_dim] f32
 
-_OPT_IDS = {"sgd": 0, "adagrad": 1, "adam": 2, "lazy_adam": 2}
+from .table import OPT_WIRE_IDS as _OPT_IDS  # single source, both planes
 _SPARSE_CFG = struct.Struct("<ffqBBfffffff")   # lr,std,seed,opt,ctr,b1,b2,eps,sdec,ccoef,dth,ttl
 _DENSE_CFG = struct.Struct("<fqqBfff")          # lr,shard_lo,total,opt,b1,b2,eps
 _ST_OK = b"\x01"
@@ -231,12 +231,12 @@ class PsServer:
                             raise ValueError(
                                 f"ps: table {name!r} already registered")
                         opt_name = {0: "sgd", 1: "adagrad", 2: "adam"}[opt]
-                        tbl = self.add_dense_table(name, (int(n),),
-                                                   optimizer=opt_name, lr=lr,
-                                                   beta1=b1, beta2=b2,
-                                                   eps=eps)
-                        tbl.shard_range = (int(lo), int(lo) + int(n))
-                        tbl.total_size = int(total) if total > 0 else int(n)
+                        self.add_dense_table(name, (int(n),),
+                                             optimizer=opt_name, lr=lr,
+                                             beta1=b1, beta2=b2, eps=eps,
+                                             shard_lo=int(lo),
+                                             total_size=int(total) if
+                                             total > 0 else int(n))
                         conn.sendall(_ST_OK)
                         continue
                     tbl = self._tables.get(name)

@@ -73,6 +73,11 @@ class _AdamRule:
 _RULES = {"sgd": _SGDRule, "adagrad": _AdagradRule, "adam": _AdamRule,
           "lazy_adam": _AdamRule}
 
+# wire ids for the table-config negotiation frames (service.py cmds 10/11
+# and the native plane's config structs) — the ONE mapping both planes and
+# both table kinds share
+OPT_WIRE_IDS = {"sgd": 0, "adagrad": 1, "adam": 2, "lazy_adam": 2}
+
 
 class CtrAccessor:
     """Show/click statistics + eviction scoring per sparse row.
@@ -129,11 +134,19 @@ class DenseTable:
     server-0 bandwidth/memory pinch point."""
 
     def __init__(self, shape, optimizer="sgd", lr=0.01, initializer=None,
-                 shard=None, beta1=0.9, beta2=0.999, eps=1e-8):
+                 shard=None, beta1=0.9, beta2=0.999, eps=1e-8,
+                 shard_lo=None, total_size=None):
         self._lock = threading.Lock()
         total = int(np.prod(shape))
         self.total_size = total
-        if shard is not None:
+        if shard_lo is not None:
+            # explicit range (wire-negotiated tables): must be set BEFORE
+            # the initializer so per-shard RNG streams decorrelate by the
+            # TRUE global offset, not a post-construction patch
+            self.total_size = int(total_size) if total_size else total
+            self.shard_range = (int(shard_lo), int(shard_lo) + total)
+            myshape = tuple(shape)
+        elif shard is not None:
             i, n = shard
             if not 0 <= i < n:
                 raise ValueError(f"dense shard index {i} out of range for "

@@ -44,11 +44,11 @@ class StaticFunction:
     def layer(self):
         return self._layer
 
-    def _get_jitted(self, training, pnames, bnames, static_kwargs):
-        key = (training, tuple(pnames), tuple(bnames),
+    def _get_pure(self, training, pnames, bnames, static_kwargs):
+        key = ("pure", training, tuple(pnames), tuple(bnames),
                tuple(sorted(static_kwargs.items())))
-        jitted = self._jit_cache.get(key)
-        if jitted is None:
+        pure = self._jit_cache.get(key)
+        if pure is None:
             layer, func = self._layer, self._function
             kw = dict(static_kwargs)
 
@@ -73,11 +73,44 @@ class StaticFunction:
                     if swapped:
                         layer.__dict__["forward"] = saved_fwd
 
-            jitted = jax.jit(pure)
+            self._jit_cache[key] = pure
+        return pure
+
+    def _get_jitted(self, training, pnames, bnames, static_kwargs):
+        key = ("jit", training, tuple(pnames), tuple(bnames),
+               tuple(sorted(static_kwargs.items())))
+        jitted = self._jit_cache.get(key)
+        if jitted is None:
+            jitted = jax.jit(
+                self._get_pure(training, pnames, bnames, static_kwargs))
             self._jit_cache[key] = jitted
         return jitted
 
+    def _get_fwd_vjp(self, training, pnames, bnames, static_kwargs, n_p):
+        """jit'd (outs, vjp) of the pure forward with the rng key and
+        buffer arrays as ARGUMENTS. The earlier design closed the per-call
+        rng key into the run_op fn, which made every call miss the global
+        vjp cache (`_fn_key` correctly refuses to value-key arrays) and
+        dropped backward to an unjitted transposed-jaxpr walk — measured
+        78 ms/step LeNet vs 44 eager. With key/buffers as traced args the
+        whole fwd+vjp pair is ONE cached executable each way."""
+        key = ("fwd_vjp", training, tuple(pnames), tuple(bnames),
+               tuple(sorted(static_kwargs.items())), n_p)
+        f = self._jit_cache.get(key)
+        if f is None:
+            pure = self._get_pure(training, pnames, bnames, static_kwargs)
+
+            def fwd_vjp(diff, barrs, rkey):
+                def g(*d):
+                    return pure(list(d[:n_p]), barrs, rkey, list(d[n_p:]))
+                return jax.vjp(g, *diff)
+
+            f = jax.jit(fwd_vjp)
+            self._jit_cache[key] = f
+        return f
+
     def __call__(self, *args, **kwargs):
+        from ..core import autograd as _ag
         layer = self._layer
         input_tensors = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
         if any(isinstance(v, Tensor) for v in kwargs.values()):
@@ -93,18 +126,19 @@ class StaticFunction:
             pnames, bnames = list(trainable), list(frozen)
             ptensors = [trainable[n] for n in pnames]
             barrs = [frozen[n]._value for n in bnames]
-            training = layer.training
+            # composite mode flag: sublayer train/eval toggles re-key the
+            # trace caches (a capture traced with dropout active must not
+            # replay after model.dropout.eval())
+            training = tuple(l.training for l in
+                             layer.sublayers(include_self=True))
         else:
             pnames, bnames, ptensors, barrs = [], [], [], []
             training = True
 
-        jitted = self._get_jitted(training, pnames, bnames, static_kwargs)
         key = rnd.default_generator().next_key()
         n_p = len(ptensors)
         diff_inputs = ptensors + input_tensors
-
-        def fn(*arrays):
-            return jitted(list(arrays[:n_p]), barrs, key, list(arrays[n_p:]))
+        arrays = [t._value for t in diff_inputs]
 
         # publish this capture as the default program (ProgramDesc role):
         # introspection/pruning lower lazily from the same traced callable.
@@ -112,6 +146,11 @@ class StaticFunction:
         # cost on the hot path).
         sig = tuple((t._value.shape, str(t._value.dtype)) for t in diff_inputs)
         if getattr(self, "_prog_sig", None) != sig:
+            jitted = self._get_jitted(training, pnames, bnames, static_kwargs)
+
+            def fn(*arrs, _jit=jitted, _b=list(barrs), _k=key, _np=n_p):
+                return _jit(list(arrs[:_np]), _b, _k, list(arrs[_np:]))
+
             from ..static.program import Program, _set_default_program
             specs = [jax.ShapeDtypeStruct(t._value.shape, t._value.dtype)
                      for t in diff_inputs]
@@ -120,7 +159,44 @@ class StaticFunction:
             self._prog_sig = sig
             _set_default_program(self._last_program)
 
-        return run_op(fn, diff_inputs, "static_program")
+        import time as _time
+        _t0 = _time.time()
+        record = (_ag.is_grad_enabled()
+                  and any(not t.stop_gradient for t in diff_inputs)
+                  and not any(isinstance(a, jax.core.Tracer) for a in arrays))
+        if not record:
+            jitted = self._get_jitted(training, pnames, bnames, static_kwargs)
+            out = jitted(arrays[:n_p], barrs, key, arrays[n_p:])
+        else:
+            fwd_vjp = self._get_fwd_vjp(training, pnames, bnames,
+                                        static_kwargs, n_p)
+            out, raw_vjp = fwd_vjp(arrays, barrs, key)
+        # arbitrary output pytrees (e.g. RNN layers return (out, (h, c))):
+        # the tape stores flat leaf tensors; the vjp wrapper unflattens the
+        # flat cotangents back to the traced structure
+        leaves, treedef = jax.tree_util.tree_flatten(out)
+        # tape convention: bare cotangent for single output, flat tuple for
+        # >1. A 1-TUPLE output is NOT native (the vjp expects (c,), the
+        # tape would pass a bare array) — keep its treedef for unflatten.
+        flat_native = (treedef == jax.tree_util.tree_structure(0)
+                       or (len(leaves) > 1 and treedef ==
+                           jax.tree_util.tree_structure(tuple(leaves))))
+        outs_list = [Tensor(o) for o in leaves]
+        from ..ops import _dispatch as _dsp
+        from ..core import flags as _flags
+        if _flags.flag("check_nan_inf") and not any(
+                isinstance(o, jax.core.Tracer) for o in leaves):
+            _dsp._check_nan_inf("static_program", tuple(leaves))
+        if _dsp._PROFILE_HOOK is not None:
+            import time as _time
+            _dsp._PROFILE_HOOK("static_program", _t0, _time.time())
+        if record:
+            _ag.record_node(
+                _ag._JitVJP(raw_vjp,
+                            treedef=None if flat_native else treedef),
+                diff_inputs, outs_list, "static_program")
+        return jax.tree_util.tree_unflatten(
+            treedef, [t for t in outs_list])
 
     def program(self, *args):
         """The Program captured by the most recent call (lazy-lowered);

@@ -224,6 +224,13 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     seq_lengths = np.zeros(np.shape(finished), np.int64)
     outputs = []
     step = 0
+    if max_step_num is None:
+        # a model that never emits end_token must not hang the host loop
+        # forever (ADVICE r4): apply a large default cap, warn on hit
+        max_step_num = 10000
+        _warn_on_cap = True
+    else:
+        _warn_on_cap = False
     while not bool(np.all(finished)):
         out, states, inputs, finished = decoder.step(step, inputs, states,
                                                      **kwargs)
@@ -232,6 +239,12 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         outputs.append(out)
         step += 1
         if max_step_num is not None and step >= max_step_num:
+            if _warn_on_cap:
+                import warnings
+                warnings.warn(
+                    "dynamic_decode hit the default 10000-step cap without "
+                    "every beam emitting end_token; pass max_step_num to "
+                    "raise or silence this")
             break
     lengths = getattr(states, "lengths", seq_lengths)
     try:

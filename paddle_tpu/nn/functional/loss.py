@@ -331,6 +331,28 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         input_lengths, Tensor) else jnp.asarray(input_lengths)
     label_lengths_v = label_lengths._value if isinstance(
         label_lengths, Tensor) else jnp.asarray(label_lengths)
+    # warpctc errors on out-of-range lengths; the clipped take_along_axis
+    # below would silently read frozen alpha entries instead (ADVICE r4).
+    # Validate HOST-side values only — np.asarray on a device array would
+    # add a device->host sync per call to the hot loss path; device-array
+    # lengths are trusted (they came from the same device pipeline).
+    import numpy as _np
+    T_max = int(log_probs._value.shape[0])
+    L_max = int(labels_v.shape[1]) if labels_v.ndim > 1 else int(
+        labels_v.shape[0])
+
+    def _host_max(v):
+        if isinstance(v, (int, list, tuple, _np.ndarray, _np.integer)):
+            return int(_np.max(_np.asarray(v)))
+        return None
+
+    im, lm = _host_max(input_lengths), _host_max(label_lengths)
+    if im is not None and im > T_max:
+        raise ValueError(
+            f"ctc_loss: input_lengths exceed max_logit_length {T_max}")
+    if lm is not None and lm > L_max:
+        raise ValueError(
+            f"ctc_loss: label_lengths exceed labels length {L_max}")
     return run_op(f, [log_probs], "ctc_loss")
 
 

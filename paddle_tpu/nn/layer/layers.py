@@ -7,6 +7,7 @@ sublayer registries, buffers, state_dict/set_state_dict, train/eval.
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -24,6 +25,14 @@ class _HookRemoveHelper:
 
     def remove(self):
         self._hooks.pop(self._key, None)
+
+
+class _CallDepth(threading.local):
+    def __init__(self):
+        self.depth = 0
+
+
+_LAYER_CALL_DEPTH = _CallDepth()
 
 
 class Layer:
@@ -252,16 +261,83 @@ class Layer:
     def forward(self, *inputs, **kwargs):
         raise NotImplementedError
 
+    # step-chain capture (FLAGS_eager_auto_jit): a TOP-LEVEL layer called
+    # repeatedly with the same signature is promoted to its captured
+    # static program (jit.to_static machinery) — the repeated per-op tape
+    # becomes ONE fwd executable + ONE vjp executable. This is the eager
+    # hot loop's answer to the reference's dygraph program-desc caching
+    # (imperative/tracer.cc:172): on a remote/tunnel device the per-op
+    # RTTs dominate eager stepping, and capture removes all but one.
+    _AUTOJIT_THRESHOLD = 3
+
+    def _autojit_try(self, inputs, kwargs):
+        from ...core import flags as _flags
+        from ...core.tensor import Tensor as _T
+        if self.__dict__.get("_autojit_off") or kwargs:
+            return None
+        if not _flags.flag("eager_auto_jit"):
+            return None
+        if _LAYER_CALL_DEPTH.depth or not inputs \
+                or not all(isinstance(a, _T) for a in inputs):
+            return None
+        if isinstance(self.__dict__.get("forward"), object) and \
+                type(self.__dict__.get("forward")).__name__ == "StaticFunction":
+            return None            # explicitly to_static'd already
+        import jax as _jax
+        if any(isinstance(a._value, _jax.core.Tracer) for a in inputs):
+            return None
+        for l in self.sublayers(include_self=True):
+            if self.training and l._buffers:
+                # buffer mutations (BN running stats) are DISCARDED by the
+                # functional capture; keep training-mode BN models eager
+                return None
+            if l._forward_pre_hooks or l._forward_post_hooks:
+                # hooks run INSIDE the capture trace, so python side
+                # effects (logging, stats) would fire once per compile
+                # instead of once per call — keep hooked models eager
+                return None
+        # key the capture on EVERY sublayer's training flag: toggling one
+        # sublayer's train/eval (e.g. model.dropout.eval()) must retrace,
+        # not replay the stale program
+        sig = (tuple(l.training
+                     for l in self.sublayers(include_self=True)),
+               tuple((tuple(a.shape), str(a.dtype), a.stop_gradient)
+                     for a in inputs))
+        state = self.__dict__.setdefault("_autojit_state", {})
+        state[sig] = state.get(sig, 0) + 1
+        if len(state) > 32:
+            state.clear()
+        if state[sig] < self._AUTOJIT_THRESHOLD:
+            return None
+        sf = self.__dict__.get("_autojit_sf")
+        if sf is None:
+            from ...jit.to_static import StaticFunction
+            sf = StaticFunction(type(self).forward.__get__(self), layer=self)
+            self.__dict__["_autojit_sf"] = sf
+        return sf
+
     def __call__(self, *inputs, **kwargs):
-        for hook in list(self._forward_pre_hooks.values()):
-            result = hook(self, inputs)
-            if result is not None:
-                inputs = result if isinstance(result, tuple) else (result,)
-        outputs = self.forward(*inputs, **kwargs)
-        for hook in list(self._forward_post_hooks.values()):
-            result = hook(self, inputs, outputs)
-            if result is not None:
-                outputs = result
+        sf = self._autojit_try(inputs, kwargs)
+        if sf is not None:
+            try:
+                return sf(*inputs, **kwargs)
+            except Exception:
+                # any capture failure (untraceable control flow, exotic
+                # outputs) permanently reverts this layer to eager
+                self.__dict__["_autojit_off"] = True
+        _LAYER_CALL_DEPTH.depth += 1
+        try:
+            for hook in list(self._forward_pre_hooks.values()):
+                result = hook(self, inputs)
+                if result is not None:
+                    inputs = result if isinstance(result, tuple) else (result,)
+            outputs = self.forward(*inputs, **kwargs)
+            for hook in list(self._forward_post_hooks.values()):
+                result = hook(self, inputs, outputs)
+                if result is not None:
+                    outputs = result
+        finally:
+            _LAYER_CALL_DEPTH.depth -= 1
         return outputs
 
     def __repr__(self):

@@ -196,7 +196,7 @@ def _maybe_coalesce(x):
     return x.coalesce() if len(np.unique(flat)) < len(flat) else x
 
 
-def _ewise(a, b, fn, name):
+def _ewise(a, b, fn, name, require_same_pattern=False):
     """Sparse(+)sparse elementwise; result sparsity = union of patterns."""
     was_csr = isinstance(a, SparseCsrTensor)
     a, b = _as_coo(a), _as_coo(b)
@@ -206,6 +206,11 @@ def _ewise(a, b, fn, name):
     # duplicate indices would be dropped by the union scatter (and have
     # ill-defined semantics for multiply/divide): coalesce first
     a, b = _maybe_coalesce(a), _maybe_coalesce(b)
+    if require_same_pattern and not _same_pattern(a, b):
+        raise ValueError(
+            f"sparse.{name} requires operands with identical sparsity "
+            "patterns (positions present in one but not the other would "
+            "compute x/0 -> inf); densify or align patterns first")
     if _same_pattern(a, b):
         vals = run_op(fn, [a.values, b.values], f"sparse_{name}")
         out = SparseCooTensor(a.indices, vals, a.shape)
@@ -244,7 +249,12 @@ def multiply(a, b):
 
 
 def divide(a, b):
-    return _ewise(a, b, lambda x, y: x / y, "divide")
+    # union-pattern semantics are only sound for add/sub/mul: a position
+    # present in `a` but missing in `b` would divide by the implicit zero
+    # and silently produce inf/nan — refuse instead (ADVICE r4); the check
+    # rides inside _ewise where the operands are already coalesced
+    return _ewise(a, b, lambda x, y: x / y, "divide",
+                  require_same_pattern=True)
 
 
 def matmul(a, dense):
