@@ -366,7 +366,9 @@ def _dense_cot(c):
 # wiring (who feeds whom) repeats, and the vjp residual pytrees ride in as
 # jit arguments. One executable per backward instead of N.
 _FUSED_BWD_CACHE: dict = {}
+_FUSED_BWD_SEEN: dict = {}
 _FUSED_BWD_MAX = 256
+_FUSED_BWD_THRESHOLD = 2   # compile only for REPEATING tape structures
 
 
 def _fused_backward_try(root, grad, ordered):
@@ -407,6 +409,16 @@ def _fused_backward_try(root, grad, ordered):
     key = (len(slots), slot_of(root), tuple(structure))
     fn = _FUSED_BWD_CACHE.get(key)
     if fn is None:
+        # gate the whole-tape compile on structure REPETITION (mirror of
+        # the forward's _AUTOJIT_THRESHOLD): a varying-shape / dynamic-
+        # graph workload would otherwise pay a full XLA compile on every
+        # novel backward instead of the already-compiled eager walk
+        seen = _FUSED_BWD_SEEN.get(key, 0) + 1
+        if len(_FUSED_BWD_SEEN) >= 4 * _FUSED_BWD_MAX:
+            _FUSED_BWD_SEEN.clear()
+        _FUSED_BWD_SEEN[key] = seen
+        if seen < _FUSED_BWD_THRESHOLD:
+            return None
         if len(_FUSED_BWD_CACHE) >= _FUSED_BWD_MAX:
             _FUSED_BWD_CACHE.clear()
         struct = tuple(structure)

@@ -7,6 +7,7 @@ ops draw fresh keys while jitted programs take keys as explicit inputs.
 """
 from __future__ import annotations
 
+import functools
 import threading
 
 import jax
@@ -36,38 +37,93 @@ class Generator:
             # bring-up).
             self._key = None
             self._count = 0
+            self._pool = []
         return self
 
     def initial_seed(self) -> int:
         return self._seed
 
+    _POOL = 16
+
+    @staticmethod
+    @functools.lru_cache(maxsize=1)
+    def _refill_fn(n):
+        # ONE jitted executable producing n sequential split(k, 2) draws —
+        # bitwise the same stream as n individual next_key calls (the
+        # chain advances split[0], hands out split[1]), amortizing the
+        # per-draw device dispatch to 1/n
+        def chain(k):
+            def body(c, _):
+                c2, out = jax.random.split(c)
+                return c2, out
+            return jax.lax.scan(body, k, None, length=n)
+        return jax.jit(chain)
+
+    def _refill(self):
+        cur = self._key if self._key is not None \
+            else jax.random.key(self._seed)
+        new_key, pool = Generator._refill_fn(self._POOL)(cur)
+        if isinstance(new_key, jax.core.Tracer):
+            # a jit trace would capture the split and leak a tracer
+            # into host state (note: nothing is committed before this
+            # raise — a lazily-created key may itself be a tracer);
+            # vjp-linearize replays (recompute) keep concrete keys
+            # concrete and pass through here
+            raise TraceKeyError(
+                "Generator.next_key() called inside a jax trace — draw "
+                "the key before tracing (or push a trace key for replay)")
+        self._key = new_key
+        self._pool = list(pool)
+
+    @staticmethod
+    def _in_staging_trace() -> bool:
+        """True under a STAGING trace (jit/pjit DynamicJaxprTrace), where
+        a concrete key handed out would be baked into the program as a
+        constant and replayed every call. vjp/linearize traces
+        (LinearizeTrace) keep concrete keys concrete — the recompute
+        meta-optimizer's rng-replay draws THROUGH them legitimately, so
+        they must keep being served (the pre-pool behavior)."""
+        try:
+            from jax._src import core as _core
+            return type(_core.trace_ctx.trace).__name__ == "DynamicJaxprTrace"
+        except Exception:
+            return False
+
     def next_key(self, n: int = 1):
+        # keys are drawn from a small pre-split POOL: one device-side
+        # split serves 16 draws. On a high-latency dispatch path (the
+        # tunneled chip) a per-draw split costs one RTT — with two
+        # captured static programs per eager step that was ~20% of the
+        # whole step. get_state snapshots the pool so restore stays EXACT.
+        if self._in_staging_trace():
+            # the pre-pool code raised on EVERY staged-trace draw (the
+            # split produced a tracer); a warm pool must not weaken that
+            # to a 1-in-16 intermittent — a concrete key baked into a
+            # traced program would replay the same randomness every call
+            raise TraceKeyError(
+                "Generator.next_key() called inside a jax trace — draw "
+                "the key before tracing (or push a trace key for replay)")
         with self._lock:
-            cur = self._key if self._key is not None \
-                else jax.random.key(self._seed)
-            new_key, *keys = jax.random.split(cur, n + 1)
-            if isinstance(new_key, jax.core.Tracer):
-                # a jit trace would capture the split and leak a tracer
-                # into host state (note: nothing is committed before this
-                # raise — a lazily-created key may itself be a tracer);
-                # vjp-linearize replays (recompute) keep concrete keys
-                # concrete and pass through here
-                raise TraceKeyError(
-                    "Generator.next_key() called inside a jax trace — draw "
-                    "the key before tracing (or push a trace key for replay)")
-            self._key = new_key
+            keys = []
+            for _ in range(n):
+                if not self._pool:
+                    self._refill()
+                keys.append(self._pool.pop(0))
             self._count += n
         return keys[0] if n == 1 else keys
 
     def get_state(self):
-        """(seed, count, raw key data) — the raw key makes restore EXACT:
-        replaying `count` draws can't reproduce a stream whose draws had
-        mixed granularity (split(k, n+1) != n sequential split(k, 2))."""
+        """(seed, count, raw key data, pooled key data) — the raw key +
+        remaining pool make restore EXACT: replaying `count` draws can't
+        reproduce a stream whose draws had mixed granularity
+        (split(k, n+1) != n sequential split(k, 2))."""
         import numpy as np
-        with self._lock:  # consistent (count, key) snapshot
+        with self._lock:  # consistent (count, key, pool) snapshot
             kd = None if self._key is None else \
                 np.asarray(jax.random.key_data(self._key))
-            return (self._seed, self._count, kd)
+            pool = tuple(np.asarray(jax.random.key_data(k))
+                         for k in getattr(self, "_pool", ()))
+            return (self._seed, self._count, kd, pool)
 
     def set_state(self, state):
         if len(state) == 2:  # legacy (seed, count) form: replay draws
@@ -76,12 +132,15 @@ class Generator:
             if count:
                 self.next_key(count)
             return
-        seed, count, kd = state
+        seed, count, kd = state[0], state[1], state[2]
+        pool = state[3] if len(state) > 3 else ()
         with self._lock:
             self._seed = int(seed)
             self._count = int(count)
             self._key = None if kd is None else \
                 jax.random.wrap_key_data(jax.numpy.asarray(kd))
+            self._pool = [jax.random.wrap_key_data(jax.numpy.asarray(p))
+                          for p in pool]
 
 
 _DEFAULT = Generator(0)

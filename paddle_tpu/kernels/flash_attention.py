@@ -56,7 +56,7 @@ _LN2 = 0.6931471805599453
 # online-softmax identities absorb them (p == 0, alpha == 1).
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
-               seq_len, unroll, heads):
+               seq_len, unroll, heads, local_softmax):
     qi = pl.program_id(1)
     # dots run in the INPUT dtype (bf16 hits the full-rate MXU path; the
     # f32 accumulate comes from preferred_element_type) — upcasting q/k/v
@@ -68,15 +68,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
     G = heads                                 # bh slices per grid step
 
     def tile(g, kb, carry, masked):
-        # The tile's softmax normalizes against its LOCAL row max — NOT the
-        # running max — so the [Bq,Bk] exp and both dots have no data
-        # dependence on the carry; combined with group-unrolling, tile
-        # i+1's MXU dots issue under tile i's VPU exp. The carry merge
-        # (segment-merge of online softmax) only touches [Bq,1]/[Bq,D]
-        # vectors, a ~1% tail. This halved the serial per-tile critical
-        # path vs the classic running-max formulation (fwd 0.20 -> see
-        # bench) because that chain forced dot -> max -> exp -> dot
-        # end-to-end serialization every tile.
+        # Two softmax formulations, picked per head_dim by the dispatcher:
+        # - local_softmax (d>=128): normalize against the tile's LOCAL row
+        #   max so the [Bq,Bk] exp and both dots have no data dependence on
+        #   the carry (tile i+1's dots issue under tile i's exp); the carry
+        #   merge (online-softmax segment merge) touches only [Bq,1]/[Bq,D]
+        #   vectors. Measured +9% fwd at d128/s8192.
+        # - running max (d<64..127): the classic chain; the extra [Bq,D]
+        #   merge multiplies of the local form cost more than the overlap
+        #   buys when D is narrow. Measured +10% fwd at d64/s8192.
         m_run, l_run, acc = carry
         k = k_ref[g, pl.ds(kb * block_k, block_k), :]  # [Bk, D]
         v = v_ref[g, pl.ds(kb * block_k, block_k), :]
@@ -86,19 +86,28 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
             qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(kpos <= qpos, s, -1e30)
-        m_t = jnp.max(s, axis=1, keepdims=True)
-        p = jnp.exp2(s - m_t)
-        l_t = jnp.sum(p, axis=1, keepdims=True)
-        acc_t = jax.lax.dot_general(
+        if local_softmax:
+            m_t = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp2(s - m_t)
+            l_t = jnp.sum(p, axis=1, keepdims=True)
+            acc_t = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_new = jnp.maximum(m_run, m_t)
+            alpha = jnp.exp2(m_run - m_new)
+            # fully-masked overrun tiles: m_t == -1e30 -> beta == 0 wipes
+            # the garbage p == exp2(0) == 1 rows out of the merge
+            beta = jnp.exp2(m_t - m_new)
+            l_new = l_run * alpha + l_t * beta
+            acc = acc * alpha + acc_t * beta
+            return m_new, l_new, acc
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_run - m_new)
+        l_new = l_run * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_new = jnp.maximum(m_run, m_t)
-        alpha = jnp.exp2(m_run - m_new)
-        # fully-masked overrun tiles: m_t == -1e30 -> beta == 0 wipes the
-        # garbage p == exp2(0) == 1 rows out of the merge
-        beta = jnp.exp2(m_t - m_new)
-        l_new = l_run * alpha + l_t * beta
-        acc = acc * alpha + acc_t * beta
         return m_new, l_new, acc
 
     def group(gi, carry, masked):
@@ -179,7 +188,7 @@ def _flash_fwd_bhsd(q, k, v, *, causal, block_q, block_k, interpret):
     unroll = _pick_unroll(s // block_k, G * 8 * block_q * block_k)
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_k=block_k, seq_len=s, unroll=unroll,
-                               heads=G)
+                               heads=G, local_softmax=d >= 128)
     grid = (bh // G, s // block_q)
     return pl.pallas_call(
         kernel,
@@ -409,17 +418,33 @@ def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
-    # The group-unrolled two-pass backward beats the fused single-pass
-    # kernel (258 vs 212 steps/s at d64/s8192 even before unrolling — the
-    # fused kernel's dq_acc scratch read-modify-write serializes what the
-    # unrolled two-pass overlaps), so two-pass is the default everywhere;
-    # the fused kernel remains as a tested-equal alternative
+    bh, s, d = q.shape
+    # The fused single-pass backward wins UNDER jax.grad composition at
+    # both head dims (measured r5, steps/s under grad at s8192: d64 148
+    # fused vs 121 two-pass; d128 279 vs 238 — standalone kernel timings
+    # said the opposite, but the grad-composed program schedules the
+    # two-pass's three pallas calls worse). Keep the fused default with
+    # its VMEM-residency guard; the two-pass covers everything else
     # (tests/test_flash_attention.py asserts grad parity between the two).
+    vmem_est = (3 * q.dtype.itemsize + 4) * s * d + 8 * s
+    if s % block_q == 0 and s % block_k == 0 \
+            and vmem_est < _FUSED_BWD_VMEM_CAP:
+        return _flash_bwd_fused_bhsd(q, k, v, o, lse, g, causal=causal,
+                                     block_q=block_q, block_k=block_k,
+                                     interpret=interpret)
     return _flash_bwd_bhsd(q, k, v, o, lse, g, causal=causal, block_q=block_q,
                            block_k=block_k, interpret=interpret)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# resident streams for the fused backward: q/do/dq at [S, D] + f32 dq
+# scratch (k/v/dk/dv stream per k-block); stay inside scoped vmem with
+# headroom for fusions jax.grad composes around the custom call.
+# 12 MiB admits d128/s8192 (10.5 MiB resident, measured compiling + 0.51
+# MFU under grad); d256 long-seq falls to the streaming two-pass.
+_FUSED_BWD_VMEM_CAP = 12 * 2 ** 20
 
 
 def flash_attention_arrays(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,

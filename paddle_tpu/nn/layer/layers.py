@@ -287,7 +287,7 @@ class Layer:
         if any(isinstance(a._value, _jax.core.Tracer) for a in inputs):
             return None
         for l in self.sublayers(include_self=True):
-            if self.training and l._buffers:
+            if l.training and l._buffers:
                 # buffer mutations (BN running stats) are DISCARDED by the
                 # functional capture; keep training-mode BN models eager
                 return None
