@@ -76,18 +76,21 @@ class Generator:
         self._pool = list(pool)
 
     @staticmethod
-    def _in_staging_trace() -> bool:
-        """True under a STAGING trace (jit/pjit DynamicJaxprTrace), where
-        a concrete key handed out would be baked into the program as a
-        constant and replayed every call. vjp/linearize traces
-        (LinearizeTrace) keep concrete keys concrete — the recompute
-        meta-optimizer's rng-replay draws THROUGH them legitimately, so
-        they must keep being served (the pre-pool behavior)."""
+    def _trace_mode() -> str:
+        """"clean" (no trace: pool OK), "staging" (jit/pjit: must raise),
+        or "unknown" (linearize/other/probe failure: fall back to the
+        pre-pool BEHAVIORAL path — split once and inspect the result — so
+        a jax upgrade that breaks the private probe degrades to the old
+        per-draw safety, never to silently baking a key constant)."""
         try:
             from jax._src import core as _core
-            return type(_core.trace_ctx.trace).__name__ == "DynamicJaxprTrace"
+            if _core.trace_state_clean():
+                return "clean"
+            if type(_core.trace_ctx.trace).__name__ == "DynamicJaxprTrace":
+                return "staging"
         except Exception:
-            return False
+            pass
+        return "unknown"
 
     def next_key(self, n: int = 1):
         # keys are drawn from a small pre-split POOL: one device-side
@@ -95,7 +98,8 @@ class Generator:
         # tunneled chip) a per-draw split costs one RTT — with two
         # captured static programs per eager step that was ~20% of the
         # whole step. get_state snapshots the pool so restore stays EXACT.
-        if self._in_staging_trace():
+        mode = self._trace_mode()
+        if mode == "staging":
             # the pre-pool code raised on EVERY staged-trace draw (the
             # split produced a tracer); a warm pool must not weaken that
             # to a 1-in-16 intermittent — a concrete key baked into a
@@ -103,6 +107,29 @@ class Generator:
             raise TraceKeyError(
                 "Generator.next_key() called inside a jax trace — draw "
                 "the key before tracing (or push a trace key for replay)")
+        if mode == "unknown":
+            # behavioral pre-pool path: per-draw split whose RESULT tells
+            # us whether this trace stages (tracer -> raise) or replays
+            # concretely (linearize recompute -> serve). The pool stream
+            # is preserved: these draws consume pool slots first.
+            with self._lock:
+                keys = []
+                for _ in range(n):
+                    if self._pool:
+                        keys.append(self._pool.pop(0))
+                        continue
+                    cur = self._key if self._key is not None \
+                        else jax.random.key(self._seed)
+                    new_key, k = jax.random.split(cur)
+                    if isinstance(new_key, jax.core.Tracer):
+                        raise TraceKeyError(
+                            "Generator.next_key() called inside a jax "
+                            "trace — draw the key before tracing (or push "
+                            "a trace key for replay)")
+                    self._key = new_key
+                    keys.append(k)
+                self._count += n
+            return keys[0] if n == 1 else keys
         with self._lock:
             keys = []
             for _ in range(n):

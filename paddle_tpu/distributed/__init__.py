@@ -5,3 +5,95 @@ from ..parallel import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .fleet_executor import DistModel, FleetExecutor  # noqa: F401
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+
+# ---- remaining reference-surface members ----
+from ..parallel import launch  # noqa: F401  (module: python -m ...launch)
+
+
+class _PsEntryConfig:
+    """Sparse-table entry (admission) policies for the PS tier
+    (`distributed/entry_attr.py`): gate which feature ids get rows."""
+
+    def __init__(self, kind, *args):
+        self._kind = kind
+        self._args = args
+
+    def _to_attr(self):
+        return ":".join([self._kind] + [str(a) for a in self._args])
+
+    def __repr__(self):
+        return f"{type(self).__name__}({', '.join(map(str, self._args))})"
+
+
+class CountFilterEntry(_PsEntryConfig):
+    """Admit a feature only after it has been seen `count` times."""
+
+    def __init__(self, count: int):
+        if count < 0:
+            raise ValueError("count must be non-negative")
+        super().__init__("count_filter_entry", int(count))
+
+
+class ShowClickEntry(_PsEntryConfig):
+    """Admission scored by named show/click input slots (CTR tables)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        super().__init__("show_click_entry", show_name, click_name)
+
+
+class ProbabilityEntry(_PsEntryConfig):
+    """Admit a feature with the given probability."""
+
+    def __init__(self, probability: float):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        super().__init__("probability_entry", float(probability))
+
+
+# gloo_* compat: the reference uses Gloo for CPU-side barriers/rendezvous;
+# this build's CPU control plane is the TCPStore + collective env, so these
+# bind to it (same call sites, same semantics).
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    from ..parallel import env as _env
+    host, port = server_endpoint.rsplit(":", 1)
+    from .._native import TCPStore
+    store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                     world_size=rank_num)
+    _GLOO_STATE["store"] = store
+    _GLOO_STATE["rank"] = rank_id
+    _GLOO_STATE["nranks"] = rank_num
+    return store
+
+
+_GLOO_STATE = {}
+
+
+def gloo_barrier():
+    store = _GLOO_STATE.get("store")
+    if store is None:
+        raise RuntimeError("gloo_barrier before gloo_init_parallel_env")
+    import time as _t
+    n = _GLOO_STATE["nranks"]
+    gen = _GLOO_STATE.get("gen", 0)
+    _GLOO_STATE["gen"] = gen + 1
+    key = f"gloo_barrier/{gen}"
+    arrived = store.add(key, 1)
+    deadline = _t.time() + 60
+    while arrived < n:
+        if _t.time() > deadline:
+            raise TimeoutError("gloo_barrier timed out")
+        _t.sleep(0.01)
+        arrived = store.add(key, 0)
+
+
+def gloo_release():
+    _GLOO_STATE.clear()
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    """paddle.distributed.split compat — row/column-parallel splitting of a
+    dense layer's computation is covered by mp_layers (ColumnParallelLinear
+    / RowParallelLinear / VocabParallelEmbedding); the tensor-split form
+    delegates to paddle.split."""
+    from ..ops.manipulation import split as _split
+    return _split(x, num_or_sections, axis)

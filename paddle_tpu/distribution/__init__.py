@@ -157,6 +157,13 @@ class Multinomial(Distribution):
 
 
 def kl_divergence(p, q):
+    # registered pairwise rules first (register_kl), walking the MROs the
+    # way the reference's dispatch does
+    for kp in type(p).__mro__:
+        for kq in type(q).__mro__:
+            fn = _KL_REGISTRY.get((kp, kq))
+            if fn is not None:
+                return fn(p, q)
     if hasattr(p, "kl_divergence"):
         return p.kl_divergence(q)
     raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")
@@ -363,3 +370,75 @@ class AffineTransform:
         return run_op(lambda m, s, v: jnp.broadcast_to(jnp.log(jnp.abs(s)),
                                                        v.shape),
                       [self.loc, self.scale, ensure_tensor(x)], "affine_ldj")
+
+
+class Dirichlet(Distribution):
+    """Dirichlet(concentration) (`distribution/dirichlet.py`)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+
+    @property
+    def mean(self):
+        from ..ops._dispatch import run_op as _run
+        return _run(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                    [self.concentration], "dirichlet_mean")
+
+    @property
+    def variance(self):
+        from ..ops._dispatch import run_op as _run
+
+        def f(c):
+            a0 = jnp.sum(c, -1, keepdims=True)
+            m = c / a0
+            return m * (1 - m) / (a0 + 1)
+
+        return _run(f, [self.concentration], "dirichlet_var")
+
+    def sample(self, shape=()):
+        from ..core import random as rnd
+        import jax as _jax
+        key = rnd.next_key()
+        c = self.concentration._value
+        out = _jax.random.dirichlet(key, c, tuple(shape) + c.shape[:-1])
+        return Tensor(out)
+
+    def log_prob(self, value):
+        from ..ops._dispatch import run_op as _run
+        import jax as _jax
+
+        def f(c, v):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + _jax.scipy.special.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(_jax.scipy.special.gammaln(c), -1))
+
+        return _run(f, [self.concentration, _t(value)], "dirichlet_logp")
+
+    def entropy(self):
+        from ..ops._dispatch import run_op as _run
+        import jax as _jax
+
+        def f(c):
+            a0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            lnB = jnp.sum(_jax.scipy.special.gammaln(c), -1) \
+                - _jax.scipy.special.gammaln(a0)
+            dg = _jax.scipy.special.digamma
+            return (lnB + (a0 - k) * dg(a0)
+                    - jnp.sum((c - 1) * dg(c), -1))
+
+        return _run(f, [self.concentration], "dirichlet_entropy")
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a pairwise KL rule consumed by kl_divergence
+    (`distribution/kl.py` register_kl)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco

@@ -6,3 +6,138 @@ optimizers (`incubate/optimizer/`).
 """
 from . import asp  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+
+# ---- segment ops (incubate/tensor/math.py over segment_pool ops) ----
+# TPU-first: jax.ops.segment_* are XLA scatter-reductions — exactly the
+# primitive the reference's CUDA segment kernels hand-roll.
+
+def _segment(op_name):
+    import jax
+    import jax.numpy as jnp
+    from ..ops._dispatch import ensure_tensor, run_op
+
+    def fn(data, segment_ids, name=None):
+        data = ensure_tensor(data)
+        ids = ensure_tensor(segment_ids)._value.astype("int32")
+        import numpy as np
+        n_seg = int(np.asarray(ids).max()) + 1 if ids.size else 0
+
+        def f(d):
+            if op_name == "sum":
+                return jax.ops.segment_sum(d, ids, n_seg)
+            if op_name == "mean":
+                s = jax.ops.segment_sum(d, ids, n_seg)
+                c = jax.ops.segment_sum(jnp.ones_like(d), ids, n_seg)
+                return s / jnp.maximum(c, 1.0)
+            if op_name == "max":
+                return jax.ops.segment_max(d, ids, n_seg)
+            return jax.ops.segment_min(d, ids, n_seg)
+
+        return run_op(f, [data], f"segment_{op_name}")
+
+    fn.__name__ = f"segment_{op_name}"
+    return fn
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Message passing gather-scatter (`incubate/operators/graph_send_recv`):
+    out[d] = reduce over edges (s -> d) of x[s]."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ops._dispatch import ensure_tensor, run_op
+    x = ensure_tensor(x)
+    src = ensure_tensor(src_index)._value.astype("int32")
+    dst = ensure_tensor(dst_index)._value.astype("int32")
+    n_out = int(out_size) if out_size is not None else int(x.shape[0])
+    pool = pool_type.lower()
+
+    def f(a):
+        msgs = jnp.take(a, src, axis=0)
+        if pool == "sum":
+            return jax.ops.segment_sum(msgs, dst, n_out)
+        if pool == "mean":
+            s = jax.ops.segment_sum(msgs, dst, n_out)
+            c = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), a.dtype),
+                                    dst, n_out)
+            return s / jnp.maximum(c, 1.0)[(...,) + (None,) * (a.ndim - 1)]
+        if pool == "max":
+            return jax.ops.segment_max(msgs, dst, n_out)
+        if pool == "min":
+            return jax.ops.segment_min(msgs, dst, n_out)
+        raise ValueError(f"graph_send_recv: bad pool_type {pool_type!r}")
+
+    return run_op(f, [x], "graph_send_recv")
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       return_eids=False, name=None):
+    """K-hop neighbor sampling over a CSC graph
+    (`incubate/operators/graph_khop_sampler`): host-side like the
+    reference's CPU sampler; returns (edge_src, edge_dst, sample_index,
+    reindex_nodes) with nodes reindexed to the sampled subgraph."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    row_v = np.asarray(row._value if hasattr(row, "_value") else row)
+    col_v = np.asarray(colptr._value if hasattr(colptr, "_value") else colptr)
+    seeds = np.asarray(input_nodes._value if hasattr(input_nodes, "_value")
+                       else input_nodes).reshape(-1)
+    rng = np.random.RandomState(0)
+    frontier = seeds
+    all_src, all_dst = [], []
+    seen = list(seeds)
+    pos = {int(n): i for i, n in enumerate(seeds)}
+    for k in sample_sizes:
+        nxt = []
+        for d in frontier:
+            lo, hi = int(col_v[int(d)]), int(col_v[int(d) + 1])
+            nbrs = row_v[lo:hi]
+            if len(nbrs) > k:
+                nbrs = rng.choice(nbrs, size=k, replace=False)
+            for s in nbrs:
+                s = int(s)
+                if s not in pos:
+                    pos[s] = len(seen)
+                    seen.append(s)
+                    nxt.append(s)
+                all_src.append(pos[s])
+                all_dst.append(pos[int(d)])
+        frontier = np.asarray(nxt, np.int64)
+    return (Tensor(np.asarray(all_src, np.int64)),
+            Tensor(np.asarray(all_dst, np.int64)),
+            Tensor(np.asarray(seeds, np.int64)),
+            Tensor(np.asarray(seen, np.int64)))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (`incubate/operators/softmax_mask_fuse`) —
+    XLA fuses the add into the softmax; this is the API surface."""
+    import jax
+    from ..ops._dispatch import ensure_tensor, run_op
+    return run_op(lambda a, m: jax.nn.softmax(a + m, axis=-1),
+                  [ensure_tensor(x), ensure_tensor(mask)],
+                  "softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the upper triangle masked out (causal scores),
+    `incubate/operators/softmax_mask_fuse_upper_triangle`."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops._dispatch import ensure_tensor, run_op
+
+    def f(a):
+        n = a.shape[-1]
+        m = a.shape[-2]
+        mask = jnp.tril(jnp.ones((m, n), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e4), axis=-1)
+
+    return run_op(f, [ensure_tensor(x)], "softmax_mask_fuse_upper_triangle")

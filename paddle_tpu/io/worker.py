@@ -90,8 +90,16 @@ def _from_shm(obj, opened):
 
 
 def _worker_loop(dataset, index_queue, result_queue, collate_fn,
-                 use_shared_memory, worker_id, worker_init_fn):
+                 use_shared_memory, worker_id, worker_init_fn,
+                 num_workers=1):
     """Runs in the child process. numpy only — no jax."""
+    # publish worker metadata for get_worker_info (IterableDataset shards)
+    try:
+        from . import WorkerInfo, _WORKER_INFO
+        _WORKER_INFO[0] = WorkerInfo(worker_id, num_workers,
+                                     1234 + worker_id, dataset)
+    except Exception:
+        pass
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     collate = collate_fn or _np_collate
@@ -139,7 +147,7 @@ class MultiprocessIter:
                 target=_worker_loop,
                 args=(loader.dataset, self._index_queue, self._result_queue,
                       loader.worker_collate_fn, loader.use_shared_memory, wid,
-                      loader.worker_init_fn),
+                      loader.worker_init_fn, n),
                 daemon=True)
             p.start()
             self._workers.append(p)

@@ -3,3 +3,75 @@ from .input_spec import InputSpec  # noqa: F401
 from .to_static import StaticFunction, declarative, not_to_static, to_static  # noqa: F401
 from .train_step import TrainStep  # noqa: F401
 from .save_load import TranslatedLayer, load, save  # noqa: F401
+
+# ---- legacy compat surface -------------------------------------------------
+from .to_static import to_static as _ts
+
+
+class ProgramTranslator:
+    """dygraph_to_static ProgramTranslator compat: the global toggle for
+    to_static conversion (`program_translator.py` singleton)."""
+
+    _instance = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        type(self)._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return type(self)._enabled
+
+
+def enable_to_static(flag: bool = True):
+    ProgramTranslator.get_instance().enable(flag)
+
+
+class TracedLayer:
+    """dygraph.TracedLayer compat over StaticFunction: trace(layer, inputs)
+    returns (outputs, traced) where traced(*) replays the captured program
+    and save_inference_model exports it (jit.save)."""
+
+    def __init__(self, static_fn, layer):
+        self._fn = static_fn
+        self._layer = layer
+
+    @staticmethod
+    def trace(layer, inputs):
+        from .to_static import StaticFunction
+        sf = StaticFunction(type(layer).forward.__get__(layer), layer=layer)
+        outs = sf(*inputs)
+        return outs, TracedLayer(sf, layer)
+
+    def __call__(self, *inputs):
+        return self._fn(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kw):
+        from .input_spec import InputSpec
+        from .save_load import save as _save
+        prog = self._fn.program()
+        specs = [InputSpec(list(s.shape), str(s.dtype))
+                 for s in prog.in_specs[len(list(
+                     self._layer.parameters())):]] if hasattr(
+                         prog, "in_specs") else None
+        _save(self._layer, path, input_spec=specs, **kw)
+
+
+# verbosity/code-level knobs (dy2static debugging surface): stored and
+# honored by dy2static's transform logging when enabled
+_JIT_VERBOSITY = [0]
+_JIT_CODE_LEVEL = [0]
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    _JIT_VERBOSITY[0] = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    _JIT_CODE_LEVEL[0] = int(level)

@@ -160,3 +160,41 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 101, width=128, **kwargs)
+
+
+class ResNeXt(ResNet):
+    """vision/models/resnext.py parity: ResNet bottlenecks with grouped
+    3x3 convs (cardinality x width_per_group)."""
+
+    def __init__(self, depth=50, cardinality=32, width_per_group=4,
+                 num_classes=1000, **kw):
+        super().__init__(BottleneckBlock, depth=depth, groups=cardinality,
+                         width=width_per_group, num_classes=num_classes, **kw)
+
+
+def _resnext(depth, card, wpg, **kw):
+    return ResNeXt(depth=depth, cardinality=card, width_per_group=wpg, **kw)
+
+
+def resnext50_32x4d(**kw):
+    return _resnext(50, 32, 4, **kw)
+
+
+def resnext50_64x4d(**kw):
+    return _resnext(50, 64, 4, **kw)
+
+
+def resnext101_32x4d(**kw):
+    return _resnext(101, 32, 4, **kw)
+
+
+def resnext101_64x4d(**kw):
+    return _resnext(101, 64, 4, **kw)
+
+
+def resnext152_32x4d(**kw):
+    return _resnext(152, 32, 4, **kw)
+
+
+def resnext152_64x4d(**kw):
+    return _resnext(152, 64, 4, **kw)

@@ -55,19 +55,31 @@ class _Fire(nn.Layer):
 
 
 class SqueezeNet(nn.Layer):
-    """vision/models/squeezenet.py (v1.1) parity (~1.24M params)."""
+    """vision/models/squeezenet.py parity (v1.1 ~1.24M / v1.0 ~1.25M)."""
 
-    def __init__(self, num_classes=1000):
+    def __init__(self, num_classes=1000, version="1.1"):
         super().__init__()
-        self.features = nn.Sequential(
-            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
-            nn.MaxPool2D(3, stride=2),
-            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
-            nn.MaxPool2D(3, stride=2),
-            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
-            nn.MaxPool2D(3, stride=2),
-            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
-            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
         self.head = nn.Sequential(nn.Dropout(0.5),
                                   nn.Conv2D(512, num_classes, 1), nn.ReLU(),
                                   nn.AdaptiveAvgPool2D(1))
@@ -82,25 +94,26 @@ def squeezenet1_1(**kw):
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, cin, cout, stride):
+    def __init__(self, cin, cout, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = cout // 2
+        mk_act = (lambda: nn.Swish()) if act == "swish" else (lambda: nn.ReLU())
         if stride == 2:
             self.b1 = nn.Sequential(
                 nn.Conv2D(cin, cin, 3, stride=2, padding=1, groups=cin),
                 nn.BatchNorm2D(cin),
-                nn.Conv2D(cin, branch, 1), nn.BatchNorm2D(branch), nn.ReLU())
+                nn.Conv2D(cin, branch, 1), nn.BatchNorm2D(branch), mk_act())
             c2in = cin
         else:
             self.b1 = None
             c2in = cin // 2
         self.b2 = nn.Sequential(
-            nn.Conv2D(c2in, branch, 1), nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(c2in, branch, 1), nn.BatchNorm2D(branch), mk_act(),
             nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                       groups=branch),
             nn.BatchNorm2D(branch),
-            nn.Conv2D(branch, branch, 1), nn.BatchNorm2D(branch), nn.ReLU())
+            nn.Conv2D(branch, branch, 1), nn.BatchNorm2D(branch), mk_act())
 
     def forward(self, x):
         import paddle_tpu as paddle
@@ -119,9 +132,11 @@ class _ShuffleUnit(nn.Layer):
 class ShuffleNetV2(nn.Layer):
     """vision/models/shufflenetv2.py parity (x1.0, ~2.28M params)."""
 
-    def __init__(self, num_classes=1000, scale=1.0):
+    def __init__(self, num_classes=1000, scale=1.0, act="relu"):
         super().__init__()
-        stages = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+        self._act = act
+        stages = {0.25: [24, 48, 96, 512], 0.33: [32, 64, 128, 512],
+                  0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
                   1.5: [176, 352, 704, 1024], 2.0: [244, 488, 976, 2048]}[scale]
         self.stem = nn.Sequential(nn.Conv2D(3, 24, 3, stride=2, padding=1),
                                   nn.BatchNorm2D(24), nn.ReLU(),
@@ -129,9 +144,9 @@ class ShuffleNetV2(nn.Layer):
         blocks = []
         cin = 24
         for cout, reps in zip(stages[:3], (4, 8, 4)):
-            blocks.append(_ShuffleUnit(cin, cout, 2))
+            blocks.append(_ShuffleUnit(cin, cout, 2, act=act))
             for _ in range(reps - 1):
-                blocks.append(_ShuffleUnit(cout, cout, 1))
+                blocks.append(_ShuffleUnit(cout, cout, 1, act=act))
             cin = cout
         self.stages = nn.Sequential(*blocks)
         self.tail = nn.Sequential(nn.Conv2D(cin, stages[3], 1),
@@ -146,6 +161,30 @@ class ShuffleNetV2(nn.Layer):
 
 def shufflenet_v2_x1_0(**kw):
     return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(**kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(**kw):
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)
 
 
 class _DenseLayer(nn.Layer):
@@ -165,9 +204,10 @@ class _DenseLayer(nn.Layer):
 class DenseNet(nn.Layer):
     """vision/models/densenet.py parity (121: ~7.98M params)."""
 
-    def __init__(self, layers=(6, 12, 24, 16), growth=32, num_classes=1000):
+    def __init__(self, layers=(6, 12, 24, 16), growth=32, num_classes=1000,
+                 init_features=64):
         super().__init__()
-        c = 64
+        c = init_features
         feats = [nn.Conv2D(3, c, 7, stride=2, padding=3),
                  nn.BatchNorm2D(c), nn.ReLU(),
                  nn.MaxPool2D(3, stride=2, padding=1)]
@@ -190,6 +230,22 @@ class DenseNet(nn.Layer):
 
 def densenet121(**kw):
     return DenseNet(layers=(6, 12, 24, 16), **kw)
+
+
+def densenet161(**kw):
+    return DenseNet(layers=(6, 12, 36, 24), growth=48, init_features=96, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(layers=(6, 12, 32, 32), **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(layers=(6, 12, 48, 32), **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(layers=(6, 12, 64, 48), **kw)
 
 
 class _Inception(nn.Layer):
@@ -267,3 +323,144 @@ class GoogLeNet(nn.Layer):
 
 def googlenet(**kw):
     return GoogLeNet(**kw)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet(version="1.0", **kw)
+
+
+class _IncA(nn.Layer):
+    """InceptionV3 figure-5 block (35x35)."""
+
+    def __init__(self, cin, pool_ch):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(cin, 48, 1), _ConvBN(48, 64, 5, p=2))
+        self.b3 = nn.Sequential(_ConvBN(cin, 64, 1), _ConvBN(64, 96, 3, p=1),
+                                _ConvBN(96, 96, 3, p=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(cin, pool_ch, 1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b1(x), self.b5(x), self.b3(x),
+                              self.bp(x)], axis=1)
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, cin, cout, k, s=1, p=0):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=s, padding=p,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _IncRedA(nn.Layer):
+    """figure-10 grid reduction 35->17."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _ConvBN(cin, 384, 3, s=2)
+        self.b33 = nn.Sequential(_ConvBN(cin, 64, 1), _ConvBN(64, 96, 3, p=1),
+                                 _ConvBN(96, 96, 3, s=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b3(x), self.b33(x), self.pool(x)], axis=1)
+
+
+class _IncB(nn.Layer):
+    """figure-6 block (17x17, factorized 7x7)."""
+
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(cin, c7, 1), _ConvBN(c7, c7, (1, 7), p=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), p=(3, 0)))
+        self.b77 = nn.Sequential(
+            _ConvBN(cin, c7, 1), _ConvBN(c7, c7, (7, 1), p=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), p=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), p=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), p=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b1(x), self.b7(x), self.b77(x),
+                              self.bp(x)], axis=1)
+
+
+class _IncRedB(nn.Layer):
+    """grid reduction 17->8."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(cin, 192, 1), _ConvBN(192, 320, 3, s=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(cin, 192, 1), _ConvBN(192, 192, (1, 7), p=(0, 3)),
+            _ConvBN(192, 192, (7, 1), p=(3, 0)), _ConvBN(192, 192, 3, s=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncC(nn.Layer):
+    """figure-7 block (8x8, expanded filter bank)."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 320, 1)
+        self.b3_stem = _ConvBN(cin, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), p=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), p=(1, 0))
+        self.b33_stem = nn.Sequential(_ConvBN(cin, 448, 1),
+                                      _ConvBN(448, 384, 3, p=1))
+        self.b33_a = _ConvBN(384, 384, (1, 3), p=(0, 1))
+        self.b33_b = _ConvBN(384, 384, (3, 1), p=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        s3 = self.b3_stem(x)
+        s33 = self.b33_stem(x)
+        return paddle.concat(
+            [self.b1(x), self.b3_a(s3), self.b3_b(s3),
+             self.b33_a(s33), self.b33_b(s33), self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """vision/models/inceptionv3.py parity (~23.8M params, 299x299 input)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, s=2), _ConvBN(32, 32, 3), _ConvBN(32, 64, 3, p=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncRedA(288),
+            _IncB(768, 128), _IncB(768, 160), _IncB(768, 160), _IncB(768, 192),
+            _IncRedB(768),
+            _IncC(1280), _IncC(2048))
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.blocks(self.stem(x)))
+        return self.fc(x.reshape([x.shape[0], -1]))
+
+
+def inception_v3(**kw):
+    return InceptionV3(**kw)

@@ -163,3 +163,50 @@ class Orthogonal(Initializer):
         if rows < cols:
             q = q.T
         return (self.gain * q[:rows, :cols].reshape(shape)).astype(dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed convs
+    (`nn/initializer/Bilinear`): weight [C_out, C_in, kH, kW] gets the
+    classic bilinear interpolation stencil per channel."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        import numpy as _np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D conv weight")
+        co, ci, kh, kw = [int(v) for v in shape]
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        c_h = (kh - 1) / 2.0 if kh % 2 == 1 else f_h - 0.5
+        c_w = (kw - 1) / 2.0 if kw % 2 == 1 else f_w - 0.5
+        og = _np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] - c_h) / f_h) * (1 - abs(og[1] - c_w) / f_w)
+        w = _np.zeros((co, ci, kh, kw), _np.float32)
+        w[range(min(co, ci)), range(min(co, ci))] = filt
+        if co != ci:
+            w[:, :] = filt          # broadcast stencil when shapes differ
+        return jnp.asarray(w, dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Reference `nn/initializer/calculate_gain` table."""
+    import math as _m
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+             "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0,
+             "relu": _m.sqrt(2.0), "selu": 3.0 / 4.0}
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return _m.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity {nonlinearity!r}")
+    return gains[nonlinearity]
+
+
+_GLOBAL_INITIALIZER = [None, None]   # (weight_init, bias_init)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializers applied by Layer.create_parameter when the
+    caller passes none (reference set_global_initializer)."""
+    _GLOBAL_INITIALIZER[0] = weight_init
+    _GLOBAL_INITIALIZER[1] = bias_init

@@ -111,6 +111,14 @@ class Layer:
         init = default_initializer
         if attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
+        else:
+            # set_global_initializer overrides LAYER-BUILTIN defaults (the
+            # reference contract: user-specified ParamAttr initializers
+            # still win, the layers' own defaults do not)
+            gw, gb = I._GLOBAL_INITIALIZER
+            g = gb if is_bias else gw
+            if g is not None:
+                init = g
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         p = Parameter(jnp.zeros([int(s) for s in shape], dtype=dt))

@@ -1,0 +1,30 @@
+"""paddle.onnx parity surface.
+
+Reference parity: `python/paddle/onnx/export.py` (paddle2onnx bridge).
+This build's portable deployment artifact is StableHLO (`jit.save` ->
+`inference.Config` -> Predictor, plus the C ABI in csrc/predict_capi.cpp);
+ONNX is an NVIDIA/CPU-runtime interchange format whose operator set the
+XLA pipeline does not round-trip through. `export` here produces the
+StableHLO artifact at the requested path and records the reasoning in the
+raised guidance when a true .onnx file is demanded.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export `layer` for deployment. Writes the StableHLO artifact (the
+    TPU-portable equivalent of the reference's paddle2onnx flow). If the
+    caller explicitly requires ONNX bytes (path endswith '.onnx'), raise
+    with guidance instead of silently writing a different format."""
+    if str(path).endswith(".onnx"):
+        raise NotImplementedError(
+            "paddle.onnx.export: this TPU build deploys via StableHLO "
+            "(jit.save -> inference.Predictor / C API), not ONNX — the "
+            "XLA pipeline has no faithful ONNX opset round-trip. Export "
+            "without the .onnx suffix to produce the StableHLO artifact, "
+            "or run the reference paddle2onnx flow on a CPU/GPU build.")
+    from ..jit.save_load import save
+    save(layer, str(path), input_spec=input_spec, **configs)
+    return str(path)

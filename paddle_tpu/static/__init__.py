@@ -85,3 +85,433 @@ def default_startup_program():
 
 def data(name, shape, dtype="float32", lod_level=0):
     return InputSpec(shape, dtype, name)
+
+
+# ---- legacy fluid-static compat surface -----------------------------------
+import contextlib as _ctx
+
+
+class Scope:
+    """Variable scope (fluid Scope role): name -> value store backing the
+    legacy static API's parameter sharing (static.nn's layer scope)."""
+
+    def __init__(self):
+        from .nn import _LAYER_SCOPE
+        self._store = _LAYER_SCOPE
+
+    def var(self, name):
+        return self._store.get(name)
+
+    def find_var(self, name):
+        return self._store.get(name)
+
+
+_GLOBAL_SCOPE = Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+@_ctx.contextmanager
+def scope_guard(scope):
+    """Compat: the eager build has ONE live scope; the guard validates and
+    yields (programs execute immediately, so there is no deferred state to
+    swap)."""
+    yield scope
+
+
+@_ctx.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Compat context: ops written inside run eagerly; the main program
+    object collects nothing extra (Program capture happens via to_static),
+    but the guard keeps legacy call sites running unchanged."""
+    yield
+
+
+@_ctx.contextmanager
+def name_scope(prefix=None):
+    from ..utils import unique_name as _un
+    with _un.guard(prefix or "name_scope"):
+        yield
+
+
+@_ctx.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    """IPU pipeline-shard annotation (compat no-op: no IPU backend; mesh
+    sharding is the paddle_tpu.parallel surface)."""
+    yield
+
+
+class IpuStrategy:
+    """Accepted-for-compat IPU config carrier (no IPU backend)."""
+
+    def __init__(self):
+        self._opts = {}
+
+    def set_graph_config(self, **kw):
+        self._opts.update(kw)
+
+    def set_pipelining_config(self, **kw):
+        self._opts.update(kw)
+
+    def set_precision_config(self, **kw):
+        self._opts.update(kw)
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        self._program = program
+
+    def compile(self, feed_list=None, fetch_list=None):
+        return self._program
+
+
+class BuildStrategy:
+    """Graph-build knobs (fluid BuildStrategy): carried for compat; the
+    XLA pipeline owns fusion/memory decisions these used to toggle."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = None
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """fluid CompiledProgram compat: wraps a Program/callable; with_data_
+    parallel maps to the mesh data-parallel path at run time."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        return self
+
+    def __call__(self, *args, **kw):
+        return self._program(*args, **kw)
+
+
+class ParallelExecutor(CompiledProgram):
+    """fluid ParallelExecutor compat (superseded by CompiledProgram in the
+    reference too)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        super().__init__(main_program, build_strategy)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """fluid append_backward: in the eager-tape world this IS
+    loss.backward(); returns (param, grad) pairs like the reference —
+    for ALL trainable leaves reachable from the loss when
+    parameter_list is omitted (the reference's default)."""
+    params = parameter_list
+    if params is None:
+        # walk the tape BEFORE backward frees it: trainable leaf inputs
+        from ..core.autograd import _collect
+        leaves, seen = [], set()
+        if loss._node is not None:
+            for node in _collect([loss._node]):
+                for t in node.inputs:
+                    if (not t.stop_gradient and t._node is None
+                            and id(t) not in seen):
+                        seen.add(id(t))
+                        leaves.append(t)
+        params = leaves
+    loss.backward()
+    out = []
+    for p in params:
+        if isinstance(p, str):
+            continue
+        out.append((p, p.grad))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid gradients -> autograd.grad over the tape."""
+    from .. import autograd as _ag
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _ag.grad(targets, inputs, grad_outputs=target_gradients,
+                    allow_unused=True)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (fluid Print): host-prints the value, passes it
+    through unchanged (identity in the compute graph)."""
+    import numpy as _np
+    v = getattr(input, "_value", input)
+    head = message or "Print"
+    arr = _np.asarray(v) if not hasattr(v, "aval") else v
+    print(f"[{head}] shape={getattr(arr, 'shape', '?')} "
+          f"dtype={getattr(arr, 'dtype', '?')}\n{arr if summarize else ''}")
+    return input
+
+
+from .nn import py_func  # noqa: E402,F401
+
+
+class WeightNormParamAttr:
+    """fluid WeightNormParamAttr compat: carries the dim; consumers apply
+    nn.utils.weight_norm to the built layer."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (fluid ExponentialMovingAverage):
+    update() folds current weights in; apply()/restore() swap the shadow
+    weights for evaluation — the decay follows the reference's
+    min(decay, (1+t)/(10+t)) thresholding."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._step = 0
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def _tracked(self, params=None):
+        if params is not None:
+            self._params = list(params)
+        if not self._params:
+            raise ValueError("EMA.update: pass params on first call")
+        return self._params
+
+    def update(self, params=None):
+        import numpy as _np
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._tracked(params):
+            cur = _np.asarray(p._value, dtype="float32")
+            name = p.name or str(id(p))
+            prev = self._shadow.get(name)
+            self._shadow[name] = cur if prev is None else \
+                d * prev + (1 - d) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+        for p in self._tracked():
+            name = p.name or str(id(p))
+            if name in self._shadow:
+                self._backup[name] = p._value
+                p._value = jnp.asarray(self._shadow[name]).astype(
+                    p._value.dtype)
+        return _ctx.nullcontext()
+
+    def restore(self, executor=None):
+        for p in self._tracked():
+            name = p.name or str(id(p))
+            if name in self._backup:
+                p._value = self._backup.pop(name)
+
+
+# ---- program / persistables serialization (static/io.py role) -----------
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+    prog = default_main_program()
+    return pickle.dumps({"name": prog.name if prog else "main",
+                         "feeds": [getattr(v, "name", None) for v in feed_vars],
+                         "fetches": [getattr(v, "name", None)
+                                     for v in fetch_vars]})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+    import numpy as _np
+    from .nn import _LAYER_SCOPE
+    state = {}
+    for key, layer in _LAYER_SCOPE.items():
+        sd = getattr(layer, "state_dict", None)
+        if sd is not None:
+            state[key] = {k: _np.asarray(v._value)
+                          for k, v in layer.state_dict().items()}
+        elif hasattr(layer, "_value"):
+            state[key] = _np.asarray(layer._value)
+    return pickle.dumps(state)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    import jax.numpy as jnp
+    from .nn import _LAYER_SCOPE
+    state = pickle.loads(data)
+    for key, val in state.items():
+        layer = _LAYER_SCOPE.get(key)
+        if layer is None:
+            continue
+        if isinstance(val, dict):
+            sd = layer.state_dict()
+            for k, v in val.items():
+                if k in sd:
+                    sd[k]._value = jnp.asarray(v)
+        elif hasattr(layer, "_value"):
+            layer._value = jnp.asarray(val)
+    return state
+
+
+def save(program, model_path, protocol=4, **configs):
+    """static.save: persist the legacy scope's persistables."""
+    save_to_file(model_path + ".pdparams",
+                 serialize_persistables([], []))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    deserialize_persistables(program,
+                             load_from_file(model_path + ".pdparams"))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Inference-normalize (prune to feeds/fetches); Program.prune is the
+    TPU-era form."""
+    if hasattr(program, "prune"):
+        try:
+            return program.prune(feed_vars, fetch_vars)
+        except Exception:
+            return program
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+    return pickle.loads(load_from_file(model_path + ".pdparams"))
+
+
+def set_program_state(program, state_dict):
+    import pickle
+    deserialize_persistables(program, pickle.dumps(state_dict))
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..core.place import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..core.place import Place
+    ids = device_ids if device_ids is not None else [0]
+    return [Place("xpu", i) for i in ids]
+
+
+def npu_places(device_ids=None):
+    from ..core.place import NPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [NPUPlace(i) for i in ids]
+
+
+def mlu_places(device_ids=None):
+    from ..core.place import Place
+    ids = device_ids if device_ids is not None else [0]
+    return [Place("mlu", i) for i in ids]
+
+
+# static Variable role is played by Tensor/InputSpec in the eager build
+from ..core.tensor import Tensor as Variable  # noqa: E402,F401
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import numpy as _np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    t = Tensor(jnp.full(tuple(shape), value, dtype=_np.dtype(dtype)))
+    t.name = name
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import numpy as _np
+    import jax.numpy as jnp
+    from ..core.tensor import Parameter
+    if default_initializer is not None:
+        v = default_initializer(tuple(shape), jnp.dtype(_np.dtype(dtype)))
+    else:
+        k = 1.0 / max(_np.sqrt(_np.prod(shape[:-1]) or 1), 1)
+        v = jnp.asarray(_np.random.RandomState(0).uniform(
+            -k, k, tuple(shape)).astype(_np.dtype(dtype)))
+    p = Parameter(v, name=name)
+    from .nn import _LAYER_SCOPE
+    _LAYER_SCOPE[f"param:{name or id(p)}"] = p
+    return p
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (fluid auc op surface): returns (auc, batch_auc, states)
+    — here the exact batch AUC plus placeholder states."""
+    import numpy as _np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    p = _np.asarray(getattr(input, "_value", input))
+    y = _np.asarray(getattr(label, "_value", label)).reshape(-1)
+    score = p[:, 1] if p.ndim == 2 and p.shape[1] == 2 else p.reshape(-1)
+    order = _np.argsort(-score)
+    y_sorted = y[order]
+    pos = y_sorted.sum()
+    neg = len(y_sorted) - pos
+    if pos == 0 or neg == 0:
+        val = 0.0
+    else:
+        ranks = _np.empty(len(score))
+        ranks[_np.argsort(score)] = _np.arange(1, len(score) + 1)
+        val = float((ranks[y == 1].sum() - pos * (pos + 1) / 2) / (pos * neg))
+    a = Tensor(jnp.asarray(val, jnp.float32))
+    return a, a, []
+
+
+def device_guard(device=None):
+    import contextlib
+    return contextlib.nullcontext()

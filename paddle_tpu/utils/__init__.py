@@ -64,3 +64,22 @@ def run_check():
 def download(url, path=None, md5sum=None):
     raise RuntimeError("zero-egress environment: datasets must be local "
                        "(use paddle_tpu.vision.datasets with mode='synthetic')")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against a range
+    (`paddle.utils.require_version`)."""
+    from ..framework.version import FRAMEWORK_VERSION as _v
+
+    def parse(s):
+        return [int(x) for x in str(s).replace("rc", ".").split(".")[:3]
+                if x.isdigit()]
+
+    cur = parse(_v)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {_v} < required min {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {_v} > allowed max {max_version}")
+    return True

@@ -114,3 +114,138 @@ class Flowers(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (`vision/datasets/folder.py`): walks
+    root/<class_x>/*.<ext>, maps class dirs to indices."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp",
+                      ".npy")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or self.IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    p = os.path.join(dirpath, f)
+                    ok = is_valid_file(p) if is_valid_file is not None \
+                        else p.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root!r}")
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/unlabelled image folder (`vision/datasets/folder.py`
+    ImageFolder): every valid file under root, no targets."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        exts = tuple(e.lower() for e in (extensions
+                                         or DatasetFolder.IMG_EXTENSIONS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                p = os.path.join(dirpath, f)
+                ok = is_valid_file(p) if is_valid_file is not None \
+                    else p.lower().endswith(exts)
+                if ok:
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root!r}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs (`vision/datasets/voc2012.py`). Like the
+    other vision datasets in this build, a local copy is required
+    (`data_file=`) — there is no network egress; SYNTHETIC mode generates
+    deterministic image/mask pairs for tests."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, n_synthetic=32, seed=0):
+        self.transform = transform
+        self.mode = mode
+        self._files = None
+        if data_file is not None:
+            # extracted VOCdevkit tree: JPEGImages/*.jpg paired with
+            # SegmentationClass/*.png by the ImageSets/Segmentation split
+            root = data_file
+            for sub in ("VOCdevkit/VOC2012", "VOC2012", ""):
+                cand = os.path.join(root, sub) if sub else root
+                if os.path.isdir(os.path.join(cand, "JPEGImages")):
+                    root = cand
+                    break
+            split = {"train": "train", "valid": "val", "test": "val",
+                     "val": "val"}[mode]
+            lst = os.path.join(root, "ImageSets", "Segmentation",
+                               split + ".txt")
+            with open(lst) as fh:
+                ids = [ln.strip() for ln in fh if ln.strip()]
+            self._files = [
+                (os.path.join(root, "JPEGImages", i + ".jpg"),
+                 os.path.join(root, "SegmentationClass", i + ".png"))
+                for i in ids]
+            return
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self._imgs = (rng.rand(n_synthetic, 3, 64, 64) * 255).astype("uint8")
+        self._masks = rng.randint(0, 21, (n_synthetic, 64, 64)).astype("int64")
+
+    def __getitem__(self, idx):
+        if self._files is not None:
+            from PIL import Image
+            jp, mp = self._files[idx]
+            img = np.asarray(Image.open(jp).convert("RGB"))
+            mask = np.asarray(Image.open(mp)).astype("int64")
+        else:
+            img, mask = self._imgs[idx], self._masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._files) if self._files is not None else len(self._imgs)

@@ -5,6 +5,11 @@ from ...models import (  # noqa: F401
     wide_resnet50_2, wide_resnet101_2,
 )
 from ...models import (  # noqa: F401
-    AlexNet, DenseNet, GoogLeNet, ShuffleNetV2, SqueezeNet, alexnet,
-    densenet121, googlenet, shufflenet_v2_x1_0, squeezenet1_1,
+    AlexNet, DenseNet, GoogLeNet, InceptionV3, ResNeXt, ShuffleNetV2,
+    SqueezeNet, alexnet, densenet121, densenet161, densenet169, densenet201,
+    densenet264, googlenet, inception_v3, resnext50_32x4d, resnext50_64x4d,
+    resnext101_32x4d, resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
+    shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    shufflenet_v2_swish, squeezenet1_0, squeezenet1_1,
 )

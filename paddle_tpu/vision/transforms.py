@@ -156,3 +156,248 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+# ---- functional image ops (transforms/functional.py parity) ----
+# All HWC numpy-based (PIL-free, like the rest of this module); scipy
+# supplies the rotation resample.
+
+def hflip(img):
+    return np.ascontiguousarray(np.asarray(img)[:, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(np.asarray(img)[::-1])
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = np.asarray(img)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    h, w = arr.shape[0], arr.shape[1]
+    top = max((h - oh) // 2, 0)
+    left = max((w - ow) // 2, 0)
+    return crop(arr, top, left, oh, ow)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, int):
+        l = r = t = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    pads = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, pads, mode=mode, **kw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    from scipy import ndimage
+    arr = np.asarray(img)
+    order = {"nearest": 0, "bilinear": 1, "bicubic": 3}.get(interpolation, 0)
+    out = ndimage.rotate(arr, -angle, axes=(1, 0), reshape=expand,
+                         order=order, mode="constant", cval=fill)
+    return out.astype(arr.dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img).astype("float32")
+    if arr.ndim == 2:
+        g = arr
+    else:
+        g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    g = g[..., None]
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=-1)
+    return g.astype(np.asarray(img).dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = np.asarray(img)
+    out = arr.astype("float32") * brightness_factor
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img)
+    f = arr.astype("float32")
+    gray_mean = (f[..., 0] * 0.299 + f[..., 1] * 0.587
+                 + f[..., 2] * 0.114).mean() if f.ndim == 3 else f.mean()
+    out = gray_mean + contrast_factor * (f - gray_mean)
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = np.asarray(img)
+    f = arr.astype("float32")
+    g = to_grayscale(arr, 3).astype("float32")
+    out = g + saturation_factor * (f - g)
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV round trip."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    arr = np.asarray(img)
+    f = arr.astype("float32") / (255.0 if arr.dtype == np.uint8 else 1.0)
+    mx = f.max(-1)
+    mn = f.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    h = np.where(mx == r, ((g - b) / diff) % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6
+    h = (h + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    i = np.floor(h * 6).astype(int) % 6
+    frac = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - frac * s)
+    t = v * (1 - (1 - frac) * s)
+    out = np.zeros_like(f)
+    for idx, (rr, gg, bb) in enumerate([(v, t, p), (q, v, p), (p, v, t),
+                                        (p, q, v), (t, p, v), (v, p, q)]):
+        m = i == idx
+        out[..., 0][m] = rr[m]
+        out[..., 1][m] = gg[m]
+        out[..., 2][m] = bb[m]
+    if arr.dtype == np.uint8:
+        return np.clip(out * 255.0, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+# ---- random/color transform classes ----
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[0], arr.shape[1]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                patch = crop(arr, top, left, ch, cw)
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation, self.expand = interpolation, expand
+        self.center, self.fill = center, fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.n)

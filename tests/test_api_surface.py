@@ -5,6 +5,7 @@ the reference's `__all__` lists (paddle, paddle.nn, paddle.nn.functional,
 paddle.vision.ops). VERDICT r4 item 3's done-criterion: this diff reports
 ZERO missing names for every namespace.
 """
+import importlib
 import json
 import os
 
@@ -18,17 +19,10 @@ import paddle_tpu.vision.ops as vops
 REF = json.load(open(os.path.join(os.path.dirname(__file__),
                                   "reference_api_all.json")))
 
-NAMESPACES = {
-    "paddle": paddle,
-    "paddle.nn": nn,
-    "paddle.nn.functional": F,
-    "paddle.vision.ops": vops,
-}
 
-
-@pytest.mark.parametrize("name", sorted(NAMESPACES))
+@pytest.mark.parametrize("name", sorted(REF))
 def test_namespace_complete(name):
-    mod = NAMESPACES[name]
+    mod = importlib.import_module(name.replace("paddle", "paddle_tpu", 1))
     missing = [x for x in REF[name] if not hasattr(mod, x)]
     assert not missing, f"{name} missing {len(missing)}: {missing}"
 

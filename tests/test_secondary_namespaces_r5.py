@@ -1,0 +1,220 @@
+"""Behavioral tests for the r5 secondary-namespace additions: transforms,
+model-zoo variants, folder datasets, Dirichlet, Viterbi, segment/graph ops,
+static legacy builders, EMA, worker info."""
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestTransforms:
+    def test_functional_ops(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(0).rand(12, 16, 3) * 255).astype(np.uint8)
+        assert (T.hflip(T.hflip(img)) == img).all()
+        assert (T.vflip(T.vflip(img)) == img).all()
+        assert T.center_crop(img, 8).shape == (8, 8, 3)
+        assert T.pad(img, (1, 2, 3, 4)).shape == (12 + 2 + 4, 16 + 1 + 3, 3)
+        assert T.rotate(img, 45, expand=True).shape[0] > 12
+        g = T.to_grayscale(img, 3)
+        assert g.shape == (12, 16, 3) and (g[..., 0] == g[..., 1]).all()
+        b = T.adjust_brightness(img, 2.0)
+        assert b.mean() >= img.mean()
+        # hue shift by 0.5 twice returns near the original
+        h2 = T.adjust_hue(T.adjust_hue(img, 0.5), -0.5)
+        assert np.abs(h2.astype(int) - img.astype(int)).max() <= 3
+
+    def test_transform_classes(self):
+        from paddle_tpu.vision import transforms as T
+        np.random.seed(1)
+        img = (np.random.rand(20, 20, 3) * 255).astype(np.uint8)
+        assert T.RandomResizedCrop(8)(img).shape[:2] == (8, 8)
+        assert T.ColorJitter(0.3, 0.3, 0.3, 0.2)(img).shape == img.shape
+        assert T.RandomRotation(30)(img).shape == img.shape
+        assert T.Grayscale()(img).shape == (20, 20, 1)
+        assert T.Pad(2)(img).shape == (24, 24, 3)
+
+
+class TestModelZooVariants:
+    @pytest.mark.parametrize("name,params_m", [
+        ("densenet169", (12, 16)), ("resnext50_32x4d", (22, 26)),
+        ("squeezenet1_0", (0.7, 1.5)), ("shufflenet_v2_x0_5", (0.3, 1.5)),
+    ])
+    def test_variant_geometry(self, name, params_m):
+        from paddle_tpu.vision import models as M
+        net = getattr(M, name)(num_classes=1000)
+        n = sum(int(np.prod(p.shape)) for p in net.parameters()) / 1e6
+        lo, hi = params_m
+        assert lo < n < hi, (name, n)
+
+    def test_inception_runs(self):
+        from paddle_tpu.vision import models as M
+        net = M.inception_v3(num_classes=4)
+        x = paddle.to_tensor(np.random.rand(1, 3, 299, 299).astype("float32"))
+        assert net(x).shape == [1, 4]
+
+
+class TestFolderDatasets:
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                np.save(str(d / f"{i}.npy"),
+                        np.zeros((4, 4, 3), np.uint8))
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 6 and ds.classes == ["cat", "dog"]
+        img, label = ds[0]
+        assert img.shape == (4, 4, 3) and label == 0
+        flat = ImageFolder(str(tmp_path))
+        assert len(flat) == 6 and flat[0][0].shape == (4, 4, 3)
+
+    def test_voc_synthetic(self):
+        from paddle_tpu.vision.datasets import VOC2012
+        ds = VOC2012(mode="train", n_synthetic=8)
+        img, mask = ds[0]
+        assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+        assert len(ds) == 8
+
+
+class TestDirichletViterbi:
+    def test_dirichlet_moments(self):
+        from paddle_tpu.distribution import Dirichlet
+        from scipy import stats
+        c = np.array([2.0, 3.0, 5.0], np.float32)
+        d = Dirichlet(paddle.to_tensor(c))
+        np.testing.assert_allclose(d.mean.numpy(), c / c.sum(), rtol=1e-6)
+        v = paddle.to_tensor(np.array([0.2, 0.3, 0.5], np.float32))
+        np.testing.assert_allclose(float(d.log_prob(v).numpy()),
+                                   stats.dirichlet.logpdf([0.2, 0.3, 0.5], c),
+                                   rtol=1e-4)
+
+    def test_viterbi_brute_force(self):
+        from paddle_tpu.text import viterbi_decode
+
+        def brute(pots, trans, length, use_tag):
+            N = pots.shape[-1]
+            best, bestp = -1e30, None
+            for path in itertools.product(range(N), repeat=length):
+                s = (trans[N - 1, path[0]] if use_tag else 0) + pots[0, path[0]]
+                for t in range(1, length):
+                    s += trans[path[t - 1], path[t]] + pots[t, path[t]]
+                if use_tag:
+                    s += trans[N - 2, path[-1]]
+                if s > best:
+                    best, bestp = s, path
+            return best, bestp
+
+        rng = np.random.RandomState(3)
+        pots = rng.randn(2, 4, 4).astype(np.float32)
+        trans = rng.randn(4, 4).astype(np.float32)
+        lens = np.array([4, 3], np.int32)
+        for use_tag in (True, False):
+            sc, paths = viterbi_decode(paddle.to_tensor(pots),
+                                       paddle.to_tensor(trans),
+                                       paddle.to_tensor(lens), use_tag)
+            for b in range(2):
+                ws, wp = brute(pots[b], trans, int(lens[b]), use_tag)
+                assert abs(float(sc.numpy()[b]) - ws) < 1e-4
+                assert tuple(paths.numpy()[b][:int(lens[b])]) == wp
+
+
+class TestIncubateOps:
+    def test_segment_and_graph(self):
+        import paddle_tpu.incubate as inc
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(inc.segment_sum(x, ids).numpy(),
+                                   [[2, 4], [10, 12]])
+        np.testing.assert_allclose(inc.segment_min(x, ids).numpy(),
+                                   [[0, 1], [4, 5]])
+        out = inc.graph_send_recv(
+            x, paddle.to_tensor(np.array([0, 1])),
+            paddle.to_tensor(np.array([2, 2])), "mean")
+        np.testing.assert_allclose(out.numpy()[2], [1, 2])
+
+    def test_softmax_mask_fuse(self):
+        import paddle_tpu.incubate as inc
+        x = paddle.to_tensor(np.zeros((1, 1, 2, 4), np.float32))
+        m_np = np.full((1, 1, 2, 4), -1e4, np.float32)
+        m_np[0, 0, :, :2] = 0
+        out = inc.softmax_mask_fuse(x, paddle.to_tensor(m_np)).numpy()
+        np.testing.assert_allclose(out[0, 0, 0], [0.5, 0.5, 0, 0], atol=1e-4)
+
+
+class TestStaticLegacy:
+    def test_builders_share_by_name(self):
+        from paddle_tpu.static import nn as snn
+        x = paddle.to_tensor(np.random.rand(2, 3, 8, 8).astype("float32"))
+
+        class A:
+            name = "shared_conv"
+
+        o1 = snn.conv2d(x, 4, 3, param_attr=A())
+        o2 = snn.conv2d(x, 4, 3, param_attr=A())
+        np.testing.assert_allclose(o1.numpy(), o2.numpy())   # shared params
+        o3 = snn.conv2d(x, 4, 3)                              # fresh params
+        assert not np.allclose(o1.numpy(), o3.numpy())
+
+    def test_append_backward_and_gradients(self):
+        import paddle_tpu.static as st
+        w = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+        loss = (w * w).sum()
+        pairs = st.append_backward(loss, parameter_list=[w])
+        assert len(pairs) == 1
+        np.testing.assert_allclose(np.asarray(pairs[0][1]), 2.0)
+
+    def test_ema_apply_restore(self):
+        import paddle_tpu.static as st
+        lin = nn.Linear(2, 2)
+        ema = st.ExponentialMovingAverage(0.9)
+        w0 = lin.weight.numpy().copy()
+        ema.update(list(lin.parameters()))
+        lin.weight._value = lin.weight._value + 1.0
+        ema.update()
+        ema.apply()
+        assert not np.allclose(lin.weight.numpy(), w0 + 1.0)
+        ema.restore()
+        np.testing.assert_allclose(lin.weight.numpy(), w0 + 1.0)
+
+    def test_crf_decoding_shapes(self):
+        from paddle_tpu.static import nn as snn
+        pots = paddle.to_tensor(np.random.rand(2, 5, 4).astype("float32"))
+        path = snn.crf_decoding(pots)
+        assert path.shape == [2, 5] and int(path.numpy().max()) < 4
+
+    def test_auc_exact(self):
+        import paddle_tpu.static as st
+        score = paddle.to_tensor(np.array(
+            [[0.9, 0.1], [0.4, 0.6], [0.3, 0.7], [0.8, 0.2]], np.float32))
+        lab = paddle.to_tensor(np.array([0, 1, 1, 0]))
+        a, _, _ = st.auc(score, lab)
+        assert abs(float(a.numpy()) - 1.0) < 1e-6   # perfectly separable
+
+
+class TestStaticWrapTape:
+    def test_builders_preserve_upstream_gradients(self):
+        """_wrap must pass Tensors through: rebuilding a pytree-registered
+        Tensor severs the tape, silently zeroing upstream grads (r5 bug
+        found driving conv2d -> fc end to end)."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.static import nn as snn
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(4, 1, 8, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 3, (4,)).astype("int64"))
+
+        class A:
+            name = "wraptape_conv"
+
+        h = snn.conv2d(x, 4, 3, act="relu", param_attr=A())
+        loss = F.cross_entropy(snn.fc(h.reshape([4, -1]), 3), y)
+        loss.backward()
+        from paddle_tpu.static.nn import _LAYER_SCOPE
+        conv = _LAYER_SCOPE["conv2d:wraptape_conv"]
+        g = conv.weight.grad
+        assert g is not None and np.abs(np.asarray(g)).sum() > 0
