@@ -454,7 +454,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import jax.numpy as jnp
-from jax import shard_map
+from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
@@ -468,7 +468,7 @@ def body(x):
     return dist.all_reduce(paddle.to_tensor(x))._value
 
 f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
-                      check_vma=False))
+                      check_rep=False))
 x = jnp.ones((n, nbytes // 4), jnp.float32)
 y = f(x)
 float(np.asarray(y[0, 0]))  # warmup + path check

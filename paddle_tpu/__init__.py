@@ -51,6 +51,7 @@ from . import text  # noqa: F401
 from . import models  # noqa: F401
 from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401  (stats registry + trace spans plane)
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
 from . import distributed  # noqa: F401

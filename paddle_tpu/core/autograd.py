@@ -190,6 +190,9 @@ def _cached_jit(fn, kind, build=None):
         return None, None
     jf = _JIT_CACHE.get(key)
     if jf is None:
+        from .. import monitor as _monitor
+        if _monitor._ENABLED:
+            _monitor.count("autograd.jit_cache_miss")
         if len(_JIT_CACHE) >= _JIT_CACHE_CAP:
             _JIT_CACHE.clear()
         if build is not None:
@@ -471,6 +474,19 @@ def _fused_backward_try(root, grad, ordered):
 
 def backward(root, grad=None, retain_graph: bool = False):
     """Run the tape backward from `root` (paddle.Tensor.backward parity)."""
+    from .. import monitor as _monitor
+    if not _monitor._ENABLED:
+        return _backward_impl(root, grad, retain_graph)
+    import time as _time
+    _t0 = _time.time()
+    try:
+        return _backward_impl(root, grad, retain_graph)
+    finally:
+        _monitor.count("autograd.backward_count")
+        _monitor.observe("autograd.backward_dur", _time.time() - _t0)
+
+
+def _backward_impl(root, grad=None, retain_graph: bool = False):
     if root._node is None:
         if not root.stop_gradient:
             g = jnp.ones_like(root._value) if grad is None else grad
@@ -488,9 +504,14 @@ def backward(root, grad=None, retain_graph: bool = False):
         grad = grad._value
 
     ordered = _collect([root._node])
+    from .. import monitor as _monitor
+    if _monitor._ENABLED:
+        _monitor.count("autograd.nodes_walked", len(ordered))
 
     fused = _fused_backward_try(root, grad, ordered)
     if fused is not None:
+        if _monitor._ENABLED:
+            _monitor.count("autograd.fused_backward")
         for t, g in fused:
             t.grad = g if t.grad is None else t.grad + g
         if not retain_graph:

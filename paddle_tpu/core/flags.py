@@ -7,9 +7,22 @@ Flags may also be seeded from environment variables named FLAGS_<name>.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict
+from typing import Any, Callable, Dict, List
 
 _REGISTRY: Dict[str, Any] = {}
+
+# flag-name -> callbacks fired on set_flags (lets hot paths cache a flag in
+# a module attribute — e.g. paddle_tpu.monitor._ENABLED — instead of paying
+# a dict lookup per op; the reference's equivalent is the exported-flag
+# pointer that C++ call sites read directly)
+_WATCHERS: Dict[str, List[Callable[[Any], None]]] = {}
+
+
+def watch_flag(name: str, fn: Callable[[Any], None]) -> None:
+    """Register fn(new_value) to run whenever `name` is set via set_flags."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown flag {name}")
+    _WATCHERS.setdefault(name, []).append(fn)
 
 
 def define_flag(name: str, default: Any, doc: str = "") -> None:
@@ -33,6 +46,8 @@ def set_flags(flags: Dict[str, Any]) -> None:
         if key not in _REGISTRY:
             raise KeyError(f"unknown flag {k}")
         _REGISTRY[key] = v
+        for fn in _WATCHERS.get(key, ()):
+            fn(v)
 
 
 def get_flags(flags) -> Dict[str, Any]:
@@ -61,3 +76,7 @@ define_flag("allocator_strategy", "auto_growth", "host allocator strategy name")
 define_flag("tpu_matmul_precision", "default", "default|high|highest - lax precision for matmul/conv")
 define_flag("tpu_eager_jit", True, "jit-cache eager primitive ops instead of op-by-op dispatch")
 define_flag("enable_unused_var_check", False, "unused-var detection parity flag")
+define_flag("monitor", False,
+            "enable the paddle_tpu.monitor stats registry + trace spans "
+            "(platform/monitor.h STAT registry role); off = the dispatch "
+            "fast path pays one module-attribute check and nothing else")

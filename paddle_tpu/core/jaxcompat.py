@@ -1,0 +1,18 @@
+"""Shims over jax API drift, pinned to the container's jax.
+
+The codebase targets the current jax surface; where the installed wheel
+predates a rename, the old spelling is bridged here so call sites stay
+modern. Covered:
+  - `lax.axis_size(name)` (newer jax) vs `lax.psum(1, name)` (0.4.x) —
+    psum of a unit literal is constant-folded to the axis size (an int)
+    and raises NameError when the axis is unbound, matching axis_size.
+"""
+from __future__ import annotations
+
+from jax import lax
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+    def axis_size(axis_name):
+        return lax.psum(1, axis_name)

@@ -23,6 +23,14 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+try:
+    from .. import monitor as _monitor
+except ImportError:
+    # spec-loaded standalone (tests/fleet_exec_2proc_runner.py keeps this
+    # module import-light, outside the package): stats plane disabled
+    class _monitor:  # noqa: N801
+        _ENABLED = False
+
 
 class Message:
     __slots__ = ("src", "dst", "kind", "payload", "micro")
@@ -43,7 +51,12 @@ class MessageBus:
         return q
 
     def send(self, msg: Message):
-        self._inboxes[msg.dst].put(msg)
+        q = self._inboxes[msg.dst]
+        q.put(msg)
+        if _monitor._ENABLED:
+            _monitor.count("fleet.messages")
+            _monitor.count(f"fleet.msg.{msg.kind}")
+            _monitor.gauge_set(f"fleet.inbox_depth.{msg.dst}", q.qsize())
 
 
 class Interceptor:
@@ -114,6 +127,9 @@ class ComputeInterceptor(Interceptor):
             self._drain()
 
     def _drain(self):
+        if _monitor._ENABLED:
+            _monitor.gauge_set(f"fleet.pending.{self.iid}",
+                               len(self._pending))
         while self._pending and (self._credits > 0 or self.downstream is None):
             msg = self._pending.pop(0)
             out = self.fn(msg.payload)
@@ -364,6 +380,11 @@ class DistMessageBus(MessageBus):
 
     def send(self, msg: Message):
         owner = self.owner_of.get(msg.dst, self.rank)
+        if _monitor._ENABLED:
+            _monitor.count("fleet.messages")
+            _monitor.count(f"fleet.msg.{msg.kind}")
+            if owner != self.rank:
+                _monitor.count("fleet.remote_messages")
         if owner == self.rank:
             self._inboxes[msg.dst].put(msg)
             return

@@ -12,12 +12,14 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Callable, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import monitor as _monitor
 from ..core.tensor import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
@@ -78,8 +80,15 @@ class _PrefetchIter:
                 self.queue.put((None, None))
                 return
             try:
-                samples = [ds[i] for i in indices]
-                batch = collate(samples)
+                if _monitor._ENABLED:
+                    _tb = _time.time()
+                    samples = [ds[i] for i in indices]
+                    batch = collate(samples)
+                    _monitor.observe("io.dataloader.worker_batch",
+                                     _time.time() - _tb)
+                else:
+                    samples = [ds[i] for i in indices]
+                    batch = collate(samples)
                 self.queue.put((seq, batch))
             except Exception as e:  # propagate to consumer
                 self.queue.put((seq, e))
@@ -97,7 +106,15 @@ class _PrefetchIter:
             # all workers done → every produced batch is already queued/pending
             if self._done_workers >= self._n_workers and self.queue.empty():
                 raise StopIteration
-            seq, batch = self.queue.get()
+            if _monitor._ENABLED:
+                # how long the consumer stalls on the workers: the signal
+                # that the input pipeline (not the device) is the bottleneck
+                _tw = _time.time()
+                seq, batch = self.queue.get()
+                _monitor.observe("io.dataloader.queue_wait",
+                                 _time.time() - _tw)
+            else:
+                seq, batch = self.queue.get()
             if seq is None:
                 self._done_workers += 1
                 continue

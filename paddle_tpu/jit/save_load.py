@@ -15,6 +15,7 @@ import os
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import export as _jax_export
 
 from ..core.tensor import Tensor
 from .functional import split_state
@@ -126,7 +127,7 @@ def save(layer, path, input_spec=None, **configs):
     arg_specs = (
         [jax.ShapeDtypeStruct(tuple(1 if d == -1 else d for d in s.shape), s.dtype)
          for s in specs])
-    exported = jax.export.export(jax.jit(pure))(
+    exported = _jax_export.export(jax.jit(pure))(
         [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in parrs],
         [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in barrs],
         *arg_specs)
@@ -196,7 +197,7 @@ class TranslatedLayer:
 
 def load(path, **configs):
     with open(path + ".pdmodel", "rb") as f:
-        exported = jax.export.deserialize(f.read())
+        exported = _jax_export.deserialize(f.read())
     with open(path + ".pdmodel.json") as f:
         meta = json.load(f)
     from ..framework.version import (GLOBAL_OP_VERSION_REGISTRY,

@@ -18,6 +18,7 @@ import functools
 
 import jax
 
+from .. import monitor as _monitor
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from ..ops._dispatch import run_op
@@ -81,6 +82,8 @@ class StaticFunction:
                tuple(sorted(static_kwargs.items())))
         jitted = self._jit_cache.get(key)
         if jitted is None:
+            if _monitor._ENABLED:
+                _monitor.count("jit.to_static.cache_miss")
             jitted = jax.jit(
                 self._get_pure(training, pnames, bnames, static_kwargs))
             self._jit_cache[key] = jitted
@@ -98,6 +101,8 @@ class StaticFunction:
                tuple(sorted(static_kwargs.items())), n_p)
         f = self._jit_cache.get(key)
         if f is None:
+            if _monitor._ENABLED:
+                _monitor.count("jit.to_static.cache_miss")
             pure = self._get_pure(training, pnames, bnames, static_kwargs)
 
             def fwd_vjp(diff, barrs, rkey):
@@ -146,6 +151,13 @@ class StaticFunction:
         # cost on the hot path).
         sig = tuple((t._value.shape, str(t._value.dtype)) for t in diff_inputs)
         if getattr(self, "_prog_sig", None) != sig:
+            if _monitor._ENABLED:
+                # a signature change on a to_static capture = retrace: the
+                # whole program recompiles for the new shapes/dtypes
+                _monitor.record_retrace(
+                    "to_static",
+                    [f"{s}:{d}" for s, d in sig],
+                    first=getattr(self, "_prog_sig", None) is None)
             jitted = self._get_jitted(training, pnames, bnames, static_kwargs)
 
             def fn(*arrs, _jit=jitted, _b=list(barrs), _k=key, _np=n_p):
@@ -190,6 +202,10 @@ class StaticFunction:
         if _dsp._PROFILE_HOOK is not None:
             import time as _time
             _dsp._PROFILE_HOOK("static_program", _t0, _time.time())
+        if _monitor._ENABLED:
+            import time as _time
+            _monitor.count("jit.to_static.calls")
+            _monitor.observe("jit.to_static.dur", _time.time() - _t0)
         if record:
             _ag.record_node(
                 _ag._JitVJP(raw_vjp,

@@ -14,9 +14,12 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import time as _time
+
 import jax
 import jax.numpy as jnp
 
+from .. import monitor as _monitor
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from .functional import functional_call, split_state
@@ -58,9 +61,14 @@ class TrainStep:
         self._bnames = None
         # step(x..., y...): first n go to model.forward, the rest to loss_fn
         self._n_model_inputs = n_model_inputs
+        # batch signatures already traced (monitor retrace accounting): a
+        # novel (shape, dtype) signature means jax.jit recompiles the step
+        self._seen_sigs = set()
 
     def _build(self):
         from ..core import flags as _flags
+        if _monitor._ENABLED:
+            _monitor.count("jit.train_step.builds")
         # FLAGS_check_nan_inf for the COMPILED hot loop (operator.cc:1171
         # role): the per-op eager scan can't see inside a jitted step, so
         # the finite-check is traced INTO the executable — one fused
@@ -154,6 +162,15 @@ class TrainStep:
         if lr_val != self._lr_val:
             self._lr_val = lr_val
             self._lr_arr = jnp.asarray(lr_val, jnp.float32)
+        if _monitor._ENABLED:
+            # retrace accounting: the jitted step recompiles for every novel
+            # batch signature — the dominant TPU perf hazard. The signature
+            # that caused each retrace is logged for diagnosis.
+            sig = _monitor.arg_signature(arrs)
+            if sig not in self._seen_sigs:
+                _monitor.record_retrace("train_step", sig,
+                                        first=not self._seen_sigs)
+                self._seen_sigs.add(sig)
         return params, buffers, arrs[:n_mi], arrs[n_mi:]
 
     def __call__(self, *batch):
@@ -161,6 +178,9 @@ class TrainStep:
         model output(s) — close labels into loss_fn or pass them as model inputs.
         """
         params, buffers, inputs, labels = self._prepare(batch)
+        _mon = _monitor._ENABLED
+        if _mon:
+            _t0 = _time.time()
         new_params, self._slots, loss, self._key, self._t_arr, bad = \
             self._jitted(params, self._slots, buffers, self._key,
                          self._lr_arr, self._t_arr, inputs, labels)
@@ -171,6 +191,9 @@ class TrainStep:
         for tns, v in zip(self._ptensors, new_params):
             tns._value = v
         self.optimizer._step_count += 1
+        if _mon:
+            _monitor.count("jit.train_step.steps")
+            _monitor.observe("jit.train_step.dur", _time.time() - _t0)
         raise_nonfinite(bad, self._pnames, "jitted train step")
         return Tensor(loss)
 
@@ -190,5 +213,7 @@ class TrainStep:
         for tns, v in zip(self._ptensors, new_params):
             tns._value = v
         self.optimizer._step_count += n_steps
+        if _monitor._ENABLED:
+            _monitor.count("jit.train_step.steps", n_steps)
         raise_nonfinite(bads, self._pnames, "jitted train step")
         return Tensor(losses)

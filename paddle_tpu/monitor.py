@@ -1,0 +1,479 @@
+"""paddle_tpu.monitor — the framework-wide telemetry plane.
+
+Reference parity: `paddle/fluid/platform/monitor.h` (the STAT_INT registry,
+STAT_ADD/STAT_RESET macros over `platform::StatRegistry`) plus the span side
+of `platform/profiler/event_tracing.h` (RecordEvent ranges). One process-wide
+registry of counters, gauges and histograms that every layer reports into:
+
+  - op dispatch (`ops/_dispatch.run_op`): per-op counts + duration histograms
+  - autograd (`core/autograd.backward`): walk timing, nodes walked, fused hits
+  - JIT (`jit/train_step.py`, `jit/to_static.py`): trace/RETRACE counts with
+    the argument signatures that caused each retrace — the single most
+    important TPU perf signal (a retrace = a full XLA recompile)
+  - collectives (`parallel/collective.py`): per-collective counts + bytes
+  - fleet executor (`distributed/fleet_executor.py`): message counts,
+    inbox-depth gauges
+  - data loading (`io/dataloader.py`): queue-wait + batch-build histograms
+  - optimizer (`optimizer/optimizer.py`): step counts + durations
+
+Everything is gated by `FLAGS_monitor` (off by default): instrumented call
+sites check the module attribute `_ENABLED` — one attribute load on the
+disabled path, no hook installation, no allocation. `core.flags.watch_flag`
+keeps `_ENABLED` in sync with `paddle.set_flags({"FLAGS_monitor": ...})`.
+
+Outputs: `snapshot()` (nested dict), `report()` (rendered table, the
+`Profiler.summary()` sibling), `export_json(path)`, `prometheus_text()` /
+`export_prometheus(path)`, and `span(name)` trace ranges that ALSO feed any
+active `paddle_tpu.profiler.Profiler`'s host-event stream so one chrome
+trace carries both planes (`Profiler.export` embeds `snapshot()` as trace
+metadata).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .core import flags as _flags
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "StatRegistry",
+    "enabled", "enable", "disable",
+    "counter", "gauge", "histogram",
+    "count", "gauge_set", "observe", "log_event", "record_op",
+    "record_collective", "record_retrace",
+    "span", "snapshot", "report", "reset",
+    "export_json", "prometheus_text", "export_prometheus",
+]
+
+# Hot-path gate: instrumented sites read this module attribute directly.
+_ENABLED: bool = bool(_flags.flag("monitor"))
+
+
+def _on_flag(value) -> None:
+    global _ENABLED
+    _ENABLED = bool(value)
+
+
+_flags.watch_flag("monitor", _on_flag)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable() -> None:
+    _flags.set_flags({"monitor": True})
+
+
+def disable() -> None:
+    _flags.set_flags({"monitor": False})
+
+
+# ---- metric primitives (monitor.h StatValue role) -------------------------
+
+class Counter:
+    """Monotonic int/float accumulator (STAT_ADD)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def add(self, delta=1) -> None:
+        with self._lock:
+            self.value += delta
+
+    def get(self):
+        return self.value
+
+    def reset(self) -> None:
+        with self._lock:
+            self.value = 0
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, cache size)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def set(self, value) -> None:
+        with self._lock:
+            self.value = value
+
+    def add(self, delta=1) -> None:
+        with self._lock:
+            self.value += delta
+
+    def get(self):
+        return self.value
+
+    def reset(self) -> None:
+        with self._lock:
+            self.value = 0
+
+
+# Default buckets suit durations in seconds: 1us .. 10s, exponential.
+_DEFAULT_BUCKETS: Tuple[float, ...] = (
+    1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket counts
+    observations <= its upper bound; +Inf is implicit via `count`)."""
+
+    __slots__ = ("name", "buckets", "bucket_counts", "count", "sum",
+                 "min", "max", "_lock")
+
+    def __init__(self, name: str, buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.buckets = tuple(sorted(buckets or _DEFAULT_BUCKETS))
+        self.bucket_counts = [0] * len(self.buckets)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    self.bucket_counts[i] += 1
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            cnt = self.count
+            return {
+                "count": cnt,
+                "sum": self.sum,
+                "avg": (self.sum / cnt) if cnt else 0.0,
+                "min": self.min if cnt else 0.0,
+                "max": self.max,
+                "buckets": dict(zip(self.buckets, self.bucket_counts)),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.bucket_counts = [0] * len(self.buckets)
+            self.count = 0
+            self.sum = 0.0
+            self.min = float("inf")
+            self.max = 0.0
+
+
+# ---- registry (monitor.h StatRegistry role) --------------------------------
+
+_EVENT_RING_CAP = 256
+
+
+class StatRegistry:
+    """Thread-safe get-or-create store of named metrics + an event ring
+    (bounded structured log — retrace causes, anomalies)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._events: List[Dict[str, Any]] = []
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str,
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name,
+                                                Histogram(name, buckets))
+        return h
+
+    def log_event(self, name: str, **payload) -> None:
+        ev = {"ts": time.time(), "event": name}
+        ev.update(payload)
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > _EVENT_RING_CAP:
+                del self._events[: len(self._events) - _EVENT_RING_CAP]
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = {n: c.get() for n, c in self._counters.items()}
+            gauges = {n: g.get() for n, g in self._gauges.items()}
+            hists = list(self._histograms.items())
+            events = list(self._events)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {n: h.stats() for n, h in hists},
+            "events": events,
+        }
+
+    def reset(self) -> None:
+        """Drop every metric (STAT_RESET role): a fresh snapshot after
+        reset carries no stale zero-valued names. Holders of metric objects
+        obtained before the reset keep functioning but are detached."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._events.clear()
+
+
+_REGISTRY = StatRegistry()
+
+
+def registry() -> StatRegistry:
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str, buckets=None) -> Histogram:
+    return _REGISTRY.histogram(name, buckets)
+
+
+# ---- instrumentation entry points (the STAT_ADD call sites use these) ------
+
+def count(name: str, delta=1) -> None:
+    _REGISTRY.counter(name).add(delta)
+
+
+def gauge_set(name: str, value) -> None:
+    _REGISTRY.gauge(name).set(value)
+
+
+def observe(name: str, value: float) -> None:
+    _REGISTRY.histogram(name).observe(value)
+
+
+def log_event(name: str, **payload) -> None:
+    _REGISTRY.log_event(name, **payload)
+
+
+def record_op(name: str, dur: float) -> None:
+    """One eager op dispatched through `ops._dispatch.run_op`."""
+    _REGISTRY.counter("dispatch.op_count").add(1)
+    _REGISTRY.counter(f"dispatch.op.{name}").add(1)
+    _REGISTRY.histogram(f"dispatch.dur.{name}").observe(dur)
+
+
+def record_collective(name: str, nbytes: int) -> None:
+    """One collective API call moving (logically) `nbytes`."""
+    _REGISTRY.counter("collective.count").add(1)
+    _REGISTRY.counter("collective.bytes").add(nbytes)
+    _REGISTRY.counter(f"collective.{name}.count").add(1)
+    _REGISTRY.counter(f"collective.{name}.bytes").add(nbytes)
+
+
+def record_retrace(kind: str, signature, first: bool) -> None:
+    """A JIT cache event. first=True is the initial trace (expected, one
+    compile); first=False is a RETRACE — a novel argument shape/dtype
+    signature forced a full recompile. The signature is logged so the
+    offending input can be padded/bucketed away."""
+    if first:
+        _REGISTRY.counter(f"jit.{kind}.traces").add(1)
+    else:
+        _REGISTRY.counter(f"jit.{kind}.retraces").add(1)
+        _REGISTRY.counter("jit.retraces").add(1)
+        _REGISTRY.log_event("jit.retrace", kind=kind,
+                            signature=list(signature))
+
+
+def arg_signature(arrays) -> Tuple[str, ...]:
+    """Hashable (shape, dtype) signature of a flat array/tensor list."""
+    sig = []
+    for a in arrays:
+        v = getattr(a, "_value", a)
+        sig.append(f"{tuple(getattr(v, 'shape', ()))}:"
+                   f"{getattr(v, 'dtype', type(v).__name__)}")
+    return tuple(sig)
+
+
+# ---- trace spans (event_tracing.h RecordEvent role) ------------------------
+
+class _NullSpan:
+    """Shared no-op context: the disabled span() path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "kind", "_t0")
+
+    def __init__(self, name: str, kind: str):
+        self.name = name
+        self.kind = kind
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.time()
+        _REGISTRY.counter(f"span.{self.name}.count").add(1)
+        _REGISTRY.histogram(f"span.{self.name}.dur").observe(t1 - self._t0)
+        # feed the profiler plane: every active Profiler records the range
+        # on its host-event stream (and thereby into the chrome trace)
+        from . import profiler as _profiler
+        for p in tuple(_profiler._ACTIVE_STACK):
+            p._record_op(self.name, self._t0, t1, self.kind)
+        return False
+
+
+def span(name: str, kind: str = "span"):
+    """Instrumentation range: `with monitor.span("stage"): ...`. Duration
+    lands in `span.<name>.dur`; when a Profiler is active the range also
+    appears on its host timeline. Disabled -> shared no-op context."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _Span(name, kind)
+
+
+# ---- snapshots / reports / exporters ---------------------------------------
+
+def snapshot() -> Dict[str, Any]:
+    """Nested dict of every metric: {counters, gauges, histograms, events}."""
+    return _REGISTRY.snapshot()
+
+
+def events() -> List[Dict[str, Any]]:
+    return _REGISTRY.events()
+
+
+def reset() -> None:
+    _REGISTRY.reset()
+
+
+def report(time_unit: str = "ms") -> str:
+    """Rendered stats table (Profiler.summary() sibling for the stats plane)."""
+    scale = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
+    snap = _REGISTRY.snapshot()
+    width = 78
+    lines = ["-" * width, f"{'paddle_tpu.monitor':<58}{'(FLAGS_monitor=' + ('1' if _ENABLED else '0') + ')':>20}",
+             "-" * width]
+    if snap["counters"]:
+        lines.append(f"{'Counter':<52}{'Value':>24}")
+        for name in sorted(snap["counters"]):
+            lines.append(f"{name[:51]:<52}{snap['counters'][name]:>24}")
+        lines.append("-" * width)
+    if snap["gauges"]:
+        lines.append(f"{'Gauge':<52}{'Value':>24}")
+        for name in sorted(snap["gauges"]):
+            lines.append(f"{name[:51]:<52}{snap['gauges'][name]:>24}")
+        lines.append("-" * width)
+    if snap["histograms"]:
+        lines.append(f"{'Histogram':<38}{'Count':>8}"
+                     f"{'Avg(' + time_unit + ')':>11}"
+                     f"{'Min':>10}{'Max':>11}")
+        for name in sorted(snap["histograms"]):
+            st = snap["histograms"][name]
+            lines.append(
+                f"{name[:37]:<38}{st['count']:>8}{st['avg'] * scale:>11.3f}"
+                f"{st['min'] * scale:>10.3f}{st['max'] * scale:>11.3f}")
+        lines.append("-" * width)
+    if snap["events"]:
+        lines.append(f"events: {len(snap['events'])} "
+                     f"(last: {snap['events'][-1].get('event')})")
+        lines.append("-" * width)
+    if len(lines) == 3:
+        lines.append("(no stats recorded)")
+        lines.append("-" * width)
+    return "\n".join(lines)
+
+
+def export_json(path: str) -> str:
+    """Write snapshot() as a JSON artifact."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(snapshot(), f, indent=1, default=str)
+    return path
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    n = "".join(out)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return "paddle_tpu_" + n
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition format (text/plain; version 0.0.4)."""
+    snap = _REGISTRY.snapshot()
+    lines: List[str] = []
+    for name in sorted(snap["counters"]):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {snap['counters'][name]}")
+    for name in sorted(snap["gauges"]):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {snap['gauges'][name]}")
+    for name in sorted(snap["histograms"]):
+        st = snap["histograms"][name]
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        for ub, c in st["buckets"].items():
+            lines.append(f'{pn}_bucket{{le="{ub}"}} {c}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {st["count"]}')
+        lines.append(f"{pn}_sum {st['sum']}")
+        lines.append(f"{pn}_count {st['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def export_prometheus(path: str) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        f.write(prometheus_text())
+    return path

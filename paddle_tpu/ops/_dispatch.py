@@ -9,6 +9,7 @@ Autograd recording (tape + VJP) happens here, mirroring Tracer::TraceOp.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Callable, Sequence
 
 import jax
@@ -16,6 +17,7 @@ import jax.numpy as jnp
 
 _TracerTypes = (jax.core.Tracer,)
 
+from .. import monitor as _monitor
 from ..core import autograd
 from ..core import flags as _flags
 from ..core.tensor import Tensor
@@ -57,15 +59,22 @@ def run_op(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):
 
     fn must be a pure function of the positional arrays only (close over any
     static attrs). Returns Tensor or tuple[Tensor].
+
+    Instrumentation: with neither a profiler hook nor FLAGS_monitor active,
+    the fast path below is two attribute checks and a tail call — no timer,
+    no try frame, no hook installation.
     """
-    if _PROFILE_HOOK is not None:
-        import time as _time
-        _t0 = _time.time()
-        try:
-            return _run_op_impl(fn, tensors, name)
-        finally:
-            _PROFILE_HOOK(name, _t0, _time.time())
-    return _run_op_impl(fn, tensors, name)
+    if _PROFILE_HOOK is None and not _monitor._ENABLED:
+        return _run_op_impl(fn, tensors, name)
+    _t0 = _time.time()
+    try:
+        return _run_op_impl(fn, tensors, name)
+    finally:
+        _t1 = _time.time()
+        if _PROFILE_HOOK is not None:
+            _PROFILE_HOOK(name, _t0, _t1)
+        if _monitor._ENABLED:
+            _monitor.record_op(name, _t1 - _t0)
 
 
 def _run_op_impl(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):

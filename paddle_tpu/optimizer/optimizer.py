@@ -83,6 +83,18 @@ class Optimizer:
 
     # ---- step ----
     def step(self):
+        from .. import monitor as _monitor
+        if not _monitor._ENABLED:
+            return self._step_impl()
+        import time as _time
+        _t0 = _time.time()
+        try:
+            return self._step_impl()
+        finally:
+            _monitor.count("optimizer.steps")
+            _monitor.observe("optimizer.step_dur", _time.time() - _t0)
+
+    def _step_impl(self):
         from ..core.selected_rows import SelectedRows
         params = [p for p in (self._parameter_list or [])
                   if not p.stop_gradient and p.grad is not None]

@@ -25,8 +25,24 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import monitor as _monitor
+from ..core.jaxcompat import axis_size as _axis_size
 from ..core.tensor import Tensor
 from ..ops._dispatch import ensure_tensor, run_op
+
+
+def _record(name: str, t) -> None:
+    """Monitor plane: count the collective and its logical payload bytes.
+    Works on tracers too (shape/dtype are static), so SPMD-region
+    collectives are accounted once per trace."""
+    if not _monitor._ENABLED:
+        return
+    v = getattr(t, "_value", t)
+    try:
+        nbytes = int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+    except Exception:
+        nbytes = 0
+    _monitor.record_collective(name, nbytes)
 
 
 class ReduceOp:
@@ -83,7 +99,7 @@ def _in_spmd(axis_name) -> bool:
     if axis_name is None:
         return False
     try:
-        lax.axis_size(axis_name)
+        _axis_size(axis_name)
         return True
     except Exception:
         return False
@@ -92,6 +108,7 @@ def _in_spmd(axis_name) -> bool:
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place (paddle semantics): tensor payload replaced with the result."""
     t = ensure_tensor(tensor)
+    _record("c_allreduce", t)
     ax = _axis(group) or "dp"
     if _in_spmd(ax):
         red = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax, ReduceOp.MIN: lax.pmin}
@@ -112,10 +129,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     t = ensure_tensor(tensor)
+    _record("c_allgather", t)
     ax = _axis(group) or "dp"
     if _in_spmd(ax):
         out = run_op(lambda a: lax.all_gather(a, ax, tiled=False), [t], "c_allgather")
-        n = lax.axis_size(ax)
+        n = _axis_size(ax)
         parts = [Tensor(out._value[i]) for i in range(n)]
         if tensor_list is not None:
             tensor_list.extend(parts)
@@ -138,6 +156,7 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
         from ..ops.manipulation import concat
         src = concat(list(src), axis=0)
     t = ensure_tensor(src)
+    _record("c_reducescatter", t)
     if _in_spmd(ax):
         out = run_op(lambda a: lax.psum_scatter(a, ax, tiled=True), [t], "c_reducescatter")
         if tensor is not None:
@@ -148,6 +167,7 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     t = ensure_tensor(tensor)
+    _record("c_broadcast", t)
     ax = _axis(group) or "dp"
     if _in_spmd(ax):
         idx = lax.axis_index(ax)
@@ -165,6 +185,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group) or "dp"
+    _record("c_scatter", ensure_tensor(tensor))
     if tensor_list is not None and _in_spmd(ax):
         from ..ops.manipulation import stack
         stacked = stack(list(tensor_list), axis=0)
@@ -182,11 +203,12 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         src = stack(list(in_tensor_list), axis=0)
     else:
         src = ensure_tensor(in_tensor_list)
+    _record("alltoall", src)
     if _in_spmd(ax):
         out = run_op(lambda a: lax.all_to_all(a, ax, split_axis=0, concat_axis=0,
                                               tiled=False), [src], "alltoall")
         if out_tensor_list is not None:
-            n = lax.axis_size(ax)
+            n = _axis_size(ax)
             out_tensor_list.extend(Tensor(out._value[i]) for i in range(n))
         return out
     if out_tensor_list is not None and isinstance(in_tensor_list, (list, tuple)):
@@ -197,6 +219,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     t = ensure_tensor(in_tensor)
+    _record("alltoall_single", t)
     ax = _axis(group) or "mp"
     if _in_spmd(ax):
         out = run_op(lambda a: lax.all_to_all(a, ax, split_axis=0, concat_axis=0,
@@ -210,9 +233,10 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 def send(tensor, dst=0, group=None, sync_op=True):
     """SPMD p2p: expressed as ppermute to the destination stage (pipeline use)."""
     t = ensure_tensor(tensor)
+    _record("send_v2", t)
     ax = _axis(group) or "pp"
     if _in_spmd(ax):
-        n = lax.axis_size(ax)
+        n = _axis_size(ax)
         perm = [(i, (i + 1) % n) for i in range(n)]
         return run_op(lambda a: lax.ppermute(a, ax, perm), [t], "send_v2")
     return t
@@ -229,8 +253,9 @@ irecv = recv
 def p2p_shift(x, group="pp", shift=1):
     """ppermute neighbour shift — the TPU-native partial_send/recv."""
     t = ensure_tensor(x)
+    _record("p2p_shift", t)
     ax = _axis(group) or "pp"
-    n = lax.axis_size(ax)
+    n = _axis_size(ax)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return run_op(lambda a: lax.ppermute(a, ax, perm), [t], "p2p_shift")
 
@@ -262,6 +287,7 @@ def _mp_allreduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
 
 def _c_concat(tensor, group=None):
     t = ensure_tensor(tensor)
+    _record("c_concat", t)
     ax = _axis(group) or "mp"
     if _in_spmd(ax):
         return run_op(lambda a: lax.all_gather(a, ax, axis=a.ndim - 1, tiled=True),
@@ -271,9 +297,10 @@ def _c_concat(tensor, group=None):
 
 def _c_split(tensor, group=None):
     t = ensure_tensor(tensor)
+    _record("c_split", t)
     ax = _axis(group) or "mp"
     if _in_spmd(ax):
-        n = lax.axis_size(ax)
+        n = _axis_size(ax)
         idx = lax.axis_index(ax)
 
         def f(a):

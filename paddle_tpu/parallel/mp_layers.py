@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core.jaxcompat import axis_size as _axis_size
 from ..core.tensor import Tensor
 from ..nn import functional as F
 from ..nn import initializer as I
@@ -85,7 +86,7 @@ class RowParallelLinear(Layer):
         x = ensure_tensor(x)
         if _in_spmd("mp"):  # manual regime: partial matmul + psum
             if not self.input_is_parallel:
-                n = lax.axis_size("mp")
+                n = _axis_size("mp")
                 idx = lax.axis_index("mp")
 
                 def split_f(a):
@@ -120,7 +121,7 @@ class VocabParallelEmbedding(Layer):
     def forward(self, x):
         x = ensure_tensor(x)
         if _in_spmd("mp"):  # manual regime: mask out-of-shard ids, psum partial lookups
-            n = lax.axis_size("mp")
+            n = _axis_size("mp")
             idx = lax.axis_index("mp")
             per = self.num_embeddings // n
 
@@ -153,7 +154,7 @@ class ParallelCrossEntropy(Layer):
     def forward(self, input, label):
         input, label = ensure_tensor(input), ensure_tensor(label)
         if _in_spmd("mp"):
-            n = lax.axis_size("mp")
+            n = _axis_size("mp")
             idx = lax.axis_index("mp")
 
             def f(logits):

@@ -63,6 +63,10 @@ def export_chrome_tracing(dir_name, worker_name=None):
     def handler(prof):
         prof._export_dir = dir_name
 
+    # Profiler.__init__ reads the dir off the handler WITHOUT calling it, so
+    # the handler itself runs only when a trace is ready (at stop) — the
+    # reference's on_trace_ready contract (profiler.py:224).
+    handler._export_dir = dir_name
     return handler
 
 
@@ -85,19 +89,15 @@ class Profiler:
         self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
-        self._export_dir = None
-        if on_trace_ready is not None:
-            try:
-                on_trace_ready(self)
-            except Exception:
-                pass
+        # dir-only peek: the handler itself runs when the trace is READY
+        # (stop()), never here — see export_chrome_tracing
+        self._export_dir = getattr(on_trace_ready, "_export_dir", None)
         self._active = False
         self.step_num = 0
         self._step_times = []
         self._t0 = None
         self._events: list = []
         self._lock = threading.Lock()
-        self._prev_hook = None
 
     # ---- lifecycle ----
     def __enter__(self):
@@ -110,10 +110,14 @@ class Profiler:
 
     def start(self):
         self._t0 = time.time()
+        global _EXTERNAL_HOOK
         from ..ops import _dispatch
-        self._prev_hook = getattr(_dispatch, "_PROFILE_HOOK", None)
-        _dispatch._PROFILE_HOOK = self._record_op
-        _ACTIVE_STACK.append(self)
+        if not _ACTIVE_STACK:
+            # chain any hook a non-profiler party installed before us
+            _EXTERNAL_HOOK = _dispatch._PROFILE_HOOK
+        if self not in _ACTIVE_STACK:
+            _ACTIVE_STACK.append(self)
+        _dispatch._PROFILE_HOOK = _dispatch_hook
         if not self._timer_only:
             self._export_dir = self._export_dir or "./profiler_log"
             os.makedirs(self._export_dir, exist_ok=True)
@@ -125,10 +129,18 @@ class Profiler:
         return self
 
     def stop(self):
+        # Stack discipline with out-of-order tolerance: remove THIS profiler
+        # from the active set wherever it sits; the shared dispatcher hook
+        # keeps feeding every remaining profiler, so stopping an outer
+        # profiler never clobbers an inner one's hook (and nested profilers
+        # both observe ops while both are active).
+        global _EXTERNAL_HOOK
         from ..ops import _dispatch
-        _dispatch._PROFILE_HOOK = self._prev_hook
-        if _ACTIVE_STACK and _ACTIVE_STACK[-1] is self:
-            _ACTIVE_STACK.pop()
+        if self in _ACTIVE_STACK:
+            _ACTIVE_STACK.remove(self)
+        if not _ACTIVE_STACK:
+            _dispatch._PROFILE_HOOK = _EXTERNAL_HOOK
+            _EXTERNAL_HOOK = None
         if self._active:
             try:
                 jax.profiler.stop_trace()
@@ -176,17 +188,17 @@ class Profiler:
         keyfn = (lambda kv: -kv[1][1]) if sorted_by in ("total", None) \
             else (lambda kv: -kv[1][0])
         lines = [
-            "-" * 78,
+            "-" * 87,
             f"{'Name':<30}{'Calls':>7}{'Total(' + time_unit + ')':>14}"
-            f"{'Avg':>9}{'Max':>9}{'Ratio':>8}",
-            "-" * 78,
+            f"{'Avg':>9}{'Min':>9}{'Max':>9}{'Ratio':>8}",
+            "-" * 87,
         ]
         for name, (cnt, tot, mn, mx) in sorted(stats.items(), key=keyfn):
             lines.append(
                 f"{name[:29]:<30}{cnt:>7}{tot * scale:>14.3f}"
-                f"{tot / cnt * scale:>9.3f}{mx * scale:>9.3f}"
-                f"{tot / total:>8.1%}")
-        lines.append("-" * 78)
+                f"{tot / cnt * scale:>9.3f}{mn * scale:>9.3f}"
+                f"{mx * scale:>9.3f}{tot / total:>8.1%}")
+        lines.append("-" * 87)
         if self._step_times:
             lines.append(self.step_info())
         return "\n".join(lines)
@@ -198,30 +210,50 @@ class Profiler:
             events.append({"name": e.name, "ph": "X", "cat": e.kind,
                            "ts": e.start * 1e6, "dur": e.dur * 1e6,
                            "pid": os.getpid(), "tid": e.tid})
+        # merge the stats plane: monitor counters ride along as metadata so
+        # ONE artifact carries both spans and counters
+        from .. import monitor as _monitor
+        snap = _monitor.snapshot()
+        events.append({"name": "paddle_tpu.monitor", "ph": "M",
+                       "pid": os.getpid(), "tid": 0, "args": snap})
         os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
                     exist_ok=True)
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
-                       "displayTimeUnit": "ms"}, f)
+                       "displayTimeUnit": "ms",
+                       "monitor": snap}, f, default=str)
         return path
 
 
 _ACTIVE_STACK: list = []
+# hook that was installed on ops._dispatch before the first profiler started
+# (chained by the dispatcher, restored when the last profiler stops)
+_EXTERNAL_HOOK = None
+
+
+def _dispatch_hook(name, start, end, kind="op"):
+    """The ONE hook installed on ops._dispatch while any profiler is active:
+    fans events out to every active profiler (nested profilers all observe
+    ops) and chains to the pre-existing external hook, if any."""
+    for p in tuple(_ACTIVE_STACK):
+        p._record_op(name, start, end, kind)
+    if _EXTERNAL_HOOK is not None:
+        _EXTERNAL_HOOK(name, start, end)
 
 
 @contextlib.contextmanager
 def RecordEvent(name, event_type=None):
     """Host-side instrumentation range (`platform/profiler/event_tracing.h`).
-    Recorded into the active Profiler's host events AND forwarded to the
+    Recorded into every active Profiler's host events AND forwarded to the
     XLA TraceMe so it shows up on the device timeline."""
     t0 = time.time()
     with jax.profiler.TraceAnnotation(name):
         try:
             yield
         finally:
-            if _ACTIVE_STACK:
-                _ACTIVE_STACK[-1]._record_op(name, t0, time.time(),
-                                             kind="user")
+            t1 = time.time()
+            for p in tuple(_ACTIVE_STACK):
+                p._record_op(name, t0, t1, kind="user")
 
 
 def load_profiler_result(filename):

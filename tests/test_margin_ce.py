@@ -107,7 +107,8 @@ class TestMarginCrossEntropy:
                 return_softmax=True, reduction=None)
             return out[0]._value, out[1]._value
 
-        f = jax.shard_map(body, mesh=mesh, in_specs=(P(None, "mp"), P()),
+        from jax.experimental.shard_map import shard_map
+        f = shard_map(body, mesh=mesh, in_specs=(P(None, "mp"), P()),
                           out_specs=(P(), P(None, "mp")))
         loss, sm = f(jnp.asarray(logits), jnp.asarray(label))
         np.testing.assert_allclose(np.asarray(loss), want_loss, rtol=2e-4)

@@ -20,6 +20,31 @@ def test_scheduler_states():
     assert sched(10) == ProfilerState.CLOSED  # repeat exhausted
 
 
+def test_scheduler_skip_first():
+    sched = make_scheduler(closed=1, ready=1, record=1, skip_first=3)
+    # the first skip_first steps are CLOSED regardless of cycle position
+    assert [sched(i) for i in range(3)] == [ProfilerState.CLOSED] * 3
+    assert [sched(i) for i in range(3, 6)] == [
+        ProfilerState.CLOSED, ProfilerState.READY,
+        ProfilerState.RECORD_AND_RETURN]
+
+
+def test_scheduler_repeat_cycles():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2)
+    cycle = [ProfilerState.CLOSED, ProfilerState.READY,
+             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN]
+    assert [sched(i) for i in range(8)] == cycle * 2
+    # after `repeat` full cycles the scheduler stays CLOSED forever
+    assert all(sched(i) == ProfilerState.CLOSED for i in range(8, 16))
+
+
+def test_scheduler_unbounded_when_repeat_zero():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=0)
+    cycle = [ProfilerState.CLOSED, ProfilerState.READY,
+             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN]
+    assert [sched(i) for i in range(12)] == cycle * 3
+
+
 def test_op_events_and_summary():
     x = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
     with Profiler(timer_only=True) as prof:
@@ -45,7 +70,10 @@ def test_chrome_export_roundtrip(tmp_path):
     prof.export(p)
     data = load_profiler_result(p)
     assert any(ev["name"] == "exp" for ev in data["traceEvents"])
-    assert all(ev["ph"] == "X" for ev in data["traceEvents"])
+    # host spans are complete events; the monitor plane rides along as ONE
+    # metadata event (ph "M") carrying the counter snapshot
+    assert all(ev["ph"] in ("X", "M") for ev in data["traceEvents"])
+    assert sum(ev["ph"] == "M" for ev in data["traceEvents"]) == 1
 
 
 def test_hook_removed_after_stop():
@@ -53,6 +81,61 @@ def test_hook_removed_after_stop():
     with Profiler(timer_only=True):
         pass
     assert _dispatch._PROFILE_HOOK is None
+
+
+def test_summary_renders_min_column():
+    x = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+    with Profiler(timer_only=True) as prof:
+        for _ in range(3):
+            paddle.tanh(x)
+    rep = prof.summary()
+    header = [ln for ln in rep.splitlines() if "Calls" in ln][0]
+    assert "Min" in header and "Max" in header
+    # Min column sits between Avg and Max, matching value order per row
+    assert header.index("Avg") < header.index("Min") < header.index("Max")
+
+
+def test_nested_profilers_chain_and_out_of_order_stop():
+    """Out-of-order stop of nested profilers must not clobber the inner
+    hook; while both are active, BOTH observe ops (stack discipline)."""
+    from paddle_tpu.ops import _dispatch
+    x = paddle.to_tensor(np.random.rand(4).astype("float32"))
+    outer = Profiler(timer_only=True).start()
+    inner = Profiler(timer_only=True).start()
+    paddle.exp(x)
+    outer.stop()          # OUT OF ORDER: inner must keep observing
+    paddle.tanh(x)
+    inner.stop()
+    assert _dispatch._PROFILE_HOOK is None
+    inner_names = {e.name for e in inner.events()}
+    outer_names = {e.name for e in outer.events()}
+    assert {"exp", "tanh"} <= inner_names
+    assert "exp" in outer_names and "tanh" not in outer_names
+
+
+def test_on_trace_ready_called_once_at_stop(tmp_path):
+    """The handler runs when the trace is READY (stop), not at __init__;
+    export_chrome_tracing's dir still takes effect."""
+    calls = []
+
+    def handler(prof):
+        calls.append(prof)
+
+    prof = Profiler(timer_only=True, on_trace_ready=handler)
+    assert calls == []                    # not invoked at construction
+    prof.start()
+    assert calls == []
+    prof.stop()
+    assert calls == [prof]                # exactly once, at trace-ready
+
+    from paddle_tpu.profiler import export_chrome_tracing
+    d = str(tmp_path / "trace_dir")
+    p2 = Profiler(timer_only=True,
+                  on_trace_ready=export_chrome_tracing(d))
+    assert p2._export_dir == d            # dir seeded without calling
+    with p2:
+        pass
+    assert p2._export_dir == d
 
 
 class TestDeviceMemory:

@@ -81,11 +81,11 @@ class TestSparseOps:
         dv = paddle.to_tensor(d, stop_gradient=False)
         t = sparse.SparseCooTensor(idx, sv, [4, 6])
         out = sparse.matmul(t, dv)
-        np.testing.assert_allclose(out.numpy(), A @ d, rtol=1e-5)
+        np.testing.assert_allclose(out.numpy(), A @ d, rtol=1e-5, atol=1e-7)
         out.sum().backward()
         # d(sum)/d(vals)[e] = sum_k d[col[e], k]
         np.testing.assert_allclose(np.asarray(sv.gradient()),
-                                   d[idx[1]].sum(-1), rtol=1e-5)
+                                   d[idx[1]].sum(-1), rtol=1e-5, atol=1e-7)
         # d(sum)/d(dense)[k, :] = sum of vals in column k
         colsum = np.zeros(6, "float32")
         np.add.at(colsum, idx[1], vals)
