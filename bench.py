@@ -499,9 +499,28 @@ print(json.dumps({"bus_gbps": round(bus / 1e9, 3), "n_devices": n,
     return out
 
 
+def _init_backend(max_tries=3, backoff_s=5.0):
+    """Backend init with bounded retry + backoff. A TPU-tunnel outage used
+    to surface as rc=1 with no artifact; now the harness gets a structured
+    {"outage": true} JSON line (rc=0) it can record and alert on, instead
+    of an empty run."""
+    errors = []
+    for attempt in range(1, max_tries + 1):
+        try:
+            import jax
+            return jax.default_backend()
+        except Exception as e:  # noqa: BLE001 — runtime/tunnel init failure
+            errors.append(f"attempt {attempt}: {type(e).__name__}: "
+                          f"{str(e)[:200]}")
+            if attempt < max_tries:
+                time.sleep(backoff_s * attempt)
+    print(json.dumps({"outage": True, "stage": "backend_init",
+                      "attempts": max_tries, "errors": errors}))
+    sys.exit(0)
+
+
 def main():
-    import jax
-    backend = jax.default_backend()
+    backend = _init_backend()
 
     ernie = bench_ernie_train(backend)
     flash = bench_flash_attention(backend)

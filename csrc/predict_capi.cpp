@@ -11,9 +11,14 @@
 //   request:  u32 magic 'PDRQ', u32 n_tensors,
 //             per tensor: u32 dtype(0=f32,1=i32,2=i64), u32 ndim,
 //                         i64 dims[ndim], payload bytes
+//   deadline: u32 magic 'PDRD', u32 deadline_ms, u32 n_tensors, tensors
 //   response: u32 magic 'PDRS', u8 status,
 //             status==0: u32 n_tensors + tensors (same encoding)
-//             status!=0: u32 len + utf-8 error message
+//             status!=0: u32 len + utf-8 message
+//               status 1 = server-side error        -> rc 3
+//               status 2 = server overloaded        -> rc 4 (retryable
+//                          backpressure, NOT a failure: back off + retry)
+//               status 3 = request deadline expired -> rc 5
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -28,9 +33,18 @@
 
 namespace {
 
-constexpr uint32_t kReqMagic = 0x50445251;   // 'PDRQ'
-constexpr uint32_t kRespMagic = 0x50445253;  // 'PDRS'
+constexpr uint32_t kReqMagic = 0x50445251;       // 'PDRQ'
+constexpr uint32_t kReqDeadlineMagic = 0x50445244;  // 'PDRD'
+constexpr uint32_t kRespMagic = 0x50445253;      // 'PDRS'
 constexpr int kMaxNdim = 8;
+
+// PD_PredictorRun* return codes (>=3 carry a message in PD_GetLastError)
+constexpr int kOk = 0;
+constexpr int kBadArgs = 1;
+constexpr int kTransportError = 2;
+constexpr int kServerError = 3;
+constexpr int kOverloaded = 4;   // server backpressure: retry with backoff
+constexpr int kDeadlineExpired = 5;
 
 size_t dtype_size(int dt) { return dt == 0 ? 4 : dt == 1 ? 4 : 8; }
 
@@ -100,11 +114,14 @@ const char* PD_GetLastError(PD_Predictor* p) {
 }
 
 // Returns 0 on success; fills *outputs (malloc'd array of n) + *n_out.
-int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
-                    PD_Tensor** outputs, int* n_out) {
+// deadline_ms > 0 rides the 'PDRD' frame: the server drops the request
+// before batching if the deadline passes in its queue (rc 5).
+static int RunImpl(PD_Predictor* p, uint32_t deadline_ms,
+                   const PD_Tensor* inputs, int n_in, PD_Tensor** outputs,
+                   int* n_out) {
   if (p == nullptr || inputs == nullptr || outputs == nullptr ||
       n_out == nullptr || n_in <= 0)
-    return 1;
+    return kBadArgs;
   *outputs = nullptr;
   *n_out = 0;
   // validate EVERY input before the first byte goes out: an argument
@@ -114,18 +131,26 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
     if (t.ndim < 0 || t.ndim > kMaxNdim || t.dtype < 0 || t.dtype > 2 ||
         t.data == nullptr) {
       p->last_error = "invalid input tensor (ndim/dtype/data)";
-      return 1;
+      return kBadArgs;
     }
     for (int d = 0; d < t.ndim; ++d)
       if (t.dims[d] < 0) {
         p->last_error = "negative input dim";
-        return 1;
+        return kBadArgs;
       }
   }
-  uint32_t hdr[2] = {kReqMagic, static_cast<uint32_t>(n_in)};
-  if (!send_exact(p->fd, hdr, sizeof(hdr))) {
+  bool sent_ok;
+  if (deadline_ms > 0) {
+    uint32_t hdr[3] = {kReqDeadlineMagic, deadline_ms,
+                       static_cast<uint32_t>(n_in)};
+    sent_ok = send_exact(p->fd, hdr, sizeof(hdr));
+  } else {
+    uint32_t hdr[2] = {kReqMagic, static_cast<uint32_t>(n_in)};
+    sent_ok = send_exact(p->fd, hdr, sizeof(hdr));
+  }
+  if (!sent_ok) {
     p->last_error = "send failed (header)";
-    return 2;
+    return kTransportError;
   }
   for (int i = 0; i < n_in; ++i) {
     const PD_Tensor& t = inputs[i];
@@ -137,7 +162,7 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
         !send_exact(p->fd, t.dims, sizeof(int64_t) * t.ndim) ||
         !send_exact(p->fd, t.data, count * dtype_size(t.dtype))) {
       p->last_error = "send failed (tensor)";
-      return 2;
+      return kTransportError;
     }
   }
   uint32_t magic = 0;
@@ -145,31 +170,35 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
   if (!recv_exact(p->fd, &magic, 4) || magic != kRespMagic ||
       !recv_exact(p->fd, &status, 1)) {
     p->last_error = "bad response header";
-    return 2;
+    return kTransportError;
   }
   if (status != 0) {
     uint32_t len = 0;
-    if (!recv_exact(p->fd, &len, 4)) return 2;
+    if (!recv_exact(p->fd, &len, 4)) return kTransportError;
     if (len > (64u << 10)) {  // cap: corrupt length must not drive alloc
       p->last_error = "implausible error-message length";
-      return 2;
+      return kTransportError;
     }
     std::vector<char> msg(len);
-    if (!recv_exact(p->fd, msg.data(), len)) return 2;
+    if (!recv_exact(p->fd, msg.data(), len)) return kTransportError;
     p->last_error.assign(msg.data(), len);
-    return 3;  // server-side error (message in PD_GetLastError)
+    // the connection stays framed after any status frame: retryable
+    // backpressure and deadline expiry are distinguishable from failure
+    if (status == 2) return kOverloaded;
+    if (status == 3) return kDeadlineExpired;
+    return kServerError;  // message in PD_GetLastError
   }
   uint32_t n = 0;
-  if (!recv_exact(p->fd, &n, 4)) return 2;
+  if (!recv_exact(p->fd, &n, 4)) return kTransportError;
   if (n > 1024) {  // corrupt/hostile response: don't trust the count
     p->last_error = "implausible output tensor count";
-    return 2;
+    return kTransportError;
   }
   PD_Tensor* outs =
       static_cast<PD_Tensor*>(std::calloc(n, sizeof(PD_Tensor)));
   if (outs == nullptr && n > 0) {
     p->last_error = "out of memory (outputs)";
-    return 2;
+    return kTransportError;
   }
   // one cleanup path frees every buffer received so far (calloc zeroed
   // data pointers, so free(nullptr) is safe for the rest)
@@ -177,7 +206,7 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
     for (uint32_t j = 0; j < n; ++j) std::free(outs[j].data);
     std::free(outs);
     p->last_error = msg;
-    return 2;
+    return kTransportError;
   };
   for (uint32_t i = 0; i < n; ++i) {
     uint32_t meta[2];
@@ -211,7 +240,18 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
   }
   *outputs = outs;
   *n_out = static_cast<int>(n);
-  return 0;
+  return kOk;
+}
+
+int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* inputs, int n_in,
+                    PD_Tensor** outputs, int* n_out) {
+  return RunImpl(p, 0, inputs, n_in, outputs, n_out);
+}
+
+int PD_PredictorRunWithDeadline(PD_Predictor* p, uint32_t deadline_ms,
+                                const PD_Tensor* inputs, int n_in,
+                                PD_Tensor** outputs, int* n_out) {
+  return RunImpl(p, deadline_ms, inputs, n_in, outputs, n_out);
 }
 
 void PD_TensorsDestroy(PD_Tensor* ts, int n) {

@@ -50,6 +50,7 @@ from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import models  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401  (dynamic-batching inference engine)
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401  (stats registry + trace spans plane)
 from . import incubate  # noqa: F401

@@ -80,3 +80,25 @@ define_flag("monitor", False,
             "enable the paddle_tpu.monitor stats registry + trace spans "
             "(platform/monitor.h STAT registry role); off = the dispatch "
             "fast path pays one module-attribute check and nothing else")
+
+# ---- serving plane (paddle_tpu.serving.EngineConfig.from_flags) ----
+define_flag("serving_max_batch_size", 8,
+            "dynamic batcher: max rows coalesced into one Predictor call")
+define_flag("serving_batch_timeout_ms", 2.0,
+            "dynamic batcher: max wait for co-batchable requests before "
+            "dispatching a partial batch")
+define_flag("serving_queue_depth", 256,
+            "serving engine: pending-request cap; submits beyond it get "
+            "explicit overload rejection (wire status 2), not queuing")
+define_flag("serving_default_deadline_ms", 0.0,
+            "serving engine: implicit per-request deadline (0 = none); "
+            "expired requests are dropped before batching, wire status 3")
+define_flag("serving_num_workers", 1,
+            "serving engine: batcher worker threads (predictor dispatch "
+            "itself is serialized; >1 overlaps host pre/post work)")
+define_flag("serving_learn_buckets", True,
+            "serving engine: a novel request signature registers a new "
+            "shape bucket (one compile) instead of being rejected")
+define_flag("serving_warmup", True,
+            "serving engine: pre-run every declared bucket x batch size "
+            "at start() so steady-state serving never compiles")

@@ -217,6 +217,72 @@ class Predictor:
     # ZeroCopyRun parity
     zero_copy_run = run
 
+    def serving_buckets(self, ladder=None):
+        """Shape-bucket declarations for the serving engine, derived from
+        the artifact's exported input specs: [(item_shapes, dtypes,
+        batch_sizes)]. A saved artifact has a FIXED StableHLO signature,
+        so its only legal batch size is the exported one (requests pad up
+        to it); a live-Layer predictor retraces freely, so it gets the
+        engine's batch ladder. Dynamic (-1) dims defer to bucket learning."""
+        if self._translated is not None:
+            specs = self._translated._meta["input_specs"]
+            fixed = True
+        elif getattr(self, "_input_spec", None):
+            specs = [{"shape": list(s.shape), "dtype": s.dtype}
+                     for s in self._input_spec]
+            fixed = False
+        else:
+            return []
+        shapes = [tuple(int(d) for d in s["shape"]) for s in specs]
+        if any(len(s) < 1 or any(d < 0 for d in s) for s in shapes):
+            return []
+        # the wire carries f32/i32/i64; run_batch casts floats for bf16
+        # artifacts, so float-family specs bucket as float32 on the host
+        dtypes = ["float32" if "float" in np.dtype(s["dtype"]).name
+                  else np.dtype(s["dtype"]).name for s in specs]
+        batches = {s[0] for s in shapes}
+        if len(batches) != 1:
+            return []
+        batch = batches.pop()
+        sizes = [batch] if fixed else sorted(
+            {b for b in (ladder or [batch]) } | {batch})
+        return [([s[1:] for s in shapes], dtypes, sizes)]
+
+    def run_batch(self, arrays):
+        """Batched functional entry for the serving plane: a list of
+        numpy/jax arrays (leading dim = batch) in, a list of HOST numpy
+        arrays out. Unlike run(), it touches no handle state (_feeds/
+        _results), so engine workers can drive it without the per-request
+        lock the handle protocol needs; bf16 artifacts read back as fp32
+        exactly like copy_to_cpu."""
+        if len(arrays) != len(self._input_names):
+            raise ValueError(f"model expects {len(self._input_names)} "
+                             f"inputs, got {len(arrays)}")
+        arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in arrays]
+        if self._bf16:
+            arrs = [a.astype(jnp.bfloat16)
+                    if jnp.issubdtype(a.dtype, jnp.floating)
+                    and a.dtype != jnp.bfloat16 else a
+                    for a in arrs]
+        if self._translated is not None:
+            out = self._translated(*arrs)
+        else:
+            if self._fn is None:
+                from ..jit.to_static import to_static
+                self._fn = to_static(self._layer.forward)
+            from ..core.autograd import no_grad
+            with no_grad():
+                out = self._fn(*[Tensor(a) for a in arrs])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        res = []
+        for o in outs:
+            a = np.asarray(o._value if isinstance(o, Tensor) else o)
+            if a.dtype == np.dtype("bfloat16"):
+                a = a.astype(np.float32)
+            res.append(a)
+        return res
+
 
 def create_predictor(config):
     return Predictor(config)

@@ -3,12 +3,25 @@
 Reference parity: the deployment role of `inference/capi_exp/` +
 `goapi/`: C/Go apps run inference against a stable ABI. Here the ABI is a
 binary tensor protocol (see csrc/predict_capi.cpp) served by the process
-that owns the TPU runtime; each connection gets a handler thread and runs
-the shared Predictor (Predictor.clone()-style multi-threaded serving,
-`analysis_predictor.cc` Clone).
+that owns the TPU runtime. Connection handler threads no longer run the
+Predictor themselves (the seed's thread-per-connection loop collapsed TPU
+throughput to batch-1 latency): every request is submitted to the
+`paddle_tpu.serving.ServingEngine`, which coalesces concurrent requests
+into padded shape-bucket batches, enforces deadlines and queue-depth
+backpressure, and drives the jitted Predictor from its worker loop.
+
+Wire protocol (little-endian), on top of csrc/predict_capi.cpp's framing:
+  request:   u32 'PDRQ', u32 n_tensors, tensors
+  deadline:  u32 'PDRD', u32 deadline_ms, u32 n_tensors, tensors
+  health:    u32 'PDHQ' (no body)
+  response:  u32 'PDRS', u8 status;
+             status 0: u32 n_tensors + tensors ('PDHQ': u32 len + JSON)
+             status 1 (error) / 2 (overloaded, retryable) /
+             status 3 (deadline expired): u32 len + utf-8 message
 """
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import threading
@@ -16,15 +29,21 @@ from typing import Optional
 
 import numpy as np
 
-_REQ_MAGIC = 0x50445251
-_RESP_MAGIC = 0x50445253
+_REQ_MAGIC = 0x50445251       # 'PDRQ'
+_REQ_DEADLINE_MAGIC = 0x50445244  # 'PDRD': u32 deadline_ms precedes count
+_HEALTH_MAGIC = 0x50444851    # 'PDHQ': health/stats probe, no tensor body
+_RESP_MAGIC = 0x50445253      # 'PDRS'
 _DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
 _DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
                 np.dtype(np.int64): 2}
 _MAX_NDIM = 8
 _MAX_TENSOR_BYTES = 1 << 32  # sanity cap against corrupt headers
 
-from ..utils.net import recv_exact as _recv_exact  # noqa: E402
+from ..serving import (  # noqa: E402
+    DeadlineExceededError, EngineConfig, ServerOverloadedError, ServingEngine)
+from ..utils.net import (  # noqa: E402
+    STATUS_DEADLINE, STATUS_ERROR, STATUS_OK, STATUS_OVERLOADED,
+    recv_exact as _recv_exact, send_status_frame)
 
 
 def _read_tensor(conn) -> np.ndarray:
@@ -54,20 +73,30 @@ def _write_tensor(conn, arr: np.ndarray):
 
 class PredictorServer:
     """Serve a Predictor (or any callable of numpy arrays) over the C-API
-    wire protocol."""
+    wire protocol, with the ServingEngine between connections and the
+    accelerator. Pass `engine=` to share a pre-configured engine, or
+    `engine_config=` to tune the built-in one; the default reads the
+    FLAGS_serving_* flags."""
 
-    def __init__(self, predictor, host="127.0.0.1", port=0):
+    # handler threads park on the response future at most this long — a
+    # wedged predictor must not leak handler threads forever
+    _RESULT_TIMEOUT_S = 600.0
+
+    def __init__(self, predictor, host="127.0.0.1", port=0,
+                 engine: Optional[ServingEngine] = None,
+                 engine_config: Optional[EngineConfig] = None):
         self.predictor = predictor
+        self.engine = engine or ServingEngine(predictor, engine_config)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(16)
+        self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()  # predictor state is shared
 
     def start(self):
+        self.engine.start()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
         return self
@@ -84,52 +113,60 @@ class PredictorServer:
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
-    def _run(self, inputs):
-        from . import Predictor
-        if isinstance(self.predictor, Predictor):
-            with self._lock:
-                names = self.predictor.get_input_names()
-                if len(inputs) != len(names):
-                    raise ValueError(
-                        f"model expects {len(names)} inputs, got {len(inputs)}")
-                for name, arr in zip(names, inputs):
-                    self.predictor.get_input_handle(name).copy_from_cpu(arr)
-                self.predictor.run()
-                return [self.predictor.get_output_handle(n).copy_to_cpu()
-                        for n in self.predictor.get_output_names()]
-        outs = self.predictor(*inputs)
-        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    def _handle_one(self, conn) -> bool:
+        """One request/response exchange; False = close the connection."""
+        magic, = struct.unpack("<I", _recv_exact(conn, 4))
+        if magic == _HEALTH_MAGIC:
+            payload = json.dumps(self.engine.stats(),
+                                 default=str).encode()
+            conn.sendall(struct.pack("<IB", _RESP_MAGIC, STATUS_OK)
+                         + struct.pack("<I", len(payload)) + payload)
+            return True
+        deadline_ms = None
+        if magic == _REQ_DEADLINE_MAGIC:
+            dl, = struct.unpack("<I", _recv_exact(conn, 4))
+            deadline_ms = float(dl) if dl else None
+        elif magic != _REQ_MAGIC:
+            return False  # protocol violation: drop the connection
+        n, = struct.unpack("<I", _recv_exact(conn, 4))
+        try:
+            inputs = [_read_tensor(conn) for _ in range(n)]
+        except ValueError as e:
+            # header was bad: stream unrecoverable, report + close
+            send_status_frame(conn, STATUS_ERROR, str(e))
+            return False
+        try:
+            fut = self.engine.submit(inputs, deadline_ms=deadline_ms)
+            outs = fut.result(timeout=self._RESULT_TIMEOUT_S)
+        except ServerOverloadedError as e:
+            send_status_frame(conn, STATUS_OVERLOADED, str(e))
+            return True
+        except DeadlineExceededError as e:
+            send_status_frame(conn, STATUS_DEADLINE, str(e))
+            return True
+        except Exception as e:  # surface model errors to the C app
+            send_status_frame(conn, STATUS_ERROR, str(e))
+            return True
+        conn.sendall(struct.pack("<IBI", _RESP_MAGIC, STATUS_OK, len(outs)))
+        for o in outs:
+            _write_tensor(conn, np.asarray(o))
+        return True
 
     def _handle(self, conn):
         try:
-            while True:
-                magic, n = struct.unpack("<II", _recv_exact(conn, 8))
-                if magic != _REQ_MAGIC:
-                    return  # protocol violation: drop the connection
-                try:
-                    inputs = [_read_tensor(conn) for _ in range(n)]
-                except ValueError as e:
-                    # header was bad: stream unrecoverable, report + close
-                    msg = str(e).encode()
-                    conn.sendall(struct.pack("<IB", _RESP_MAGIC, 1)
-                                 + struct.pack("<I", len(msg)) + msg)
-                    return
-                try:
-                    outs = self._run(inputs)
-                except Exception as e:  # surface model errors to the C app
-                    msg = str(e).encode()
-                    conn.sendall(struct.pack("<IB", _RESP_MAGIC, 1)
-                                 + struct.pack("<I", len(msg)) + msg)
-                    continue
-                conn.sendall(struct.pack("<IBI", _RESP_MAGIC, 0, len(outs)))
-                for o in outs:
-                    _write_tensor(conn, np.asarray(o))
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while self._handle_one(conn):
+                pass
         except (ConnectionError, OSError, struct.error):
             pass
         finally:
             conn.close()
 
-    def stop(self):
+    def stats(self):
+        """Engine health snapshot (what the 'PDHQ' wire probe returns)."""
+        return self.engine.stats()
+
+    def stop(self, drain: bool = True):
         self._stop.set()
         try:
             self._sock.close()
@@ -137,3 +174,48 @@ class PredictorServer:
             pass
         if self._thread is not None:
             self._thread.join(timeout=2)
+        self.engine.stop(drain=drain)
+
+
+class PredictorClient:
+    """Minimal python-side client of the wire protocol (the C client in
+    csrc/predict_capi.cpp is the production ABI; this one drives tests and
+    python tooling — including the health probe)."""
+
+    def __init__(self, host, port, timeout: float = 60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def run(self, arrays, deadline_ms: Optional[float] = None):
+        """Returns (status, payload): payload is the output list on
+        STATUS_OK, else the server's utf-8 message."""
+        if deadline_ms is not None:
+            hdr = struct.pack("<III", _REQ_DEADLINE_MAGIC,
+                              int(deadline_ms), len(arrays))
+        else:
+            hdr = struct.pack("<II", _REQ_MAGIC, len(arrays))
+        self._sock.sendall(hdr)
+        for a in arrays:
+            _write_tensor(self._sock, np.asarray(a))
+        magic, status = struct.unpack("<IB", _recv_exact(self._sock, 5))
+        if magic != _RESP_MAGIC:
+            raise ConnectionError(f"bad response magic {magic:#x}")
+        if status != STATUS_OK:
+            ln, = struct.unpack("<I", _recv_exact(self._sock, 4))
+            return status, _recv_exact(self._sock, ln).decode()
+        n, = struct.unpack("<I", _recv_exact(self._sock, 4))
+        return status, [_read_tensor(self._sock) for _ in range(n)]
+
+    def health(self) -> dict:
+        self._sock.sendall(struct.pack("<I", _HEALTH_MAGIC))
+        magic, status = struct.unpack("<IB", _recv_exact(self._sock, 5))
+        if magic != _RESP_MAGIC or status != STATUS_OK:
+            raise ConnectionError("bad health response")
+        ln, = struct.unpack("<I", _recv_exact(self._sock, 4))
+        return json.loads(_recv_exact(self._sock, ln).decode())
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

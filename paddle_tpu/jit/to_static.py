@@ -36,6 +36,11 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._jit_cache = {}
+        # signatures already traced (monitor retrace accounting): only a
+        # NOVEL signature is a recompile — alternating between two known
+        # shapes (e.g. the serving engine cycling batch buckets) replays
+        # jax.jit's cache and must not count as retraces
+        self._seen_sigs = set()
         try:
             functools.update_wrapper(self, function)
         except Exception:
@@ -151,13 +156,17 @@ class StaticFunction:
         # cost on the hot path).
         sig = tuple((t._value.shape, str(t._value.dtype)) for t in diff_inputs)
         if getattr(self, "_prog_sig", None) != sig:
-            if _monitor._ENABLED:
-                # a signature change on a to_static capture = retrace: the
-                # whole program recompiles for the new shapes/dtypes
-                _monitor.record_retrace(
-                    "to_static",
-                    [f"{s}:{d}" for s, d in sig],
-                    first=getattr(self, "_prog_sig", None) is None)
+            if sig not in self._seen_sigs:
+                # a NOVEL signature on a to_static capture = retrace: the
+                # whole program recompiles for the new shapes/dtypes. A
+                # previously-seen signature hits jax.jit's executable
+                # cache and is free — only the Program rebuild below runs.
+                if _monitor._ENABLED:
+                    _monitor.record_retrace(
+                        "to_static",
+                        [f"{s}:{d}" for s, d in sig],
+                        first=not self._seen_sigs)
+                self._seen_sigs.add(sig)
             jitted = self._get_jitted(training, pnames, bnames, static_kwargs)
 
             def fn(*arrs, _jit=jitted, _b=list(barrs), _k=key, _np=n_p):

@@ -15,6 +15,11 @@ registry of counters, gauges and histograms that every layer reports into:
     inbox-depth gauges
   - data loading (`io/dataloader.py`): queue-wait + batch-build histograms
   - optimizer (`optimizer/optimizer.py`): step counts + durations
+  - serving (`serving/engine.py`): `serving.queue_depth` gauge,
+    `serving.queue_wait`/`serving.e2e_latency`/`serving.batch_size`
+    histograms, `serving.padding_waste_elems`/`serving.padded_rows`,
+    `serving.rejected`/`serving.deadline_expired`/`serving.compiles`
+    counters — one Prometheus scrape covers the whole serving path
 
 Everything is gated by `FLAGS_monitor` (off by default): instrumented call
 sites check the module attribute `_ENABLED` — one attribute load on the

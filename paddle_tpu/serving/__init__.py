@@ -1,0 +1,25 @@
+"""paddle_tpu.serving — the TPU-native serving plane.
+
+Sits between the wire protocols (`inference/server.py`, `csrc/
+predict_capi.cpp`) and the Predictor: a `ServingEngine` coalesces
+concurrent requests into padded shape-bucket batches (declared or
+learned, warmed up so steady-state serving never compiles), enforces
+per-request deadlines and queue-depth backpressure, drains gracefully on
+shutdown, and reports health + `paddle_tpu.monitor` metrics.
+
+Reference parity: the deployment role of `paddle/fluid/inference/`
+(AnalysisPredictor served under Paddle Serving / Triton-style dynamic
+batching); see README "Serving" for configuration and overload semantics.
+"""
+from .bucket import BucketSet, ShapeBucket, default_batch_sizes, signature_of  # noqa: F401
+from .engine import (  # noqa: F401
+    DeadlineExceededError, EngineConfig, EngineStoppedError, NoBucketError,
+    ResponseFuture, ServerOverloadedError, ServingEngine, ServingError,
+)
+
+__all__ = [
+    "ServingEngine", "EngineConfig", "ResponseFuture",
+    "ShapeBucket", "BucketSet", "default_batch_sizes", "signature_of",
+    "ServingError", "ServerOverloadedError", "DeadlineExceededError",
+    "EngineStoppedError", "NoBucketError",
+]

@@ -1,0 +1,163 @@
+"""Shape buckets: the padding contract between wire requests and XLA.
+
+Every distinct (shape, dtype) signature reaching a jitted Predictor is one
+XLA compile; a serving process that compiles mid-request stalls the whole
+batch lane for seconds. A `ShapeBucket` declares the canonical padded item
+shapes and the allowed batch sizes up front so the engine pads every
+request onto a small closed set of signatures — warmed at startup, zero
+retraces in steady state (reference role: the TensorRT profile /
+dynamic-shape bucket declarations of `paddle/fluid/inference/`; same idea
+as Triton's preferred_batch_size + ragged-input padding).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ShapeBucket", "BucketSet", "signature_of", "default_batch_sizes"]
+
+# (trailing item shape, dtype name) per model input — batch dim excluded
+Signature = Tuple[Tuple[Tuple[int, ...], str], ...]
+
+
+def signature_of(arrays: Sequence[np.ndarray]) -> Signature:
+    """Per-item signature of a request: trailing dims + dtype per input
+    (the leading dim is the request's batch and is bucketed separately)."""
+    return tuple((tuple(a.shape[1:]), str(a.dtype)) for a in arrays)
+
+
+def default_batch_sizes(max_batch_size: int) -> Tuple[int, ...]:
+    """Powers of two up to max_batch_size (each size is one compile)."""
+    sizes = []
+    b = 1
+    while b < max_batch_size:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch_size)
+    return tuple(sizes)
+
+
+class ShapeBucket:
+    """One padded lane: canonical item shapes + the batch-size ladder."""
+
+    def __init__(self, item_shapes: Sequence[Sequence[int]],
+                 dtypes: Sequence[str],
+                 batch_sizes: Sequence[int],
+                 learned: bool = False):
+        self.item_shapes: Tuple[Tuple[int, ...], ...] = tuple(
+            tuple(int(d) for d in s) for s in item_shapes)
+        self.dtypes: Tuple[str, ...] = tuple(str(d) for d in dtypes)
+        self.batch_sizes: Tuple[int, ...] = tuple(sorted(set(
+            int(b) for b in batch_sizes)))
+        if not self.batch_sizes or self.batch_sizes[0] < 1:
+            raise ValueError(f"bad batch sizes {batch_sizes}")
+        self.learned = learned
+
+    @property
+    def max_batch_size(self) -> int:
+        return self.batch_sizes[-1]
+
+    @property
+    def signature(self) -> Signature:
+        return tuple(zip(self.item_shapes, self.dtypes))
+
+    def key(self):
+        return self.signature
+
+    def accepts(self, sig: Signature) -> bool:
+        """True if a request with `sig` can be padded into this bucket:
+        same arity/dtypes/rank, every trailing dim <= the bucket dim."""
+        if len(sig) != len(self.item_shapes):
+            return False
+        for (shape, dt), bshape, bdt in zip(sig, self.item_shapes,
+                                            self.dtypes):
+            if dt != bdt or len(shape) != len(bshape):
+                return False
+            if any(d > bd for d, bd in zip(shape, bshape)):
+                return False
+        return True
+
+    def padding_cost(self, sig: Signature) -> int:
+        """Padded elements per item when `sig` rides this bucket — the
+        resolve tie-break (smallest waste wins)."""
+        cost = 0
+        for (shape, _), bshape in zip(sig, self.item_shapes):
+            n = int(np.prod(shape)) if shape else 1
+            bn = int(np.prod(bshape)) if bshape else 1
+            cost += bn - n
+        return cost
+
+    def round_up_batch(self, rows: int) -> int:
+        """Smallest declared batch size >= rows."""
+        for b in self.batch_sizes:
+            if b >= rows:
+                return b
+        return self.max_batch_size
+
+    def pad_item(self, arr: np.ndarray, slot: int) -> np.ndarray:
+        """Zero-pad one request array's trailing dims up to the bucket's
+        canonical item shape (leading/batch dim untouched)."""
+        target = self.item_shapes[slot]
+        if tuple(arr.shape[1:]) == target:
+            return arr
+        pads = [(0, 0)] + [(0, t - d) for d, t in zip(arr.shape[1:], target)]
+        return np.pad(arr, pads)
+
+    def describe(self) -> Dict:
+        return {"item_shapes": [list(s) for s in self.item_shapes],
+                "dtypes": list(self.dtypes),
+                "batch_sizes": list(self.batch_sizes),
+                "learned": self.learned}
+
+    def __repr__(self):
+        return (f"ShapeBucket(shapes={self.item_shapes}, "
+                f"dtypes={self.dtypes}, batch={self.batch_sizes}, "
+                f"learned={self.learned})")
+
+
+class BucketSet:
+    """Thread-safe registry of declared (and optionally learned) buckets."""
+
+    def __init__(self, learn: bool = True,
+                 default_batch_sizes_: Optional[Sequence[int]] = None):
+        self._lock = threading.Lock()
+        self._buckets: Dict[Signature, ShapeBucket] = {}
+        self._learn = bool(learn)
+        self._default_bs = tuple(default_batch_sizes_ or (1,))
+
+    def declare(self, item_shapes, dtypes,
+                batch_sizes: Optional[Sequence[int]] = None) -> ShapeBucket:
+        b = ShapeBucket(item_shapes, dtypes,
+                        batch_sizes or self._default_bs)
+        with self._lock:
+            self._buckets[b.key()] = b
+        return b
+
+    def resolve(self, sig: Signature) -> Optional[ShapeBucket]:
+        """Exact-signature bucket, else the accepting bucket with the least
+        padding, else (learn mode) a new exact bucket, else None."""
+        with self._lock:
+            b = self._buckets.get(sig)
+            if b is not None:
+                return b
+            candidates = [bk for bk in self._buckets.values()
+                          if bk.accepts(sig)]
+        if candidates:
+            return min(candidates, key=lambda bk: bk.padding_cost(sig))
+        if not self._learn:
+            return None
+        learned = ShapeBucket([s for s, _ in sig], [d for _, d in sig],
+                              self._default_bs, learned=True)
+        with self._lock:
+            # another submitter may have raced the learn: keep the first
+            return self._buckets.setdefault(learned.key(), learned)
+
+    def buckets(self) -> List[ShapeBucket]:
+        with self._lock:
+            return list(self._buckets.values())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._buckets)

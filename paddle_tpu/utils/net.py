@@ -1,6 +1,33 @@
 """Shared socket framing helpers (used by the PS RPC plane and the
-inference C-API server — one implementation of exact-read)."""
+inference C-API server — one implementation of exact-read, plus the
+inference response status frame).
+
+Inference response statuses (csrc/predict_capi.cpp mirrors these): a
+client must be able to tell backpressure (retryable, the server is
+healthy) from failure — overload and deadline expiry get their own codes
+instead of riding the generic error status.
+"""
 from __future__ import annotations
+
+import struct
+
+# response status byte of the inference wire protocol
+STATUS_OK = 0            # payload: u32 n_tensors + tensors
+STATUS_ERROR = 1         # payload: u32 len + utf-8 message
+STATUS_OVERLOADED = 2    # payload: u32 len + message; retry with backoff
+STATUS_DEADLINE = 3      # payload: u32 len + message; request expired
+
+_RESP_MAGIC = 0x50445253  # 'PDRS'
+
+
+def send_status_frame(sock, status: int, msg: bytes | str = b"") -> None:
+    """Send a non-OK inference response frame: magic + status + message.
+    One implementation so the server cannot desynchronize the stream by
+    hand-rolling a frame per call site."""
+    if isinstance(msg, str):
+        msg = msg.encode()
+    sock.sendall(struct.pack("<IB", _RESP_MAGIC, status)
+                 + struct.pack("<I", len(msg)) + msg)
 
 
 def recv_exact(sock, n: int) -> bytes:

@@ -22,6 +22,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: soak/long-concurrency tests carry the
+    # marker and only run in the full suite
+    config.addinivalue_line(
+        "markers", "slow: long-running soak tests, deselected in tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     np.random.seed(0)

@@ -30,6 +30,11 @@ def capi():
         ctypes.c_void_p, ctypes.POINTER(PD_Tensor), ctypes.c_int,
         ctypes.POINTER(ctypes.POINTER(PD_Tensor)),
         ctypes.POINTER(ctypes.c_int)]
+    lib.PD_PredictorRunWithDeadline.restype = ctypes.c_int
+    lib.PD_PredictorRunWithDeadline.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.POINTER(PD_Tensor),
+        ctypes.c_int, ctypes.POINTER(ctypes.POINTER(PD_Tensor)),
+        ctypes.POINTER(ctypes.c_int)]
     lib.PD_TensorsDestroy.argtypes = [ctypes.POINTER(PD_Tensor), ctypes.c_int]
     lib.PD_GetLastError.restype = ctypes.c_char_p
     lib.PD_GetLastError.argtypes = [ctypes.c_void_p]
@@ -111,6 +116,24 @@ class TestCAPI:
         rc2 = capi.PD_PredictorRun(h, ctypes.byref(t3), 1,
                                    ctypes.byref(outs), ctypes.byref(n_out))
         assert rc2 == 0, capi.PD_GetLastError(h)
+        capi.PD_TensorsDestroy(outs, n_out.value)
+        capi.PD_PredictorDestroy(h)
+
+    def test_run_with_deadline_frame(self, capi, lenet_server):
+        # the 'PDRD' request frame end-to-end: a generous deadline serves
+        # normally (rc 0); the expiry/overload rc mapping is covered from
+        # the python client side in test_serving.py (deterministic gating)
+        srv, _ = lenet_server
+        h = capi.PD_PredictorCreate(b"127.0.0.1", srv.port)
+        x = np.zeros((2, 1, 28, 28), np.float32)
+        tin, keep = make_tensor(x)
+        outs = ctypes.POINTER(PD_Tensor)()
+        n_out = ctypes.c_int()
+        rc = capi.PD_PredictorRunWithDeadline(
+            h, 10_000, ctypes.byref(tin), 1, ctypes.byref(outs),
+            ctypes.byref(n_out))
+        assert rc == 0, capi.PD_GetLastError(h)
+        assert n_out.value == 1
         capi.PD_TensorsDestroy(outs, n_out.value)
         capi.PD_PredictorDestroy(h)
 

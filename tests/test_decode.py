@@ -26,10 +26,29 @@ class BiasCell(nn.RNNCellBase):
         return rows, states
 
 
+def _logp(table):
+    """float64 log-softmax: the oracle's canonical scoring table."""
+    t = table.astype(np.float64)
+    return np.log(np.exp(t) / np.exp(t).sum(-1, keepdims=True))
+
+
+def _path_score(logp, start, end, seq):
+    """Oracle score of a decoded beam path (finished semantics: tokens
+    after the first end_token are free end-token emissions)."""
+    score, last, fin = 0.0, start, False
+    for v in seq:
+        if fin:
+            assert v == end, seq  # finished beams may only emit <end>
+            continue
+        score += logp[last, v]
+        last, fin = v, v == end
+    return score
+
+
 def brute_force_beam(table, start, end, beam, steps):
     """Exhaustive beam search oracle (tracks the same scoring rules)."""
     V = table.shape[1]
-    logp = np.log(np.exp(table) / np.exp(table).sum(-1, keepdims=True))
+    logp = _logp(table)
     beams = [((), start, 0.0, False)]  # (seq, last, score, finished)
     for _ in range(steps):
         cand = []
@@ -62,12 +81,23 @@ class TestBeamSearch:
                                                 return_length=True)
         got = np.asarray(out.numpy())[0]          # [T, beam]
         want = brute_force_beam(table, 0, 5, 3, 4)
+        logp = _logp(table)
+        # Score-equivalence, not sequence-equality: permuted paths that
+        # visit the same transition multiset tie exactly in real
+        # arithmetic, and float32 summation order (which varies across
+        # jax versions/backends) picks the survivor arbitrarily. The
+        # deterministic contract is that each decoded beam is a valid
+        # path whose ORACLE score matches the oracle's w-th best.
+        seqs = []
         for w in range(3):
-            seq = tuple(got[:, w][:int(np.asarray(lengths.numpy())[0, w])
+            seq = tuple(int(t) for t in
+                        got[:, w][:int(np.asarray(lengths.numpy())[0, w])
                                   + (1 if 5 in got[:, w] else 0)])
-            # the oracle's w-th best prefix must match the decoded beam
-            want_seq = want[w][0][:len(seq)]
-            assert tuple(want_seq) == seq, (w, seq, want[w])
+            seqs.append(seq)
+            got_score = _path_score(logp, 0, 5, seq)
+            assert abs(got_score - want[w][2]) < 1e-4, \
+                (w, seq, got_score, want[w])
+        assert len(set(seqs)) == 3  # beams are genuinely distinct paths
 
     def test_all_sequences_reach_end_token(self):
         # a table where end (tok 5) dominates: everything finishes fast

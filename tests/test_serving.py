@@ -1,0 +1,436 @@
+"""Serving plane: dynamic batching, shape buckets, deadlines, backpressure,
+drain, zero-steady-state-retrace guarantee, and the socket e2e path through
+PredictorServer (reference role: paddle/fluid/inference/ deployment stack,
+Clipper/Triton-style dynamic batching rebuilt TPU-native)."""
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.monitor as monitor
+from paddle_tpu.serving import (BucketSet, DeadlineExceededError,
+                                EngineConfig, EngineStoppedError,
+                                NoBucketError, ServerOverloadedError,
+                                ServingEngine, ShapeBucket,
+                                default_batch_sizes)
+
+
+@pytest.fixture()
+def monitored():
+    monitor.reset()
+    paddle.set_flags({"FLAGS_monitor": True})
+    yield monitor
+    paddle.set_flags({"FLAGS_monitor": False})
+    monitor.reset()
+
+
+def _counting_model(calls, delay=0.0):
+    def model(x):
+        calls.append(tuple(x.shape))
+        if delay:
+            time.sleep(delay)
+        return x * 2.0
+    return model
+
+
+class TestShapeBuckets:
+    def test_default_ladder(self):
+        assert default_batch_sizes(8) == (1, 2, 4, 8)
+        assert default_batch_sizes(6) == (1, 2, 4, 6)
+        assert default_batch_sizes(1) == (1,)
+
+    def test_round_up_and_pad(self):
+        b = ShapeBucket([(8,)], ["float32"], [2, 4])
+        assert b.round_up_batch(1) == 2 and b.round_up_batch(3) == 4
+        padded = b.pad_item(np.ones((1, 5), np.float32), 0)
+        assert padded.shape == (1, 8)
+        np.testing.assert_array_equal(padded[0, 5:], 0)
+
+    def test_resolve_prefers_least_padding(self):
+        bs = BucketSet(learn=False, default_batch_sizes_=(1,))
+        bs.declare([(16,)], ["float32"], [1])
+        small = bs.declare([(8,)], ["float32"], [1])
+        sig = ((( 5,), "float32"),)
+        assert bs.resolve(sig) is small
+        # dtype/rank mismatches never resolve
+        assert bs.resolve((((5,), "int32"),)) is None
+        assert bs.resolve((((5, 5), "float32"),)) is None
+
+    def test_learned_bucket_registered_once(self):
+        bs = BucketSet(learn=True, default_batch_sizes_=(1, 2))
+        sig = (((3,), "float32"),)
+        b1 = bs.resolve(sig)
+        b2 = bs.resolve(sig)
+        assert b1 is b2 and b1.learned and len(bs) == 1
+
+
+class TestDynamicBatching:
+    def test_coalesces_n_requests_into_ceil_n_over_b_batches(self, monitored):
+        """Acceptance: N single requests -> <= ceil(N/max_batch) predictor
+        invocations, asserted via the monitor counters too."""
+        calls = []
+        n, bmax = 12, 4
+        eng = ServingEngine(_counting_model(calls),
+                           EngineConfig(max_batch_size=bmax,
+                                        batch_timeout_ms=5.0,
+                                        warmup_on_start=False))
+        # enqueue BEFORE starting the worker: the coalescing bound is then
+        # deterministic, not a race against the batcher
+        futs = [eng.submit([np.full((1, 3), i, np.float32)])
+                for i in range(n)]
+        eng.start()
+        outs = [f.result(timeout=30) for f in futs]
+        eng.stop()
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o[0], np.full((1, 3), 2.0 * i))
+        assert len(calls) <= math.ceil(n / bmax)
+        assert all(s[0] <= bmax for s in calls)
+        snap = monitor.snapshot()["counters"]
+        assert snap["serving.requests"] == n
+        assert snap["serving.batches"] <= math.ceil(n / bmax)
+        assert snap["serving.compiles"] <= len(default_batch_sizes(bmax))
+
+    def test_bucket_padding_and_waste_counter(self, monitored):
+        calls = []
+        eng = ServingEngine(_counting_model(calls),
+                           EngineConfig(max_batch_size=4, batch_timeout_ms=1,
+                                        warmup_on_start=False,
+                                        learn_buckets=False))
+        eng.declare_bucket([(8,)], ["float32"], [4])
+        fut = eng.submit([np.ones((1, 5), np.float32)])
+        eng.start()
+        out = fut.result(timeout=30)
+        eng.stop()
+        # request rode the declared bucket: padded to (4, 8) on the wire
+        assert calls == [(4, 8)]
+        assert out[0].shape == (1, 8)  # rows sliced back per request
+        snap = monitor.snapshot()["counters"]
+        assert snap["serving.padded_rows"] == 3
+        assert snap["serving.padding_waste_elems"] == 4 * 8 - 5
+
+    def test_no_bucket_and_learning_disabled_rejects(self):
+        eng = ServingEngine(lambda x: x,
+                           EngineConfig(learn_buckets=False,
+                                        warmup_on_start=False))
+        with pytest.raises(NoBucketError):
+            eng.submit([np.ones((1, 3), np.float32)])
+
+    def test_request_larger_than_bucket_rejected(self):
+        eng = ServingEngine(lambda x: x,
+                           EngineConfig(max_batch_size=2,
+                                        warmup_on_start=False))
+        with pytest.raises(ValueError, match="exceeds bucket max"):
+            eng.submit([np.ones((3, 2), np.float32)])
+
+    def test_mixed_shapes_ride_separate_lanes(self, monitored):
+        calls = []
+        eng = ServingEngine(_counting_model(calls),
+                           EngineConfig(max_batch_size=4, batch_timeout_ms=5,
+                                        warmup_on_start=False))
+        futs = [eng.submit([np.ones((1, 3), np.float32)]) for _ in range(4)]
+        futs += [eng.submit([np.ones((1, 7), np.float32)]) for _ in range(4)]
+        eng.start()
+        [f.result(timeout=30) for f in futs]
+        eng.stop()
+        # one batch per shape lane — shapes never mix inside a batch
+        assert sorted(calls) == [(4, 3), (4, 7)]
+
+
+class TestRobustness:
+    def test_deadline_expires_before_dispatch(self, monitored):
+        gate = threading.Event()
+        calls = []
+
+        def gated(x):
+            calls.append(tuple(x.shape))
+            gate.wait(10)
+            return x
+
+        eng = ServingEngine(gated, EngineConfig(
+            max_batch_size=1, batch_timeout_ms=1, warmup_on_start=False))
+        eng.start()
+        f1 = eng.submit([np.ones((1, 2), np.float32)])
+        time.sleep(0.1)          # worker is now parked inside gated()
+        f2 = eng.submit([np.ones((1, 2), np.float32)], deadline_ms=30)
+        time.sleep(0.2)          # f2's deadline passes while it queues
+        gate.set()
+        assert f1.result(timeout=30)
+        with pytest.raises(DeadlineExceededError):
+            f2.result(timeout=30)
+        eng.stop()
+        # the expired request was dropped BEFORE batching: the predictor
+        # only ever saw f1
+        assert len(calls) == 1
+        snap = monitor.snapshot()["counters"]
+        assert snap["serving.deadline_expired"] == 1
+
+    def test_overload_rejection_is_explicit(self, monitored):
+        gate = threading.Event()
+
+        def gated(x):
+            gate.wait(10)
+            return x
+
+        eng = ServingEngine(gated, EngineConfig(
+            max_batch_size=1, batch_timeout_ms=1, queue_depth=2,
+            warmup_on_start=False))
+        eng.start()
+        f1 = eng.submit([np.ones((1, 2), np.float32)])
+        time.sleep(0.1)
+        queued = [eng.submit([np.ones((1, 2), np.float32)])
+                  for _ in range(2)]
+        with pytest.raises(ServerOverloadedError):
+            eng.submit([np.ones((1, 2), np.float32)])
+        gate.set()
+        assert f1.result(timeout=30) is not None
+        for f in queued:
+            assert f.result(timeout=30) is not None  # backpressure != loss
+        eng.stop()
+        snap = monitor.snapshot()["counters"]
+        assert snap["serving.rejected"] == 1
+        assert eng.stats()["counters"]["rejected"] == 1
+
+    def test_drain_on_shutdown_completes_queued_work(self):
+        calls = []
+        eng = ServingEngine(_counting_model(calls, delay=0.02),
+                           EngineConfig(max_batch_size=2, batch_timeout_ms=1,
+                                        warmup_on_start=False))
+        futs = [eng.submit([np.ones((1, 2), np.float32)]) for _ in range(6)]
+        eng.start()
+        eng.stop(drain=True)
+        assert all(f.done() for f in futs)
+        assert all(f.exception() is None for f in futs)
+        with pytest.raises(EngineStoppedError):
+            eng.submit([np.ones((1, 2), np.float32)])
+
+    def test_stop_without_drain_fails_queued_futures(self):
+        eng = ServingEngine(lambda x: x,
+                           EngineConfig(warmup_on_start=False))
+        futs = [eng.submit([np.ones((1, 2), np.float32)]) for _ in range(3)]
+        eng.stop(drain=False)  # never started: everything still queued
+        for f in futs:
+            with pytest.raises(EngineStoppedError):
+                f.result(timeout=1)
+
+    def test_model_error_lands_on_every_member_future(self):
+        def broken(x):
+            raise RuntimeError("kernel exploded")
+
+        eng = ServingEngine(broken, EngineConfig(
+            max_batch_size=4, batch_timeout_ms=5, warmup_on_start=False))
+        futs = [eng.submit([np.ones((1, 2), np.float32)]) for _ in range(3)]
+        eng.start()
+        for f in futs:
+            with pytest.raises(RuntimeError, match="kernel exploded"):
+                f.result(timeout=30)
+        eng.stop()
+        assert eng.stats()["counters"]["failed"] == 3
+
+    def test_health_stats_shape(self):
+        eng = ServingEngine(lambda x: x,
+                           EngineConfig(warmup_on_start=False))
+        st = eng.stats()
+        for key in ("running", "queue_depth", "queue_capacity", "inflight",
+                    "max_batch_size", "buckets", "counters", "workers"):
+            assert key in st
+
+
+class TestZeroRetraceSteadyState:
+    def test_warmup_then_steady_state_never_compiles(self, monitored):
+        """Acceptance: compile count <= declared (bucket x batch-size)
+        signatures, and the steady state adds ZERO jit retraces — both
+        asserted via the monitor counters."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.inference import Predictor
+        from paddle_tpu.jit import InputSpec
+
+        paddle.seed(0)
+        net = nn.Linear(16, 4)
+        net.eval()
+        pred = Predictor(net, input_spec=[InputSpec([2, 16], "float32")])
+        eng = ServingEngine(pred, EngineConfig(
+            max_batch_size=4, batch_sizes=[2, 4], batch_timeout_ms=1,
+            learn_buckets=False, warmup_on_start=True))
+        eng.start()  # warmup compiles every (bucket, batch) signature
+        snap = monitor.snapshot()["counters"]
+        warm_traces = (snap.get("jit.to_static.traces", 0),
+                       snap.get("jit.to_static.retraces", 0))
+        warm_compiles = snap["serving.compiles"]
+        assert warm_compiles <= 2  # one per declared batch size
+        # steady state: 30 requests of varying rows, all padding onto the
+        # two warmed signatures
+        rng = np.random.RandomState(0)
+        futs = [eng.submit([rng.rand(int(r), 16).astype(np.float32)])
+                for r in rng.randint(1, 5, size=30)]
+        outs = [f.result(timeout=60) for f in futs]
+        eng.stop()
+        assert all(o[0].shape[1] == 4 for o in outs)
+        snap = monitor.snapshot()["counters"]
+        assert (snap.get("jit.to_static.traces", 0),
+                snap.get("jit.to_static.retraces", 0)) == warm_traces
+        assert snap["serving.compiles"] == warm_compiles
+        # and the numerics survived the padding round-trip
+        x = np.ones((3, 16), np.float32)
+        want = pred.run_batch([np.pad(x, ((0, 1), (0, 0)))])[0][:3]
+        eng2 = ServingEngine(pred, EngineConfig(
+            max_batch_size=4, batch_sizes=[2, 4], batch_timeout_ms=1,
+            learn_buckets=False, warmup_on_start=False))
+        eng2.start()
+        got = eng2.submit([x]).result(timeout=60)[0]
+        eng2.stop()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def _save_lenet(tmp_path, batch=4):
+    from paddle_tpu import models
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit import InputSpec, save
+    paddle.seed(0)
+    net = models.LeNet(num_classes=10)
+    net.eval()
+    path = str(tmp_path / "lenet")
+    save(net, path, input_spec=[InputSpec([batch, 1, 28, 28], "float32")])
+    return create_predictor(Config(path))
+
+
+class TestServerE2E:
+    def test_concurrent_clients_coalesce_and_match_oracle(self, tmp_path,
+                                                          monitored):
+        from paddle_tpu.inference.server import (PredictorClient,
+                                                 PredictorServer)
+        pred = _save_lenet(tmp_path, batch=4)
+        srv = PredictorServer(pred, engine_config=EngineConfig(
+            max_batch_size=4, batch_timeout_ms=20)).start()
+        try:
+            x = np.random.RandomState(0).rand(1, 1, 28, 28).astype(
+                np.float32)
+            want = pred.run_batch([np.concatenate([x] * 4)])[0][:1]
+            results = {}
+
+            def client(i):
+                c = PredictorClient(srv.host, srv.port)
+                results[i] = c.run([x])
+                c.close()
+
+            n = 8
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(n)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert all(st == 0 for st, _ in results.values())
+            for st, out in results.values():
+                np.testing.assert_allclose(out[0], want, rtol=1e-5,
+                                           atol=1e-6)
+            c = PredictorClient(srv.host, srv.port)
+            health = c.health()
+            c.close()
+            # the artifact's exported signature is the ONLY compile: the
+            # warmup run covered it, concurrent serving added none
+            assert health["counters"]["compiles"] == 1
+            assert health["counters"]["warmup_runs"] == 1
+            assert health["counters"]["batches"] <= n
+            assert health["counters"]["completed"] == n
+            assert [b["batch_sizes"] for b in health["buckets"]] == [[4]]
+        finally:
+            srv.stop()
+
+    def test_overload_and_deadline_wire_statuses(self, monitored):
+        from paddle_tpu.inference.server import (PredictorClient,
+                                                 PredictorServer)
+        from paddle_tpu.utils.net import STATUS_DEADLINE, STATUS_OVERLOADED
+        gate = threading.Event()
+
+        def gated(x):
+            gate.wait(15)
+            return x * 1.0
+
+        srv = PredictorServer(gated, engine_config=EngineConfig(
+            max_batch_size=1, batch_timeout_ms=1, queue_depth=1,
+            warmup_on_start=False)).start()
+        try:
+            x = np.ones((1, 2), np.float32)
+            hold = PredictorClient(srv.host, srv.port)
+            t_hold = threading.Thread(target=lambda: hold.run([x]))
+            t_hold.start()
+            time.sleep(0.2)      # worker parked in gated(), queue empty
+            queued = PredictorClient(srv.host, srv.port)
+            t_q = threading.Thread(target=lambda: queued.run([x]))
+            t_q.start()
+            time.sleep(0.2)      # queue now full (depth 1)
+            c = PredictorClient(srv.host, srv.port)
+            st, msg = c.run([x])
+            assert st == STATUS_OVERLOADED and "capacity" in msg
+            # same connection stays framed after the rejection
+            st2, msg2 = c.run([x], deadline_ms=30)
+            assert st2 in (STATUS_OVERLOADED, STATUS_DEADLINE)
+            gate.set()
+            t_hold.join(timeout=30)
+            t_q.join(timeout=30)
+            for cl in (hold, queued, c):
+                cl.close()
+        finally:
+            srv.stop()
+
+    def test_health_probe(self):
+        from paddle_tpu.inference.server import (PredictorClient,
+                                                 PredictorServer)
+        srv = PredictorServer(lambda a: a * 2.0,
+                              engine_config=EngineConfig(
+                                  warmup_on_start=False)).start()
+        try:
+            c = PredictorClient(srv.host, srv.port)
+            h = c.health()
+            assert h["running"] and h["queue_depth"] == 0
+            st, out = c.run([np.arange(4, dtype=np.float32).reshape(1, 4)])
+            assert st == 0
+            np.testing.assert_allclose(out[0], [[0, 2, 4, 6]])
+            c.close()
+        finally:
+            srv.stop()
+
+
+@pytest.mark.slow
+class TestConcurrencySoak:
+    def test_burst_yields_rejections_not_hangs(self, tmp_path):
+        """Acceptance: an over-capacity burst produces explicit rejection
+        frames — never hangs or crashes — and every accepted request
+        completes correctly."""
+        from paddle_tpu.inference.server import (PredictorClient,
+                                                 PredictorServer)
+        pred = _save_lenet(tmp_path, batch=4)
+        srv = PredictorServer(pred, engine_config=EngineConfig(
+            max_batch_size=4, batch_timeout_ms=5, queue_depth=8)).start()
+        try:
+            x = np.random.RandomState(0).rand(1, 1, 28, 28).astype(
+                np.float32)
+            want = pred.run_batch([np.concatenate([x] * 4)])[0][:1]
+            statuses = []
+            lock = threading.Lock()
+
+            def client(n_reqs):
+                c = PredictorClient(srv.host, srv.port, timeout=120)
+                for _ in range(n_reqs):
+                    st, out = c.run([x])
+                    with lock:
+                        statuses.append(st)
+                    if st == 0:
+                        np.testing.assert_allclose(out[0], want,
+                                                   rtol=1e-5, atol=1e-6)
+                c.close()
+
+            ts = [threading.Thread(target=client, args=(4,))
+                  for _ in range(32)]
+            [t.start() for t in ts]
+            [t.join(timeout=300) for t in ts]
+            assert not any(t.is_alive() for t in ts), "client hang"
+            assert len(statuses) == 32 * 4
+            assert set(statuses) <= {0, 2}  # success or explicit overload
+            assert statuses.count(0) >= 1
+            h = srv.stats()
+            assert (h["counters"]["completed"] + h["counters"]["rejected"]
+                    == 32 * 4)
+        finally:
+            srv.stop()
