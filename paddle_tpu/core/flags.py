@@ -81,6 +81,32 @@ define_flag("monitor", False,
             "(platform/monitor.h STAT registry role); off = the dispatch "
             "fast path pays one module-attribute check and nothing else")
 
+# ---- resilience plane (paddle_tpu.faults + self-healing knobs) ----
+define_flag("fault_inject", "",
+            "deterministic fault-injection spec(s), ';'-separated "
+            "site:kind[:p=..][:seed=..][:times=..][:after=..] strings "
+            "(paddle_tpu.faults); empty = every injection site is one "
+            "module-attribute check")
+define_flag("ps_rpc_max_retries", 3,
+            "PS client: transport-failure retries per RPC (exponential "
+            "backoff + jitter; pushes stay exactly-once via per-client "
+            "request sequencing)")
+define_flag("ps_rpc_backoff_ms", 50.0,
+            "PS client: initial retry backoff; doubles per attempt, "
+            "capped at 2s, with up to 100% uniform jitter")
+define_flag("ps_rpc_call_timeout_s", 120.0,
+            "PS client: per-call deadline for connect + each response "
+            "read (0 = wait forever)")
+define_flag("bus_send_retries", 3,
+            "fleet message bus: reconnect-and-resend attempts per frame "
+            "before raising PeerGoneError")
+define_flag("bus_send_backoff_ms", 50.0,
+            "fleet message bus: initial reconnect backoff; doubles per "
+            "attempt, capped at 2s")
+define_flag("dataloader_max_worker_restarts", 2,
+            "DataLoader: respawns allowed per worker slot before a dead "
+            "worker becomes a hard error")
+
 # ---- serving plane (paddle_tpu.serving.EngineConfig.from_flags) ----
 define_flag("serving_max_batch_size", 8,
             "dynamic batcher: max rows coalesced into one Predictor call")

@@ -3,7 +3,8 @@ This module re-exports it so user code can `import paddle.distributed`."""
 from ..parallel import *  # noqa: F401,F403
 from ..parallel import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
-from .fleet_executor import DistModel, FleetExecutor  # noqa: F401
+from .fleet_executor import (  # noqa: F401
+    DistModel, FleetExecutor, InterceptorStuckError, PeerGoneError)
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 
 # ---- remaining reference-surface members ----

@@ -31,6 +31,43 @@ except ImportError:
     class _monitor:  # noqa: N801
         _ENABLED = False
 
+try:
+    from .. import faults as _faults
+except ImportError:
+    class _faults:  # noqa: N801 — standalone: injection plane disabled
+        _ENABLED = False
+
+try:
+    from ..core import flags as _flags
+except ImportError:
+    _flags = None
+
+
+def _bus_retry_config():
+    """(retries, backoff_s) for the bus send path; flag-driven in-package,
+    fixed defaults when spec-loaded standalone."""
+    if _flags is None:
+        return 3, 0.05
+    return (int(_flags.flag("bus_send_retries")),
+            float(_flags.flag("bus_send_backoff_ms")) / 1e3)
+
+
+class PeerGoneError(RuntimeError):
+    """A remote rank's bus endpoint is unreachable after reconnect
+    retries — the peer process is gone (crashed/killed), not slow. Raised
+    out of `DistMessageBus.send` and surfaced by `DistFleetExecutor.run`
+    instead of letting the pipeline idle into its full run timeout."""
+
+    def __init__(self, rank: int, msg: str):
+        super().__init__(msg)
+        self.rank = rank
+
+
+class InterceptorStuckError(RuntimeError):
+    """An interceptor thread outlived its join timeout — it is wedged
+    (deadlocked handler or never-delivered stop), and silently leaking it
+    would hide the hang."""
+
 
 class Message:
     __slots__ = ("src", "dst", "kind", "payload", "micro")
@@ -90,15 +127,21 @@ class Interceptor:
     def send(self, dst: int, kind: str, payload=None, micro=-1):
         self.bus.send(Message(self.iid, dst, kind, payload, micro))
 
-    def join(self, send_stop: bool = True):
+    def join(self, send_stop: bool = True, timeout: float = 120.0):
         # send_stop=False: a remote carrier owns shutdown (its broadcast
         # stop message ends the loop) — sending our own here would kill
         # the actor with microbatches still queued behind backpressure
         if send_stop:
             self.bus.send(Message(-1, self.iid, "stop"))
         if self._thread is not None:
-            self._thread.join(timeout=120)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                raise InterceptorStuckError(
+                    f"interceptor {self.iid} still alive {timeout}s after "
+                    "join — wedged handler or undelivered stop message")
         if self._error is not None:
+            if isinstance(self._error, PeerGoneError):
+                raise self._error   # typed transport verdict, not a wrap
             raise RuntimeError(
                 f"interceptor {self.iid} failed") from self._error
 
@@ -280,6 +323,8 @@ class DistMessageBus(MessageBus):
         self._pickle, self._struct, self._socket = pickle, _struct, _socket
         self.rank, self.nranks = rank, nranks
         self.owner_of = dict(owner_of)
+        self._send_retries, self._send_backoff = _bus_retry_config()
+        self._was_connected: Dict[int, bool] = {}
         self._conns: Dict[int, object] = {}
         self._conn_lock = threading.Lock()       # guards the conn MAP only
         self._peer_locks: Dict[int, threading.Lock] = {}  # serialize frames
@@ -336,6 +381,8 @@ class DistMessageBus(MessageBus):
                     if not chunk:
                         return
                     data += chunk
+                if _faults._ENABLED:
+                    _faults.check("bus.recv")
                 src, dst, kind, payload, micro = self._pickle.loads(data)
                 msg = Message(src, dst, kind, payload, micro)
                 # local delivery (register() may race: wait for the inbox)
@@ -375,8 +422,23 @@ class DistMessageBus(MessageBus):
             sk.setsockopt(self._socket.IPPROTO_TCP,
                           self._socket.TCP_NODELAY, 1)
             with self._conn_lock:
+                if self._was_connected.get(r):
+                    if _monitor._ENABLED:
+                        _monitor.count("bus.reconnects")
+                self._was_connected[r] = True
                 self._conns[r] = sk
         return sk
+
+    def _drop_conn(self, r: int):
+        # a failed send leaves the stream mid-frame: close and forget so
+        # the retry opens a FRESH connection (frames never straddle one)
+        with self._conn_lock:
+            sk = self._conns.pop(r, None)
+        if sk is not None:
+            try:
+                sk.close()
+            except OSError:
+                pass
 
     def send(self, msg: Message):
         owner = self.owner_of.get(msg.dst, self.rank)
@@ -393,12 +455,40 @@ class DistMessageBus(MessageBus):
         data = self._pickle.dumps(
             (msg.src, msg.dst, msg.kind, msg.payload, msg.micro),
             protocol=self._pickle.HIGHEST_PROTOCOL)
+        frame = self._struct.pack("<q", len(data)) + data
+        import time as _time
         with self._peer_lock(owner):
-            sk = self._remote_sock(owner)
-            sk.sendall(self._struct.pack("<q", len(data)) + data)
+            delay = self._send_backoff
+            last: Optional[BaseException] = None
+            for attempt in range(self._send_retries + 1):
+                if attempt:
+                    _time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+                try:
+                    if _faults._ENABLED:
+                        _faults.check("bus.send")
+                    sk = self._remote_sock(owner)
+                    sk.sendall(frame)
+                    return
+                except OSError as e:
+                    last = e
+                    self._drop_conn(owner)
+            raise PeerGoneError(
+                owner,
+                f"fleet bus: rank {owner} unreachable after "
+                f"{self._send_retries + 1} attempts "
+                f"({self.endpoints.get(owner, '?')}): {last}") from last
 
     def close(self):
         self._stop.set()
+        # shutdown BEFORE close: a thread blocked in accept() pins the
+        # listening socket's open file description, so close() alone
+        # leaves the port accepting (and silently swallowing) frames
+        # from peers that think this rank is still alive
+        try:
+            self._lsock.shutdown(self._socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._lsock.close()
         except OSError:

@@ -17,14 +17,20 @@ brpc client's concurrent-request role).
 """
 from __future__ import annotations
 
+import os
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from .table import DenseTable, SparseTable
+from ... import faults as _faults
+from ... import monitor as _monitor
+from ...core import flags as _flags
 
 _HDR = struct.Struct("<B16sqq")  # cmd, table name (padded), n, dim
 # payload plausibility caps (the header fields are client-controlled)
@@ -45,6 +51,17 @@ CMD_ADD_SPARSE = 10      # table-config negotiation (optimizer + accessor)
 CMD_ADD_DENSE = 11
 CMD_SAMPLE_NEIGHBORS = 12   # graph table: ids[n] -> [n, k] ids + weights
 CMD_NODE_FEAT = 13          # graph table: ids[n] -> [n, feat_dim] f32
+# Resilience extension (python plane). HELLO registers the client id for
+# this connection — the id rides the header's NAME field (no payload), so
+# a server that predates it (the native csrc/ps_server.cpp plane) answers
+# with a plain unknown-cmd error frame and the stream stays in sync; the
+# client then marks the endpoint legacy and keeps using unsequenced
+# pushes. Sequenced pushes prefix their payload with an i64 request seq;
+# the server applies each (client, seq) AT MOST ONCE, so a push retried
+# after a lost ACK cannot double-apply the gradient.
+CMD_HELLO = 14              # client id in the name field, no payload
+CMD_PUSH_SPARSE_SEQ = 15    # i64 seq + CMD_PUSH_SPARSE payload
+CMD_PUSH_DENSE_SEQ = 16     # i64 seq + CMD_PUSH_DENSE payload
 
 from .table import OPT_WIRE_IDS as _OPT_IDS  # single source, both planes
 _SPARSE_CFG = struct.Struct("<ffqBBfffffff")   # lr,std,seed,opt,ctr,b1,b2,eps,sdec,ccoef,dth,ttl
@@ -75,13 +92,14 @@ def _send_err(conn, msg: str):
     conn.sendall(_ST_ERR + _LEN.pack(len(m)) + m)
 
 
-def _check_status(sock):
-    """Read the response status byte; raise PsError on an error frame."""
-    st = _recv_exact(sock, 1)
+def _check_status(sock, deadline: Optional[float] = None):
+    """Read the response status byte; raise PsError on an error frame.
+    `deadline` (absolute monotonic) bounds the wait on a stalled peer."""
+    st = _recv_exact(sock, 1, deadline)
     if st == _ST_OK:
         return
-    (ln,) = _LEN.unpack(_recv_exact(sock, 8))
-    raise PsError(_recv_exact(sock, ln).decode())
+    (ln,) = _LEN.unpack(_recv_exact(sock, 8, deadline))
+    raise PsError(_recv_exact(sock, ln, deadline).decode())
 
 
 class PsServer:
@@ -101,6 +119,10 @@ class PsServer:
         self._barrier_cond = threading.Condition()
         self._barrier_arrived = 0
         self._barrier_gen = 0
+        # at-most-once push ledger: client id -> last applied request seq
+        # (survives the client's reconnects — that is the point)
+        self._applied_seq: Dict[str, int] = {}
+        self._seq_lock = threading.Lock()
 
     def add_sparse_table(self, name, dim, **kw):
         _tname(name)  # validate against the wire limit at registration
@@ -160,11 +182,17 @@ class PsServer:
                     f"({n_participants} participants expected)")
 
     def _handle(self, conn):
+        client_id: Optional[str] = None   # set by CMD_HELLO, per connection
         try:
             while True:
                 hdr = _recv_exact(conn, _HDR.size)
                 cmd, name, n, dim = _HDR.unpack(hdr)
                 name = name.rstrip(b"\0").decode()
+                if _faults._ENABLED:
+                    # injected conn_reset lands in the outer except and
+                    # drops this handler's connection — the server stays
+                    # up, the client reconnects and retries
+                    _faults.check("ps.server")
                 # bound the (client-controlled) payload size before any
                 # allocation: a corrupt/hostile header must produce an
                 # error frame + connection drop, not a multi-GB buffer or
@@ -178,6 +206,13 @@ class PsServer:
                 # read the FULL request payload before processing so an
                 # error reply leaves the stream in sync for the next request
                 ids = grads = None
+                req_seq = None
+                if cmd == CMD_PUSH_SPARSE_SEQ:
+                    (req_seq,) = _LEN.unpack(_recv_exact(conn, 8))
+                    cmd = CMD_PUSH_SPARSE
+                elif cmd == CMD_PUSH_DENSE_SEQ:
+                    (req_seq,) = _LEN.unpack(_recv_exact(conn, 8))
+                    cmd = CMD_PUSH_DENSE
                 if cmd == CMD_PULL_SPARSE:
                     ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
                 elif cmd == CMD_PUSH_SPARSE:
@@ -206,6 +241,24 @@ class PsServer:
                         self._barrier(int(n))
                         conn.sendall(_ST_OK)
                         continue
+                    if cmd == CMD_HELLO:
+                        client_id = name
+                        conn.sendall(_ST_OK)
+                        continue
+                    if req_seq is not None:
+                        if client_id is None:
+                            raise PsError(
+                                "ps: sequenced push before CMD_HELLO")
+                        with self._seq_lock:
+                            duplicate = req_seq <= self._applied_seq.get(
+                                client_id, 0)
+                            if not duplicate:
+                                self._applied_seq[client_id] = req_seq
+                        if duplicate:
+                            # a retry of an already-applied push: ACK
+                            # without touching the table (at-most-once)
+                            conn.sendall(_ST_OK)
+                            continue
                     if cmd == CMD_ADD_SPARSE:
                         (lr, istd, seed, opt, ctr, b1, b2, eps, sdec, ccoef,
                          dth, ttl) = _SPARSE_CFG.unpack(cfg_raw)
@@ -301,24 +354,72 @@ class PsServer:
             self._thread.join(timeout=2)
 
 
+_CLIENT_SEQ = [0]
+_CLIENT_SEQ_LOCK = threading.Lock()
+
+
+def _new_client_id() -> bytes:
+    """16-byte wire client id, unique across processes and instances
+    (pid + in-process counter, hex — fits the header's name field)."""
+    with _CLIENT_SEQ_LOCK:
+        _CLIENT_SEQ[0] += 1
+        n = _CLIENT_SEQ[0]
+    return f"{os.getpid() % 0xFFFF:04x}{n % 0xFFFF:04x}" \
+        f"{random.getrandbits(32):08x}".encode()
+
+
 class PsClient:
     """Sharded client (brpc_ps_client role): sparse ids route to server
     `id % n_servers`; dense tables are row-range sharded across all
-    servers (pull concatenates, push scatters). Transport errors
-    invalidate the cached connection so the next call reconnects."""
+    servers (pull concatenates, push scatters).
 
-    def __init__(self, endpoints: Sequence[str]):
+    Self-healing transport: a transport error invalidates the cached
+    connection, and every data-plane RPC is retried with exponential
+    backoff + jitter up to `max_retries` times, reconnecting
+    transparently (`ps.retries` / `ps.reconnects` monitor counters).
+    Pulls are idempotent and retried freely; pushes carry a per-client
+    request sequence (CMD_HELLO capability handshake per connection) so a
+    push retried after a lost ACK is applied AT MOST ONCE server-side.
+    Endpoints that reject CMD_HELLO (the native C++ plane) are marked
+    legacy and keep plain at-least-once pushes. `call_timeout` bounds
+    connect and each response read, so a stalled-but-open server raises
+    TimeoutError (feeding the retry loop) instead of hanging the caller.
+    """
+
+    def __init__(self, endpoints: Sequence[str],
+                 max_retries: Optional[int] = None,
+                 backoff_ms: Optional[float] = None,
+                 call_timeout: Optional[float] = None):
         self.endpoints = list(endpoints)
+        self.max_retries = int(_flags.flag("ps_rpc_max_retries")
+                               if max_retries is None else max_retries)
+        self.backoff_s = float(_flags.flag("ps_rpc_backoff_ms")
+                               if backoff_ms is None else backoff_ms) / 1e3
+        ct = float(_flags.flag("ps_rpc_call_timeout_s")
+                   if call_timeout is None else call_timeout)
+        self.call_timeout = ct if ct > 0 else None
         self._socks: List[Optional[socket.socket]] = [None] * len(endpoints)
         self._locks = [threading.Lock() for _ in endpoints]
         self._dims: Dict[str, int] = {}  # table -> row dim (accessor config)
         self._dense_sizes: Dict[str, list] = {}  # table -> per-server sizes
+        self._client_id = _new_client_id()
+        self._push_seq = [0] * len(endpoints)   # per-server request seq
+        self._connected_once = [False] * len(endpoints)
+        # per-CONNECTION hello state (None = not negotiated yet) and the
+        # per-ENDPOINT legacy verdict (sticky: a native server stays one)
+        self._hello_ok: List[Optional[bool]] = [None] * len(endpoints)
+        self._legacy = [False] * len(endpoints)
 
     def _sock(self, i):
         if self._socks[i] is None:
             host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=120)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self.call_timeout or 120)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._connected_once[i]:
+                if _monitor._ENABLED:
+                    _monitor.count("ps.reconnects")
+            self._connected_once[i] = True
             self._socks[i] = s
         return self._socks[i]
 
@@ -331,6 +432,58 @@ class PsClient:
             except OSError:
                 pass
             self._socks[i] = None
+        self._hello_ok[i] = None   # renegotiate on the next connection
+
+    def _deadline(self) -> Optional[float]:
+        return (time.monotonic() + self.call_timeout
+                if self.call_timeout else None)
+
+    def _retry_rpc(self, attempt_fn):
+        """Run one RPC attempt; on a transport failure (OSError family —
+        includes injected resets and recv deadlines) back off and retry.
+        Server-reported PsErrors are application failures: never retried.
+        Caller must already hold the involved per-server locks so a
+        retried push reuses its sequence numbers without interleaving."""
+        delay = self.backoff_s
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                if _monitor._ENABLED:
+                    _monitor.count("ps.retries")
+                time.sleep(delay * (1.0 + random.random()))  # full jitter
+                delay = min(delay * 2, 2.0)
+            try:
+                return attempt_fn()
+            except PsError:
+                raise
+            except OSError as e:
+                last = e
+        raise last
+
+    def _ensure_seq(self, s: int) -> bool:
+        """True when the CURRENT connection to server s has a registered
+        client id (sequenced pushes allowed). One HELLO per connection;
+        an error frame marks the endpoint legacy for good."""
+        if self._legacy[s]:
+            return False
+        sk = self._sock(s)
+        if self._hello_ok[s] is not None:
+            return self._hello_ok[s]
+        try:
+            sk.sendall(_HDR.pack(CMD_HELLO, self._client_id, 0, 0))
+            _check_status(sk, self._deadline())
+            self._hello_ok[s] = True
+        except PsError:
+            self._legacy[s] = True
+            self._hello_ok[s] = False
+        except OSError:
+            self._drop(s)
+            raise
+        return self._hello_ok[s]
+
+    def _next_push_seq(self, s: int) -> int:
+        self._push_seq[s] += 1
+        return self._push_seq[s]
 
     def _shard_sel(self, ids):
         n_srv = len(self.endpoints)
@@ -348,13 +501,15 @@ class PsClient:
         would byte-desync a reused connection)."""
         try:
             for s, sel in shards:
+                if _faults._ENABLED:
+                    _faults.check("ps.rpc.send")
                 self._sock(s).sendall(make_payload(s, sel))
         except OSError:
             for s, _ in shards:
                 self._drop(s)
             raise
 
-    def _recv_all(self, shards, recv_one):
+    def _recv_all(self, shards, recv_one, deadline: Optional[float] = None):
         """Read every shard's response even if one errors (keeps the other
         sockets in sync); re-raise the first failure afterwards."""
         first: Optional[BaseException] = None
@@ -363,7 +518,9 @@ class PsClient:
             if sk is None:
                 continue
             try:
-                _check_status(sk)
+                if _faults._ENABLED:
+                    _faults.check("ps.rpc.recv")
+                _check_status(sk, deadline)
                 if recv_one is not None:
                     recv_one(s, sel, sk)
             except OSError as e:
@@ -390,16 +547,20 @@ class PsClient:
         for s, sel in shards:
             self._locks[s].acquire()
         try:
-            self._send_all(shards, lambda s, sel: (
-                _HDR.pack(CMD_PULL_SPARSE, _tname(table), len(sel), 0)
-                + ids[sel].tobytes()))
+            def attempt():
+                deadline = self._deadline()
+                self._send_all(shards, lambda s, sel: (
+                    _HDR.pack(CMD_PULL_SPARSE, _tname(table), len(sel), 0)
+                    + ids[sel].tobytes()))
 
-            def recv_rows(s, sel, sk):
-                out[sel] = np.frombuffer(
-                    _recv_exact(sk, 4 * len(sel) * dim), np.float32
-                ).reshape(len(sel), dim)
+                def recv_rows(s, sel, sk):
+                    out[sel] = np.frombuffer(
+                        _recv_exact(sk, 4 * len(sel) * dim, deadline),
+                        np.float32).reshape(len(sel), dim)
 
-            self._recv_all(shards, recv_rows)
+                self._recv_all(shards, recv_rows, deadline)
+
+            self._retry_rpc(attempt)
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -412,14 +573,28 @@ class PsClient:
         for s, sel in shards:
             self._locks[s].acquire()
         try:
-            def payload(s, sel):
-                g = grads[sel]  # one fancy-index copy per shard
-                return (_HDR.pack(CMD_PUSH_SPARSE, _tname(table), len(sel),
-                                  g.shape[1])
-                        + ids[sel].tobytes() + g.tobytes())
+            # one seq per involved server for the WHOLE call: every retry
+            # resends the same seq, so the server applies it at most once
+            seqs = {s: self._next_push_seq(s) for s, _ in shards}
 
-            self._send_all(shards, payload)
-            self._recv_all(shards, None)
+            def attempt():
+                deadline = self._deadline()
+
+                def payload(s, sel):
+                    g = grads[sel]  # one fancy-index copy per shard
+                    if self._ensure_seq(s):
+                        return (_HDR.pack(CMD_PUSH_SPARSE_SEQ, _tname(table),
+                                          len(sel), g.shape[1])
+                                + _LEN.pack(seqs[s])
+                                + ids[sel].tobytes() + g.tobytes())
+                    return (_HDR.pack(CMD_PUSH_SPARSE, _tname(table),
+                                      len(sel), g.shape[1])
+                            + ids[sel].tobytes() + g.tobytes())
+
+                self._send_all(shards, payload)
+                self._recv_all(shards, None, deadline)
+
+            self._retry_rpc(attempt)
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -435,23 +610,27 @@ class PsClient:
         n_srv = len(self.endpoints)
         shards = [(s, None) for s in range(n_srv)]
         parts: list = [None] * n_srv
+        metas: list = [None] * n_srv
         for s, _ in shards:
             self._locks[s].acquire()
         try:
-            self._send_all(shards, lambda s, sel: _HDR.pack(
-                CMD_PULL_DENSE, _tname(table), 0, 0))
+            def attempt():
+                deadline = self._deadline()
+                self._send_all(shards, lambda s, sel: _HDR.pack(
+                    CMD_PULL_DENSE, _tname(table), 0, 0))
 
-            metas: list = [None] * n_srv
+                def recv_slice(s, sel, sk):
+                    (size,) = _LEN.unpack(_recv_exact(sk, 8, deadline))
+                    (lo,) = _LEN.unpack(_recv_exact(sk, 8, deadline))
+                    (total,) = _LEN.unpack(_recv_exact(sk, 8, deadline))
+                    metas[s] = (lo, size, total)
+                    parts[s] = np.frombuffer(
+                        _recv_exact(sk, 4 * size, deadline),
+                        np.float32).copy()
 
-            def recv_slice(s, sel, sk):
-                (size,) = _LEN.unpack(_recv_exact(sk, 8))
-                (lo,) = _LEN.unpack(_recv_exact(sk, 8))
-                (total,) = _LEN.unpack(_recv_exact(sk, 8))
-                metas[s] = (lo, size, total)
-                parts[s] = np.frombuffer(_recv_exact(sk, 4 * size),
-                                         np.float32).copy()
+                self._recv_all(shards, recv_slice, deadline)
 
-            self._recv_all(shards, recv_slice)
+            self._retry_rpc(attempt)
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -494,10 +673,24 @@ class PsClient:
         for s, _ in shards:
             self._locks[s].acquire()
         try:
-            self._send_all(shards, lambda s, sel: (
-                _HDR.pack(CMD_PUSH_DENSE, _tname(table), sel[1] - sel[0], 0)
-                + g[sel[0]:sel[1]].tobytes()))
-            self._recv_all(shards, None)
+            seqs = {s: self._next_push_seq(s) for s, _ in shards}
+
+            def attempt():
+                deadline = self._deadline()
+
+                def payload(s, sel):
+                    body = g[sel[0]:sel[1]].tobytes()
+                    if self._ensure_seq(s):
+                        return (_HDR.pack(CMD_PUSH_DENSE_SEQ, _tname(table),
+                                          sel[1] - sel[0], 0)
+                                + _LEN.pack(seqs[s]) + body)
+                    return (_HDR.pack(CMD_PUSH_DENSE, _tname(table),
+                                      sel[1] - sel[0], 0) + body)
+
+                self._send_all(shards, payload)
+                self._recv_all(shards, None, deadline)
+
+            self._retry_rpc(attempt)
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -512,11 +705,15 @@ class PsClient:
         for s, sel in shards:
             self._locks[s].acquire()
         try:
-            self._send_all(shards, lambda s, sel: (
-                _HDR.pack(CMD_PUSH_SHOW_CLICK, _tname(table), len(sel), 0)
-                + ids[sel].tobytes() + shows[sel].tobytes()
-                + clicks[sel].tobytes()))
-            self._recv_all(shards, None)
+            def attempt():
+                deadline = self._deadline()
+                self._send_all(shards, lambda s, sel: (
+                    _HDR.pack(CMD_PUSH_SHOW_CLICK, _tname(table), len(sel), 0)
+                    + ids[sel].tobytes() + shows[sel].tobytes()
+                    + clicks[sel].tobytes()))
+                self._recv_all(shards, None, deadline)
+
+            self._retry_rpc(attempt)
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -528,14 +725,18 @@ class PsClient:
         for s, _ in shards:
             self._locks[s].acquire()
         try:
-            self._send_all(shards, lambda s, sel: _HDR.pack(
-                cmd, _tname(table), 0, 0))
+            def attempt():
+                deadline = self._deadline()
+                self._send_all(shards, lambda s, sel: _HDR.pack(
+                    cmd, _tname(table), 0, 0))
 
-            def recv_one(s, sel, sk):
-                if recv_extra is not None:
-                    outs[s] = recv_extra(sk)
+                def recv_one(s, sel, sk):
+                    if recv_extra is not None:
+                        outs[s] = recv_extra(sk)
 
-            self._recv_all(shards, recv_one)
+                self._recv_all(shards, recv_one, deadline)
+
+            self._retry_rpc(attempt)
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -652,7 +853,9 @@ class PsClient:
             try:
                 sk = self._sock(0)
                 sk.sendall(_HDR.pack(CMD_BARRIER, _tname(""), n_trainers, 0))
-                _check_status(sk)
+                # the ACK is legitimately held until all trainers arrive;
+                # bound the wait by the server's own barrier timeout
+                _check_status(sk, time.monotonic() + _BARRIER_TIMEOUT + 30)
             except OSError:
                 self._drop(0)
                 raise

@@ -17,12 +17,38 @@ gives kill-and-resume.
 """
 from __future__ import annotations
 
+import io
 import json
 import os
-from typing import Callable, Dict, Iterator, Optional
+import zlib
+from typing import Callable, Dict, Iterator, Optional, Tuple
 
 import numpy as np
 import jax
+
+from .. import faults as _faults
+from .. import monitor as _monitor
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Checkpoint data failed checksum/structure verification and no
+    intact fallback generation exists."""
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + rename: the final name either holds the complete
+    bytes or does not exist — a crash mid-write can never leave a
+    half-written file under the committed name."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -40,10 +66,24 @@ def save_sharded(state: Dict[str, object], dirname: str,
                  process_index: Optional[int] = None):
     """Write this process's addressable shards of every array in `state`
     (values: jax arrays / Tensors / numpy). Layout:
-    dirname/manifest.json + dirname/shards-p<proc>.npz"""
+    dirname/manifest-p<proc>.json + dirname/shards-p<proc>-v<N>.npz
+
+    Crash-atomic commit protocol: the shard file is written under a NEW
+    versioned name (tmp + fsync + rename), then the manifest — the commit
+    record, carrying the shard file name plus whole-file and per-shard
+    CRC32 checksums — atomically replaces the previous one. A crash at
+    any point leaves the previous manifest pointing at its intact shard
+    file, so `load_sharded` always finds a complete snapshot. The
+    previous generation is kept as `manifest-p<proc>.json.bak` (+ its
+    shard file) and is the corruption fallback; older generations are
+    garbage-collected after a successful commit."""
     os.makedirs(dirname, exist_ok=True)
     proc = jax.process_index() if process_index is None else process_index
-    manifest = {"arrays": {}, "process_count": jax.process_count()}
+    mpath = os.path.join(dirname, f"manifest-p{proc}.json")
+    prev = _read_manifest(mpath)
+    version = int(prev.get("version", 0)) + 1 if prev else 1
+    manifest = {"arrays": {}, "process_count": jax.process_count(),
+                "version": version}
     blobs = {}
     for name, v in state.items():
         arr = getattr(v, "_value", v)
@@ -58,30 +98,119 @@ def save_sharded(state: Dict[str, object], dirname: str,
                 blobs[key] = np.asarray(sh.data)
                 manifest["arrays"][name].setdefault("shards", []).append(
                     {"key": key,
-                     "index": [[s.start or 0, s.stop] for s in sh.index]})
+                     "index": [[s.start or 0, s.stop] for s in sh.index],
+                     "crc": _crc(blobs[key].tobytes())})
         else:
             blobs[f"{name}::full"] = np.asarray(arr)
             manifest["arrays"][name]["shards"] = [
-                {"key": f"{name}::full", "index": None}]
-    np.savez(os.path.join(dirname, f"shards-p{proc}.npz"), **blobs)
-    with open(os.path.join(dirname, f"manifest-p{proc}.json"), "w") as f:
-        json.dump(manifest, f)
+                {"key": f"{name}::full", "index": None,
+                 "crc": _crc(blobs[f"{name}::full"].tobytes())}]
+    buf = io.BytesIO()
+    np.savez(buf, **blobs)
+    data = buf.getvalue()
+    shard_file = f"shards-p{proc}-v{version}.npz"
+    manifest["shard_file"] = shard_file
+    manifest["file_crc"] = _crc(data)   # of the INTENDED bytes: a torn
+    if _faults._ENABLED:                # write below must fail the check
+        data = _faults.mangle("ckpt.write", data)
+    _atomic_write(os.path.join(dirname, shard_file), data)
+    if _faults._ENABLED:
+        # deterministic crash point BETWEEN data and commit: the manifest
+        # still references the previous generation
+        _faults.check("ckpt.commit")
+    if os.path.exists(mpath):           # keep one fallback generation
+        import shutil
+        shutil.copyfile(mpath, mpath + ".bak")
+    _atomic_write(mpath, json.dumps(manifest).encode())
+    _gc_shard_files(dirname, proc, keep={shard_file,
+                                         prev.get("shard_file", "")})
+
+
+def _read_manifest(mpath: str) -> dict:
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _gc_shard_files(dirname: str, proc, keep) -> None:
+    import glob
+    for path in glob.glob(os.path.join(dirname, f"shards-p{proc}-v*.npz")):
+        if os.path.basename(path) not in keep:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def _load_verified(dirname: str, mpath: str) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Load one manifest + its shard file, verifying the whole-file CRC
+    and every per-shard CRC; any mismatch/unreadability raises
+    CheckpointCorruptError. Legacy (pre-checksum) manifests load
+    unverified."""
+    manifest = _read_manifest(mpath)
+    if not manifest:
+        raise CheckpointCorruptError(f"unreadable manifest {mpath}")
+    proc = os.path.basename(mpath)[len("manifest-p"):].split(".", 1)[0]
+    fname = manifest.get("shard_file", f"shards-p{proc}.npz")
+    path = os.path.join(dirname, fname)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(
+            f"missing shard file {path}") from e
+    if "file_crc" in manifest and _crc(raw) != manifest["file_crc"]:
+        raise CheckpointCorruptError(
+            f"shard file {path} failed its checksum (torn/corrupt write)")
+    try:
+        npz = np.load(io.BytesIO(raw))
+        blobs = {}
+        for name, meta in manifest["arrays"].items():
+            for sh in meta.get("shards", []):
+                blob = npz[sh["key"]]
+                if "crc" in sh and _crc(
+                        np.ascontiguousarray(blob).tobytes()) != sh["crc"]:
+                    raise CheckpointCorruptError(
+                        f"shard {sh['key']} in {path} failed its checksum")
+                blobs[sh["key"]] = blob
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:   # zip/pickle/KeyError-level damage
+        raise CheckpointCorruptError(
+            f"shard file {path} is unreadable: {e}") from e
+    return manifest, blobs
 
 
 def load_sharded(dirname: str, shardings: Optional[Dict] = None,
                  ) -> Dict[str, np.ndarray]:
     """Reassemble arrays from every process's shard files; if `shardings`
-    maps name -> jax Sharding, arrays are device_put with it."""
+    maps name -> jax Sharding, arrays are device_put with it.
+
+    Every shard file is checksum-verified against its manifest; on
+    corruption (torn write, bit rot) the loader falls back to the
+    previous committed generation (`manifest-p*.json.bak`, kept by
+    `save_sharded`), counting `ckpt.fallbacks` — only when no generation
+    is intact does it raise CheckpointCorruptError."""
     import glob
     arrays: Dict[str, np.ndarray] = {}
     manifests = sorted(glob.glob(os.path.join(dirname, "manifest-p*.json")))
     if not manifests:
         raise FileNotFoundError(f"no sharded checkpoint in {dirname}")
     for mpath in manifests:
-        with open(mpath) as f:
-            manifest = json.load(f)
-        proc = os.path.basename(mpath)[len("manifest-p"):-len(".json")]
-        blobs = np.load(os.path.join(dirname, f"shards-p{proc}.npz"))
+        try:
+            manifest, blobs = _load_verified(dirname, mpath)
+        except CheckpointCorruptError as e:
+            bak = mpath + ".bak"
+            if not os.path.exists(bak):
+                raise
+            if _monitor._ENABLED:
+                _monitor.count("ckpt.fallbacks")
+            import warnings
+            warnings.warn(f"sharded checkpoint: {e}; falling back to the "
+                          f"previous committed generation ({bak})")
+            manifest, blobs = _load_verified(dirname, bak)
         for name, meta in manifest["arrays"].items():
             want = _np_dtype(meta["dtype"])
             if name not in arrays:
@@ -149,6 +278,12 @@ class AutoCheckpoint:
             tmp = self._status_path() + ".tmp"
             with open(tmp, "w") as f:
                 json.dump({"epoch": epoch + 1, "snapshot": snap}, f)
+                f.flush()
+                os.fsync(f.fileno())   # the commit record must be durable
+            if _faults._ENABLED:
+                # crash point between snapshot and commit: the interrupted
+                # epoch replays exactly once on resume
+                _faults.check("ckpt.commit")
             os.replace(tmp, self._status_path())  # atomic commit
             prev = os.path.join(self.dirname, f"snapshot-{epoch}")
             if os.path.isdir(prev):

@@ -25,6 +25,7 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -46,11 +47,11 @@ from ..utils.net import (  # noqa: E402
     recv_exact as _recv_exact, send_status_frame)
 
 
-def _read_tensor(conn) -> np.ndarray:
-    dt, ndim = struct.unpack("<II", _recv_exact(conn, 8))
+def _read_tensor(conn, deadline: Optional[float] = None) -> np.ndarray:
+    dt, ndim = struct.unpack("<II", _recv_exact(conn, 8, deadline))
     if dt not in _DTYPES or ndim > _MAX_NDIM:
         raise ValueError(f"bad tensor header dtype={dt} ndim={ndim}")
-    dims = struct.unpack(f"<{ndim}q", _recv_exact(conn, 8 * ndim))
+    dims = struct.unpack(f"<{ndim}q", _recv_exact(conn, 8 * ndim, deadline))
     dtype = _DTYPES[dt]
     if any(d < 0 for d in dims):
         raise ValueError(f"bad tensor dims {dims}")
@@ -58,7 +59,7 @@ def _read_tensor(conn) -> np.ndarray:
     nbytes = count * dtype().itemsize
     if nbytes > _MAX_TENSOR_BYTES:
         raise ValueError(f"tensor payload {nbytes} bytes exceeds cap")
-    payload = _recv_exact(conn, nbytes)
+    payload = _recv_exact(conn, nbytes, deadline)
     return np.frombuffer(payload, dtype).reshape(dims).copy()
 
 
@@ -81,6 +82,11 @@ class PredictorServer:
     # handler threads park on the response future at most this long — a
     # wedged predictor must not leak handler threads forever
     _RESULT_TIMEOUT_S = 600.0
+    # once a request's magic arrives, the REST of the frame must follow
+    # within this budget — a client that stalls (not closes) mid-request
+    # must not pin a handler thread forever (idle BETWEEN requests is
+    # fine and unbounded)
+    _READ_TIMEOUT_S = 60.0
 
     def __init__(self, predictor, host="127.0.0.1", port=0,
                  engine: Optional[ServingEngine] = None,
@@ -122,15 +128,16 @@ class PredictorServer:
             conn.sendall(struct.pack("<IB", _RESP_MAGIC, STATUS_OK)
                          + struct.pack("<I", len(payload)) + payload)
             return True
+        read_deadline = time.monotonic() + self._READ_TIMEOUT_S
         deadline_ms = None
         if magic == _REQ_DEADLINE_MAGIC:
-            dl, = struct.unpack("<I", _recv_exact(conn, 4))
+            dl, = struct.unpack("<I", _recv_exact(conn, 4, read_deadline))
             deadline_ms = float(dl) if dl else None
         elif magic != _REQ_MAGIC:
             return False  # protocol violation: drop the connection
-        n, = struct.unpack("<I", _recv_exact(conn, 4))
+        n, = struct.unpack("<I", _recv_exact(conn, 4, read_deadline))
         try:
-            inputs = [_read_tensor(conn) for _ in range(n)]
+            inputs = [_read_tensor(conn, read_deadline) for _ in range(n)]
         except ValueError as e:
             # header was bad: stream unrecoverable, report + close
             send_status_frame(conn, STATUS_ERROR, str(e))

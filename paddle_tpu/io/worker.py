@@ -17,11 +17,17 @@ from __future__ import annotations
 import multiprocessing as mp
 import queue as pyqueue
 import threading
+import time
 from multiprocessing import shared_memory
 
 import numpy as np
 
+from .. import faults as _faults
+from .. import monitor as _monitor
+from ..core import flags as _flags
+
 _SENTINEL = None
+_DONE = "__worker_done__"   # clean worker exit marker: (_DONE, worker_id)
 
 
 def _untrack(name):
@@ -91,8 +97,12 @@ def _from_shm(obj, opened):
 
 def _worker_loop(dataset, index_queue, result_queue, collate_fn,
                  use_shared_memory, worker_id, worker_init_fn,
-                 num_workers=1):
+                 num_workers=1, reset_fault_sites=()):
     """Runs in the child process. numpy only — no jax."""
+    # A RESPAWNED worker must not inherit the fork-copied worker-kill
+    # fault spec that killed its predecessor — it would die forever.
+    for site_name in reset_fault_sites:
+        _faults.clear_site(site_name)
     # publish worker metadata for get_worker_info (IterableDataset shards)
     try:
         from . import WorkerInfo, _WORKER_INFO
@@ -106,9 +116,14 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn,
     while True:
         item = index_queue.get()
         if item is _SENTINEL:
-            result_queue.put(_SENTINEL)
+            result_queue.put((_DONE, worker_id, None))
             return
         seq, indices = item
+        # OUTSIDE the try: an injected fault here escapes the loop and
+        # kills the worker PROCESS abruptly (nonzero exit, nothing shipped
+        # to the parent) — exactly the failure mode respawn must cover
+        if _faults._ENABLED:
+            _faults.check("dataloader.worker")
         try:
             batch = collate([dataset[i] for i in indices])
             if use_shared_memory:
@@ -126,42 +141,110 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn,
 
 
 class MultiprocessIter:
-    """Ordered multiprocess prefetch iterator (dataloader_iter.py role)."""
+    """Ordered multiprocess prefetch iterator (dataloader_iter.py role).
+
+    Self-healing: each worker owns a PRIVATE index queue and the parent
+    records every (seq, indices) assignment until its batch arrives. A
+    worker that dies mid-epoch (OOM-kill, injected fault, segfault) is
+    detected by exitcode polling, respawned into a FRESH queue, and its
+    outstanding assignments are re-enqueued — the epoch completes with
+    every batch exactly once (duplicates a dying worker already shipped
+    are dropped by seq), instead of the parent hanging on the result
+    queue. Respawns per worker slot are bounded by
+    FLAGS_dataloader_max_worker_restarts; past that the death is a hard
+    error. Each respawn counts `dataloader.worker_restarts`."""
+
+    _POLL_S = 0.5   # result-queue poll granularity for death detection
 
     def __init__(self, loader):
         self.loader = loader
-        ctx = mp.get_context("fork")
+        self._ctx = mp.get_context("fork")
         n = loader.num_workers
-        self._index_queue = ctx.Queue()
-        self._result_queue = ctx.Queue()
+        self._result_queue = self._ctx.Queue()
+        self._index_queues = []
         self._workers = []
         self._pending = {}
         self._emit = 0
         self._seq = 0
-        self._done_workers = 0
         self._n_workers = n
         self._alive = True
         self._timeout = loader.timeout or None
+        self._lock = threading.Lock()       # assignments + queue swaps
+        self._assigned = [dict() for _ in range(n)]  # wid -> {seq: indices}
+        self._finished = [False] * n        # clean sentinel-exit seen
+        self._restarts = [0] * n
+        self._max_restarts = int(_flags.flag(
+            "dataloader_max_worker_restarts"))
+        self._feed_done = False
         for wid in range(n):
-            p = ctx.Process(
-                target=_worker_loop,
-                args=(loader.dataset, self._index_queue, self._result_queue,
-                      loader.worker_collate_fn, loader.use_shared_memory, wid,
-                      loader.worker_init_fn, n),
-                daemon=True)
-            p.start()
-            self._workers.append(p)
+            self._index_queues.append(self._ctx.Queue())
+            self._workers.append(self._spawn(wid))
         self._feeder = threading.Thread(target=self._feed, daemon=True)
         self._feeder.start()
 
+    def _spawn(self, wid, respawn=False):
+        p = self._ctx.Process(
+            target=_worker_loop,
+            args=(self.loader.dataset, self._index_queues[wid],
+                  self._result_queue, self.loader.worker_collate_fn,
+                  self.loader.use_shared_memory, wid,
+                  self.loader.worker_init_fn, self._n_workers),
+            kwargs=dict(reset_fault_sites=("dataloader.worker",)
+                        if respawn else ()),
+            daemon=True)
+        p.start()
+        return p
+
     def _feed(self):
         for indices in self.loader.batch_sampler:
-            self._index_queue.put((self._seq, list(indices)))
+            indices = list(indices)
+            wid = self._seq % self._n_workers
+            with self._lock:
+                self._assigned[wid][self._seq] = indices
+                self._index_queues[wid].put((self._seq, indices))
             self._seq += 1
-        for _ in range(self._n_workers):
-            self._index_queue.put(_SENTINEL)
+        with self._lock:
+            self._feed_done = True
+            for q in self._index_queues:
+                q.put(_SENTINEL)
+
+    def _respawn_dead_worker(self, wid):
+        """Replace a dead worker: fresh index queue seeded with every
+        assignment it still owed (the abandoned queue may hold some of
+        them too — re-sending all is safe, the parent dedups by seq)."""
+        self._restarts[wid] += 1
+        if self._restarts[wid] > self._max_restarts:
+            self._shutdown()
+            raise RuntimeError(
+                f"DataLoader worker {wid} died (exitcode "
+                f"{self._workers[wid].exitcode}) and exhausted its "
+                f"{self._max_restarts} respawns "
+                "(FLAGS_dataloader_max_worker_restarts)")
+        if _monitor._ENABLED:
+            _monitor.count("dataloader.worker_restarts")
+        with self._lock:
+            self._index_queues[wid] = self._ctx.Queue()
+            for seq, indices in sorted(self._assigned[wid].items()):
+                self._index_queues[wid].put((seq, indices))
+            if self._feed_done:
+                self._index_queues[wid].put(_SENTINEL)
+        self._workers[wid] = self._spawn(wid, respawn=True)
+
+    def _check_workers(self):
+        for wid, p in enumerate(self._workers):
+            if p.exitcode is not None and not self._finished[wid]:
+                with self._lock:
+                    owes = bool(self._assigned[wid]) or not self._feed_done
+                if not owes:
+                    # died after handing over everything it was assigned
+                    # (e.g. killed while idle): nothing to recover
+                    self._finished[wid] = True
+                    continue
+                self._respawn_dead_worker(wid)
 
     def __next__(self):
+        deadline = (time.monotonic() + self._timeout) \
+            if self._timeout else None
         while True:
             if self._emit in self._pending:
                 desc, err = self._pending.pop(self._emit)
@@ -179,21 +262,35 @@ class MultiprocessIter:
                     except FileNotFoundError:
                         pass
                 return self.loader._post_collate(batch)
-            if self._done_workers >= self._n_workers:
-                if self._emit in self._pending:
-                    continue
+            # epoch complete: every fed batch has been emitted (robust to
+            # sentinel loss/duplication across respawns)
+            if self._feed_done and self._emit >= self._seq:
                 self._shutdown()
                 raise StopIteration
             try:
-                item = self._result_queue.get(timeout=self._timeout)
+                poll = self._POLL_S
+                if deadline is not None:
+                    poll = min(poll, max(0.0, deadline - time.monotonic()))
+                item = self._result_queue.get(timeout=poll)
             except pyqueue.Empty:
-                self._shutdown()
-                raise RuntimeError(
-                    f"DataLoader timed out after {self._timeout}s")
-            if item is _SENTINEL:
-                self._done_workers += 1
+                if deadline is not None and time.monotonic() >= deadline:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self._timeout}s")
+                self._check_workers()   # dead worker? respawn + re-enqueue
+                continue
+            if item[0] == _DONE:   # == : the marker crosses a pickle hop
+                self._finished[item[1]] = True
                 continue
             seq, desc, err = item
+            if seq < self._emit or seq in self._pending:
+                # duplicate from a worker that died after shipping (its
+                # batches were conservatively re-enqueued): reclaim + drop
+                if err is None and self.loader.use_shared_memory:
+                    self._unlink_desc(desc)
+                continue
+            with self._lock:
+                self._assigned[seq % self._n_workers].pop(seq, None)
             self._pending[seq] = (desc, err)
 
     def __iter__(self):
@@ -238,7 +335,8 @@ class MultiprocessIter:
                     item = self._result_queue.get_nowait()
                 except (pyqueue.Empty, OSError, ValueError):
                     break
-                if item is not _SENTINEL and item[2] is None:
+                if (item is not _SENTINEL and item[0] != _DONE
+                        and item[2] is None):
                     self._unlink_desc(item[1])
 
     def __del__(self):

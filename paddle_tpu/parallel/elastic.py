@@ -22,6 +22,9 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
+from .. import faults as _faults
+from .. import monitor as _monitor
+
 
 class ElasticManager:
     """Lease-based membership over a TCPStore (manager.py:130 role)."""
@@ -44,14 +47,26 @@ class ElasticManager:
         return self
 
     def _beat(self):
+        if _faults._ENABLED:
+            _faults.check("elastic.heartbeat")
         self.store.set(f"lease:{self.rank}", repr(time.time()))
 
     def _run(self):
+        # a TRANSIENT store error (blip, injected fault) must not kill the
+        # heartbeat thread — that would turn a one-interval hiccup into a
+        # permanent lease expiry. Retry next interval; only give up once
+        # the failures alone would have expired the lease anyway.
+        misses = 0
         while not self._stop.wait(self.heartbeat_interval):
             try:
                 self._beat()
+                misses = 0
             except Exception:
-                return  # store gone: the watcher will see our lease expire
+                misses += 1
+                if _monitor._ENABLED:
+                    _monitor.count("elastic.heartbeat_errors")
+                if misses * self.heartbeat_interval > self.lease_ttl * 3:
+                    return  # store genuinely gone: lease is long expired
 
     def stop(self):
         self._stop.set()
@@ -65,7 +80,10 @@ class ElasticManager:
         for r in range(self.world_size):
             try:
                 ts = float(self.store.get(f"lease:{r}").decode())
-            except KeyError:
+            except (KeyError, ValueError):
+                # missing OR undecodable (truncated/garbled write) lease ==
+                # expired; a corrupt value must not crash the watcher
+                # thread (same contract pending_joins already applies)
                 continue
             if now - ts <= self.lease_ttl:
                 alive.append(r)

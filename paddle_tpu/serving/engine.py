@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import faults as _faults
 from .. import monitor as _monitor
 from ..core import flags as _flags
 from .bucket import BucketSet, ShapeBucket, default_batch_sizes, signature_of
@@ -441,6 +442,11 @@ class ServingEngine:
                                    signature=[f"{s}:{d}" for s, d in
                                               bucket.signature])
         with self._dispatch_lock:
+            if _faults._ENABLED:
+                # injected dispatch failure fails THIS batch's futures
+                # (via _dispatch's error path) — the engine itself keeps
+                # serving; chaos runs verify exactly that containment
+                _faults.check("serving.dispatch")
             with _monitor.span("serving.predict"):
                 return [np.asarray(o) for o in self._call(arrays)]
 

@@ -10,6 +10,7 @@ instead of riding the generic error status.
 from __future__ import annotations
 
 import struct
+import time
 
 # response status byte of the inference wire protocol
 STATUS_OK = 0            # payload: u32 n_tensors + tensors
@@ -30,13 +31,38 @@ def send_status_frame(sock, status: int, msg: bytes | str = b"") -> None:
                  + struct.pack("<I", len(msg)) + msg)
 
 
-def recv_exact(sock, n: int) -> bytes:
+def recv_exact(sock, n: int, deadline: float | None = None) -> bytes:
+    """Read exactly n bytes. `deadline` (absolute `time.monotonic()`
+    seconds) bounds the TOTAL wait: a peer that stalls without closing —
+    invisible to a plain blocking recv — raises TimeoutError instead of
+    hanging the reader forever. The socket's own timeout is restored on
+    exit, so callers with persistent connections are unaffected."""
     if n < 0:
         raise ValueError(f"recv_exact: negative length {n}")
     buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf.extend(chunk)
+    old_timeout = sock.gettimeout() if deadline is not None else None
+    try:
+        while len(buf) < n:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"recv_exact: deadline exceeded with "
+                        f"{n - len(buf)} of {n} bytes outstanding")
+                sock.settimeout(remaining)
+            try:
+                chunk = sock.recv(n - len(buf))
+            except TimeoutError:  # socket.timeout aliases this on 3.10+
+                raise TimeoutError(
+                    f"recv_exact: peer stalled with {n - len(buf)} of {n} "
+                    "bytes outstanding") from None
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf.extend(chunk)
+    finally:
+        if deadline is not None:
+            try:
+                sock.settimeout(old_timeout)
+            except OSError:
+                pass
     return bytes(buf)

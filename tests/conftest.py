@@ -27,6 +27,15 @@ def pytest_configure(config):
     # marker and only run in the full suite
     config.addinivalue_line(
         "markers", "slow: long-running soak tests, deselected in tier-1")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests (paddle_tpu.faults); "
+        "auto-applied to everything in test_faults.py")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if os.path.basename(str(item.fspath)) == "test_faults.py":
+            item.add_marker(pytest.mark.chaos)
 
 
 @pytest.fixture(autouse=True)
@@ -35,3 +44,30 @@ def _seed_all():
     import paddle_tpu as paddle
     paddle.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    """An injection spec leaking out of one test would fail arbitrary
+    later tests with injected resets — assert FLAGS_fault_inject and the
+    programmatic registry are back to their pre-test state after EVERY
+    test (and restore them, so one offender cannot cascade)."""
+    from paddle_tpu import faults
+    from paddle_tpu.core import flags as _flags
+    flag_before = _flags.flag("fault_inject")
+    active_before = faults.active()
+    yield
+    flag_after = _flags.flag("fault_inject")
+    active_after = faults.active()
+    if flag_after != flag_before:
+        _flags.set_flags({"fault_inject": flag_before})
+    if active_after != active_before:
+        faults.clear(flag_specs=False, programmatic=True)
+        if flag_before:
+            _flags.set_flags({"fault_inject": flag_before})
+    assert flag_after == flag_before, (
+        f"FLAGS_fault_inject leaked out of the test: {flag_after!r} "
+        f"(was {flag_before!r})")
+    assert active_after == active_before, (
+        f"fault specs leaked out of the test: {active_after} "
+        f"(was {active_before})")

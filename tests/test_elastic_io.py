@@ -109,6 +109,58 @@ class TestElastic:
         assert res.restarts == 1
 
 
+class TestElasticFailureBudget:
+    """`launch_elastic` restart accounting: a member exiting non-zero
+    consumes exactly one restart from the failure budget, a scale-out
+    re-rendezvous consumes none, and an exhausted budget surfaces as a
+    failed result — not an endless relaunch loop."""
+
+    def test_scale_out_consumes_no_restart_budget(self, tmp_path):
+        from paddle_tpu._native import TCPStore
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        # a pending join forces a re-rendezvous at world size 3; with
+        # max_restarts=0 the run can only succeed if that scale event
+        # leaves the failure budget untouched
+        ElasticManager(store, rank=-1, world_size=0).announce_join("node-B")
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            if int(os.environ["PADDLE_TRAINERS_NUM"]) == 2:
+                time.sleep(60)   # pre-scale gang: killed by re-rendezvous
+            sys.exit(0)
+        """))
+        res = launch_elastic(str(script), nprocs=2, max_restarts=0,
+                             timeout=90, store=store, max_np=3)
+        assert res.success, res.returncodes
+        assert res.restarts == 0          # scale-out is budget-free
+        assert len(res.returncodes) == 3  # final gang ran at world size 3
+
+    def test_nonzero_exit_consumes_exactly_one_restart(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            if int(os.environ["PADDLE_ELASTIC_RESTART_COUNT"]) == 0:
+                sys.exit(23)   # first launch: one member fails
+            sys.exit(0)
+        """))
+        res = launch_elastic(str(script), nprocs=2, max_restarts=2,
+                             timeout=60)
+        assert res.success
+        assert res.restarts == 1          # one failure == one restart
+
+    def test_exhausted_budget_reports_failure(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""
+            import sys
+            sys.exit(3)        # every launch fails
+        """))
+        res = launch_elastic(str(script), nprocs=2, max_restarts=1,
+                             timeout=60)
+        assert not res.success
+        assert res.restarts == 1          # stopped AT the budget
+        assert any(rc != 0 for rc in res.returncodes)
+
+
 class TestElasticScaleOut:
     """World-size-change events (reference fleet/elastic/manager.py:215-266):
     a NEW node joining triggers re-rendezvous with a larger gang, and
