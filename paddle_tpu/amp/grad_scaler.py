@@ -16,6 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import monitor as _monitor
 from ..core.tensor import Tensor
 
 
@@ -94,6 +95,8 @@ class GradScaler:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        elif _monitor._ENABLED:
+            _monitor.count("amp.skipped_steps")
         self._opt_states[id(optimizer)] = self._STEPPED
         # Auto-advance the scale only once every optimizer seen this round
         # has stepped — a second optimizer still in UNSCALED state must keep
@@ -116,20 +119,31 @@ class GradScaler:
             if self._bad_steps >= self._decr_every:
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
+                if _monitor._ENABLED:
+                    _monitor.count("amp.scale_updates")
         else:
             self._good_steps += 1
             self._bad_steps = 0
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+                if _monitor._ENABLED:
+                    _monitor.count("amp.scale_updates")
         self._found_inf = False
 
     def state_dict(self):
+        """Round-trips the FULL dynamic-scaling state: the scale, both
+        streak counters AND the pending found_inf of an unscale_ whose
+        step()/update() had not landed yet — so a guard checkpoint cut
+        between unscale_ and step resumes with the identical
+        grow/shrink trajectory."""
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
-                "decr_count": self._bad_steps}
+                "decr_count": self._bad_steps,
+                "found_inf": bool(self._found_inf)}
 
     def load_state_dict(self, state_dict):
         self._scale = state_dict.get("scale", self._scale)
         self._good_steps = state_dict.get("incr_count", 0)
         self._bad_steps = state_dict.get("decr_count", 0)
+        self._found_inf = bool(state_dict.get("found_inf", False))

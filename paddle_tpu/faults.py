@@ -9,7 +9,11 @@ the framework (PS RPC, fleet message bus, elastic heartbeat, DataLoader
 workers, serving dispatch, checkpoint I/O) carries a *named injection
 site*, and a flag-gated registry decides — deterministically — whether a
 given site hit turns into a connection reset, a timeout, a delay, or a
-torn write.
+torn write. The training guard plane (`paddle_tpu.guard`) adds the loop
+seams: `guard.step` (inside the supervised train step — `delay` wedges it
+under the watchdog, `error` crashes it), `guard.snapshot` (crash point
+between a guard checkpoint's payload and its commit record) and
+`guard.snapshot.write` (torn checkpoint payload, via `mangle()`).
 
 Spec grammar (`FLAGS_fault_inject`, also `register()`/`inject()`):
 

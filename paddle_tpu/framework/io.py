@@ -55,6 +55,10 @@ def _unpack(obj, store, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
+    """Crash-atomic: the payload is fully serialized in memory, then
+    committed through sharded_io's tmp+fsync+rename path — a SIGKILL
+    mid-save (e.g. inside `hapi.ModelCheckpoint` at epoch end) can never
+    leave a torn `.pdparams`/`.pdopt` under the committed name."""
     d = os.path.dirname(os.path.abspath(path))
     if d:
         os.makedirs(d, exist_ok=True)
@@ -62,9 +66,10 @@ def save(obj, path, protocol=4, **configs):
     packed = _pack(obj, store)
     buf = _io.BytesIO()
     np.savez(buf, **store)
-    with open(path, "wb") as f:
-        pickle.dump({"__paddle_tpu__": 1, "obj": packed, "npz": buf.getvalue()},
-                    f, protocol=protocol)
+    blob = pickle.dumps({"__paddle_tpu__": 1, "obj": packed,
+                         "npz": buf.getvalue()}, protocol=protocol)
+    from .sharded_io import atomic_write
+    atomic_write(path, blob)
 
 
 def load(path, return_numpy=False, **configs):

@@ -39,16 +39,22 @@ def _crc(data: bytes) -> int:
     return zlib.crc32(data) & 0xFFFFFFFF
 
 
-def _atomic_write(path: str, data: bytes) -> None:
+def atomic_write(path: str, data: bytes) -> None:
     """tmp + fsync + rename: the final name either holds the complete
     bytes or does not exist — a crash mid-write can never leave a
-    half-written file under the committed name."""
+    half-written file under the committed name. Shared by the sharded
+    checkpoint writer, `framework.io.save` (so `hapi.ModelCheckpoint`
+    can never leave a torn `.pdparams` behind a SIGKILL), and the guard
+    plane's loop-state checkpoints (`paddle_tpu.guard.checkpoint`)."""
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+_atomic_write = atomic_write  # internal alias (pre-guard name)
 
 
 def _np_dtype(name: str) -> np.dtype:

@@ -1,0 +1,33 @@
+"""paddle_tpu.guard — the training guard plane.
+
+PR 3 made the distributed *substrate* survive faults; this package makes
+the training *loop* survive them: preemption-safe auto-resume (SIGTERM →
+finish the in-flight step → crash-atomic full-loop-state checkpoint →
+bit-identical `resume()`), a step watchdog (hung step/collective →
+`StepStalledError` with the last-known phase), a divergence guard
+(non-finite/spiking loss → rollback to the rolling last-good snapshot +
+skip, `DivergedError` after `FLAGS_guard_max_bad_steps`), and cross-rank
+desync detection (parameter-fingerprint vote over the data-parallel
+group → `RankDesyncError` naming the offender).
+
+Reference parity: PaddlePaddle's `FLAGS_check_nan_inf`
+(`details/nan_inf_utils_detail.cc`) is the divergence half; fleet's
+elastic + auto-checkpoint roles are the resume half; the
+last-good-generation + restore-exact-state discipline (JAX/Orbax style)
+is the model for resume semantics.
+"""
+from .errors import (DivergedError, GuardError, PreemptedError,  # noqa: F401
+                     RankDesyncError, StepStalledError)
+from .watchdog import StepWatchdog  # noqa: F401
+from .desync import DesyncDetector, array_crc, fingerprint  # noqa: F401
+from .checkpoint import (has_guard_state, load_guard_state,  # noqa: F401
+                         save_guard_state)
+from .supervisor import GuardConfig, TrainGuard  # noqa: F401
+
+__all__ = [
+    "GuardError", "PreemptedError", "StepStalledError", "DivergedError",
+    "RankDesyncError",
+    "GuardConfig", "TrainGuard", "StepWatchdog", "DesyncDetector",
+    "fingerprint", "array_crc",
+    "save_guard_state", "load_guard_state", "has_guard_state",
+]

@@ -1,0 +1,141 @@
+"""Crash-atomic loop-state checkpoints for the training guard.
+
+Same commit discipline as `framework/sharded_io.py` (whose atomic_write /
+CRC helpers this module reuses): the array payload is written under a NEW
+versioned name via tmp+fsync+rename, then the manifest — the commit record
+carrying the payload name, its whole-file CRC32 and the per-array dtype map
+— atomically replaces the previous one. A SIGKILL at any point leaves the
+previous manifest pointing at its intact payload; the previous generation
+is kept as `guard-meta.json.bak` and is the corruption fallback on load.
+
+Fault sites: `guard.snapshot.write` (torn-payload mangle) and
+`guard.snapshot` (deterministic crash point between payload and commit)
+drive the chaos tests.
+
+The payload is a flat name->ndarray npz; extension dtypes (bfloat16,
+float8_*) round-trip via the manifest dtype map + `.view()` exactly like
+`load_sharded` (npz stores them as raw void bytes).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .. import faults as _faults
+from .. import monitor as _monitor
+from ..framework.sharded_io import (CheckpointCorruptError, _crc, _np_dtype,
+                                    atomic_write)
+
+_META = "guard-meta.json"
+
+
+def save_guard_state(dirname: str, arrays: Dict[str, np.ndarray],
+                     meta: dict) -> str:
+    """Commit one loop-state generation; returns the payload path."""
+    os.makedirs(dirname, exist_ok=True)
+    mpath = os.path.join(dirname, _META)
+    prev = _read_meta(mpath)
+    version = int(prev.get("version", 0)) + 1 if prev else 1
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.ascontiguousarray(np.asarray(v))
+                     for k, v in arrays.items()})
+    data = buf.getvalue()
+    state_file = f"guard-state-v{version}.npz"
+    record = {"version": version, "state_file": state_file,
+              "file_crc": _crc(data),  # of the INTENDED bytes: a torn
+              "dtypes": {k: str(np.asarray(v).dtype)  # write must fail load
+                         for k, v in arrays.items()},
+              "meta": meta}
+    if _faults._ENABLED:
+        data = _faults.mangle("guard.snapshot.write", data)
+    atomic_write(os.path.join(dirname, state_file), data)
+    if _faults._ENABLED:
+        # deterministic crash point BETWEEN payload and commit: the meta
+        # still references the previous generation
+        _faults.check("guard.snapshot")
+    if os.path.exists(mpath):  # keep one fallback generation
+        shutil.copyfile(mpath, mpath + ".bak")
+    atomic_write(mpath, json.dumps(record).encode())
+    _gc(dirname, keep={state_file, prev.get("state_file", "")})
+    if _monitor._ENABLED:
+        _monitor.count("guard.checkpoints")
+    return os.path.join(dirname, state_file)
+
+
+def _read_meta(mpath: str) -> dict:
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _gc(dirname: str, keep) -> None:
+    import glob
+    for path in glob.glob(os.path.join(dirname, "guard-state-v*.npz")):
+        if os.path.basename(path) not in keep:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def _load_one(dirname: str, mpath: str) -> Tuple[Dict[str, np.ndarray], dict]:
+    record = _read_meta(mpath)
+    if not record:
+        raise CheckpointCorruptError(f"unreadable guard manifest {mpath}")
+    path = os.path.join(dirname, record.get("state_file", ""))
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(f"missing guard state file {path}") from e
+    if "file_crc" in record and _crc(raw) != record["file_crc"]:
+        raise CheckpointCorruptError(
+            f"guard state file {path} failed its checksum (torn/corrupt)")
+    try:
+        npz = np.load(io.BytesIO(raw))
+        dtypes = record.get("dtypes", {})
+        arrays = {}
+        for key in npz.files:
+            arr = npz[key]
+            want = _np_dtype(dtypes[key]) if key in dtypes else arr.dtype
+            if arr.dtype != want:  # extension dtypes stored as void bytes
+                arr = np.ascontiguousarray(arr).view(want)
+            arrays[key] = arr
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"guard state file {path} is unreadable: {e}") from e
+    return arrays, record.get("meta", {})
+
+
+def load_guard_state(dirname: str) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Load the newest intact generation (arrays, meta). Falls back to the
+    previous committed generation on corruption (counting
+    `guard.ckpt_fallbacks`); raises FileNotFoundError when no checkpoint
+    was ever committed, CheckpointCorruptError when none is intact."""
+    mpath = os.path.join(dirname, _META)
+    if not os.path.exists(mpath) and not os.path.exists(mpath + ".bak"):
+        raise FileNotFoundError(f"no guard checkpoint in {dirname}")
+    try:
+        return _load_one(dirname, mpath)
+    except CheckpointCorruptError as e:
+        bak = mpath + ".bak"
+        if not os.path.exists(bak):
+            raise
+        if _monitor._ENABLED:
+            _monitor.count("guard.ckpt_fallbacks")
+        import warnings
+        warnings.warn(f"guard checkpoint: {e}; falling back to the previous "
+                      f"committed generation ({bak})")
+        return _load_one(dirname, bak)
+
+
+def has_guard_state(dirname: str) -> bool:
+    mpath = os.path.join(dirname, _META)
+    return os.path.exists(mpath) or os.path.exists(mpath + ".bak")

@@ -1,0 +1,95 @@
+"""Cross-rank desync detection — cheap parameter fingerprints, compared
+across the data-parallel group every N steps.
+
+On a pod slice a rank can silently diverge (bit flip, dropped collective,
+nondeterministic kernel) and train a *different* model for hours before
+eval notices. The detector computes a CRC32 fingerprint of this process's
+addressable parameter shards (per-shard CRCs folded with the parameter
+name, so layout changes also show), all-gathers the 4-byte value through
+the job's rendezvous store (`collective.store_all_gather_object` — the
+cross-process regime), and votes: the majority fingerprint is truth, ties
+break toward the lowest rank's value (rank 0 is the broadcast source of
+initial params, so in a 2-rank tie the non-zero rank is named). Any
+minority rank raises `RankDesyncError` naming the offender(s) on EVERY
+rank — the whole group stops instead of averaging a poisoned gradient.
+"""
+from __future__ import annotations
+
+import zlib
+from collections import Counter
+from typing import Dict, List
+
+import numpy as np
+
+from .. import monitor as _monitor
+from .errors import RankDesyncError
+
+
+def array_crc(arr) -> int:
+    """CRC32 of an array's addressable bytes. For a sharded jax.Array this
+    folds each addressable shard in index order — every rank hashes only
+    what it holds, so the check costs one D2H of local shards, never a
+    gather of the full parameter."""
+    if hasattr(arr, "addressable_shards"):
+        crc = 0
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: tuple(sl.start or 0 for sl in s.index))
+        for sh in shards:
+            crc = zlib.crc32(
+                np.ascontiguousarray(np.asarray(sh.data)).tobytes(), crc)
+        return crc & 0xFFFFFFFF
+    return zlib.crc32(np.ascontiguousarray(np.asarray(arr)).tobytes()) \
+        & 0xFFFFFFFF
+
+
+def fingerprint(named_arrays: Dict[str, object]) -> int:
+    """Order-independent over insertion (names sorted), order-dependent
+    over content: one 32-bit value summarizing every parameter."""
+    crc = 0
+    for name in sorted(named_arrays):
+        crc = zlib.crc32(name.encode(), crc)
+        crc = zlib.crc32(array_crc(named_arrays[name]).to_bytes(4, "little"),
+                         crc)
+    return crc & 0xFFFFFFFF
+
+
+class DesyncDetector:
+    """One detector per rank; `check(step, named_arrays)` is called by the
+    guard every `FLAGS_guard_desync_interval` good steps."""
+
+    def __init__(self, store, rank: int, world_size: int,
+                 timeout_s: float = 30.0, prefix: str = "guard/fp"):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.timeout_s = float(timeout_s)
+        self.prefix = prefix
+
+    def check(self, step: int, named_arrays: Dict[str, object]) -> Dict[int, int]:
+        """Exchange fingerprints for `step`; returns {rank: fingerprint} or
+        raises RankDesyncError naming the minority rank(s)."""
+        if self.world_size <= 1:
+            return {self.rank: fingerprint(named_arrays)}
+        if _monitor._ENABLED:
+            _monitor.count("guard.desync_checks")
+        from ..parallel.collective import store_all_gather_object
+        fp = fingerprint(named_arrays)
+        fps = store_all_gather_object(
+            self.store, f"{self.prefix}/{step}", fp,
+            self.rank, self.world_size, timeout_s=self.timeout_s)
+        fps = {int(r): int(v) for r, v in fps.items()}
+        offenders = self._vote(fps)
+        if offenders:
+            if _monitor._ENABLED:
+                _monitor.count("guard.desync_errors")
+            raise RankDesyncError(step=step, offenders=offenders,
+                                  fingerprints=fps)
+        return fps
+
+    @staticmethod
+    def _vote(fps: Dict[int, int]) -> List[int]:
+        counts = Counter(fps.values())
+        maxc = max(counts.values())
+        tied = {v for v, c in counts.items() if c == maxc}
+        ref = fps[min(r for r in fps if fps[r] in tied)]
+        return sorted(r for r, v in fps.items() if v != ref)

@@ -1,0 +1,81 @@
+"""Typed failure taxonomy of the training guard plane.
+
+Reference parity: the enforce layer (`paddle/fluid/platform/enforce.h`)
+turns raw crashes into typed, catchable exceptions; the guard does the
+same for the three failure modes a clean exception never covers on a pod
+slice — preemption, a wedged step/collective, and silent numeric or
+cross-rank divergence. Every error carries enough context (phase, step,
+offending ranks, checkpoint path) for the relauncher to decide between
+resume, rollback, and abort without parsing log text.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+class GuardError(RuntimeError):
+    """Base of every guard-plane failure."""
+
+
+class PreemptedError(GuardError):
+    """A preemption signal (SIGTERM/SIGINT) arrived; the in-flight step was
+    finished and the full loop state was committed to `ckpt_dir`. Re-running
+    with `TrainGuard.resume()` continues bit-identically from `cursor`."""
+
+    def __init__(self, signum: int, ckpt_dir: Optional[str],
+                 cursor: Tuple[int, int]):
+        self.signum = signum
+        self.ckpt_dir = ckpt_dir
+        self.cursor = cursor
+        where = f"epoch {cursor[0]}, batch {cursor[1]}"
+        saved = f"; loop state checkpointed to {ckpt_dir}" if ckpt_dir \
+            else " (no ckpt_dir configured — state NOT saved)"
+        super().__init__(
+            f"training preempted by signal {signum} at {where}{saved}; "
+            f"call TrainGuard.resume() after relaunch")
+
+
+class StepStalledError(GuardError):
+    """The step watchdog deadline expired with the step still running —
+    a hung collective / wedged device surfaced as a typed error instead of
+    an infinite hang. `phase` is the last phase the step reported."""
+
+    def __init__(self, phase: str, deadline_s: float, step: int):
+        self.phase = phase
+        self.deadline_s = deadline_s
+        self.step = step
+        super().__init__(
+            f"train step {step} exceeded its {deadline_s:.3f}s watchdog "
+            f"deadline (last-known phase: {phase!r}); the step thread is "
+            f"likely wedged in a hung collective or device transfer")
+
+
+class DivergedError(GuardError):
+    """`max_bad_steps` consecutive steps produced a non-finite or spiking
+    loss even after rollback to the last-good snapshot — the run has
+    genuinely diverged and skipping batches no longer helps."""
+
+    def __init__(self, bad_steps: int, last_loss, step: int):
+        self.bad_steps = bad_steps
+        self.last_loss = last_loss
+        self.step = step
+        super().__init__(
+            f"training diverged: {bad_steps} consecutive bad steps up to "
+            f"step {step} (last loss {last_loss}); params were rolled back "
+            f"to the last-good snapshot each time")
+
+
+class RankDesyncError(GuardError):
+    """Parameter fingerprints disagree across the data-parallel group —
+    some rank silently diverged (bit flip, lost collective, nondeterministic
+    kernel). Names the offending rank(s): the minority side of the
+    fingerprint vote (ties broken toward the lowest rank's value)."""
+
+    def __init__(self, step: int, offenders: List[int], fingerprints):
+        self.step = step
+        self.offenders = list(offenders)
+        self.fingerprints = dict(fingerprints)
+        super().__init__(
+            f"cross-rank parameter desync at step {step}: rank(s) "
+            f"{self.offenders} disagree with the group "
+            f"(fingerprints: {self.fingerprints})")

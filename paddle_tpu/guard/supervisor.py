@@ -1,0 +1,377 @@
+"""TrainGuard — the training-loop supervisor.
+
+Wraps a `jit.TrainStep` / `parallel.SPMDTrainStep` (and, through
+`hapi.Model.fit(guard=...)`, the whole fit loop) with the four guards the
+paper's long-running pod-slice runs need:
+
+  1. preemption-safe auto-resume — SIGTERM/SIGINT set a flag; the in-flight
+     step FINISHES, then the full loop state (params, optimizer slots,
+     GradScaler streaks, LR-scheduler step, both rng streams, epoch+batch
+     cursor) is committed crash-atomically (`guard/checkpoint.py`) and
+     `PreemptedError` is raised. `resume()` restores every piece, so an
+     interrupted run produces bit-identical params to an uninterrupted one.
+  2. step watchdog — each step runs under `StepWatchdog`'s deadline
+     (explicit flag or trailing-median auto-calibration); a wedged step
+     surfaces as `StepStalledError` with the last-known phase.
+  3. divergence guard — a non-finite loss (including the traced
+     FLAGS_check_nan_inf raise) or a spike beyond
+     `FLAGS_guard_loss_spike_ratio` x trailing-median rolls params/slots/rng
+     back to the rolling in-memory last-good snapshot and skips the batch;
+     `DivergedError` after `FLAGS_guard_max_bad_steps` consecutive bad steps.
+  4. cross-rank desync detection — every `FLAGS_guard_desync_interval` good
+     steps the addressable-shard parameter fingerprint is all-gathered
+     through the rendezvous store and voted on (`guard/desync.py`).
+
+Every recovery is observable: `guard.steps`, `guard.bad_steps`,
+`guard.rollbacks`, `guard.snapshots`, `guard.checkpoints`, `guard.stalls`,
+`guard.step_errors`, `guard.preempts`, `guard.resumes`,
+`guard.desync_checks`, `guard.desync_errors` monitor counters. Chaos sites:
+`guard.step` (inside the supervised step — `delay` wedges it, `error`
+crashes it) and `guard.snapshot` / `guard.snapshot.write` (checkpoint
+commit crash / torn payload).
+"""
+from __future__ import annotations
+
+import signal as _signal
+import statistics
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import faults as _faults
+from .. import monitor as _monitor
+from ..core import flags as _flags
+from ..core import random as _rnd
+from .checkpoint import has_guard_state, load_guard_state, save_guard_state
+from .errors import DivergedError, GuardError, PreemptedError
+from .desync import DesyncDetector
+from .watchdog import StepWatchdog
+
+
+class GuardConfig:
+    """Knobs, seeded from FLAGS_guard_* and overridable per-field."""
+
+    def __init__(self, step_timeout_s: float = 0.0, warmup_steps: int = 5,
+                 timeout_factor: float = 10.0, min_timeout_s: float = 30.0,
+                 loss_spike_ratio: float = 10.0, snapshot_interval: int = 25,
+                 max_bad_steps: int = 3, desync_interval: int = 0,
+                 desync_timeout_s: float = 30.0):
+        self.step_timeout_s = float(step_timeout_s)
+        self.warmup_steps = int(warmup_steps)
+        self.timeout_factor = float(timeout_factor)
+        self.min_timeout_s = float(min_timeout_s)
+        self.loss_spike_ratio = float(loss_spike_ratio)
+        self.snapshot_interval = int(snapshot_interval)
+        self.max_bad_steps = int(max_bad_steps)
+        self.desync_interval = int(desync_interval)
+        self.desync_timeout_s = float(desync_timeout_s)
+
+    @classmethod
+    def from_flags(cls, **overrides) -> "GuardConfig":
+        cfg = cls(
+            step_timeout_s=_flags.flag("guard_step_timeout_s"),
+            warmup_steps=_flags.flag("guard_warmup_steps"),
+            timeout_factor=_flags.flag("guard_timeout_factor"),
+            min_timeout_s=_flags.flag("guard_min_timeout_s"),
+            loss_spike_ratio=_flags.flag("guard_loss_spike_ratio"),
+            snapshot_interval=_flags.flag("guard_snapshot_interval"),
+            max_bad_steps=_flags.flag("guard_max_bad_steps"),
+            desync_interval=_flags.flag("guard_desync_interval"),
+            desync_timeout_s=_flags.flag("guard_desync_timeout_s"))
+        for k, v in overrides.items():
+            if not hasattr(cfg, k):
+                raise TypeError(f"GuardConfig has no knob {k!r}")
+            setattr(cfg, k, v)
+        return cfg
+
+
+_PREEMPT_SIGNALS = (_signal.SIGTERM, _signal.SIGINT)
+
+
+class TrainGuard:
+    """Supervises one training loop. Use as a context manager so signal
+    handlers and the watchdog runner are always torn down:
+
+        step = TrainStep(model, loss_fn, opt)
+        with TrainGuard(step, ckpt_dir="ckpt/guard") as guard:
+            start = guard.resume() or (0, 0)
+            for epoch in range(epochs):
+                for b, (x, y) in enumerate(batches):
+                    if (epoch, b) < start:
+                        continue          # fast-forward after resume
+                    guard.set_cursor(epoch, b)
+                    loss = guard.step(x, y)   # None = bad step skipped
+    """
+
+    def __init__(self, step, ckpt_dir: Optional[str] = None,
+                 config: Optional[GuardConfig] = None, scaler=None,
+                 store=None, rank: int = 0, world_size: int = 1,
+                 signals=_PREEMPT_SIGNALS):
+        self._step_fn = step
+        self.ckpt_dir = ckpt_dir
+        self.cfg = config or GuardConfig.from_flags()
+        self.scaler = scaler
+        self._signals = tuple(signals)
+        self._watchdog = StepWatchdog(
+            timeout_s=self.cfg.step_timeout_s,
+            warmup_steps=self.cfg.warmup_steps,
+            factor=self.cfg.timeout_factor,
+            min_timeout_s=self.cfg.min_timeout_s)
+        self._detector = None
+        if store is not None and world_size > 1:
+            self._detector = DesyncDetector(
+                store, rank, world_size,
+                timeout_s=self.cfg.desync_timeout_s)
+        self._snapshot = None
+        self._good_losses = []
+        self._consec_bad = 0
+        self._good_steps = 0
+        self._desync_round = 0
+        self._cursor: Tuple[int, int] = (0, 0)
+        self._next_cursor: Tuple[int, int] = (0, 0)
+        self.resume_cursor: Optional[Tuple[int, int]] = None
+        self._preempt_signum: Optional[int] = None
+        self._prev_handlers: Dict[int, object] = {}
+        self._closed = False
+
+    # ---- lifecycle ----
+    def __enter__(self) -> "TrainGuard":
+        self.install_signal_handlers()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def install_signal_handlers(self) -> None:
+        """Main-thread only (CPython delivers signals there — which is
+        also why a step running on the watchdog thread can never swallow
+        one). Handlers only set a flag: the in-flight step always
+        finishes before the checkpoint is cut."""
+        for sig in self._signals:
+            if sig not in self._prev_handlers:
+                self._prev_handlers[sig] = _signal.getsignal(sig)
+            _signal.signal(sig, self._on_signal)
+
+    def restore_signal_handlers(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            try:
+                _signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev_handlers = {}
+
+    def _on_signal(self, signum, frame) -> None:
+        self._preempt_signum = signum
+        if _monitor._ENABLED:
+            _monitor.count("guard.preempts")
+
+    def close(self, grace_s: float = 5.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.restore_signal_handlers()
+        self._watchdog.close(grace_s=grace_s)
+
+    # ---- cursor ----
+    def set_cursor(self, epoch: int, batch: int) -> None:
+        """Tell the guard which batch the NEXT step() consumes, so a
+        preemption checkpoint knows where the DataLoader must resume."""
+        self._cursor = (int(epoch), int(batch))
+
+    # ---- the guarded step ----
+    def step(self, *batch) -> Optional[float]:
+        """Run one supervised train step. Returns the float loss, or None
+        when the divergence guard skipped the batch (params rolled back).
+        Raises StepStalledError / DivergedError / RankDesyncError /
+        PreemptedError as typed failures."""
+        if self._closed:
+            raise RuntimeError("TrainGuard is closed")
+        if self._snapshot is None:
+            self._maybe_first_snapshot()
+        watchdog = self._watchdog
+
+        def supervised():
+            watchdog.phase("dispatch")
+            if _faults._ENABLED:
+                _faults.check("guard.step")
+            loss_t = self._step_fn(*batch)
+            watchdog.phase("host-sync")
+            return float(np.asarray(getattr(loss_t, "_value", loss_t)))
+
+        bad_reason = None
+        loss = None
+        try:
+            loss = watchdog.run(supervised)
+        except FloatingPointError as e:
+            # traced FLAGS_check_nan_inf raise: state was committed (the
+            # donated buffers demanded it) but is poisoned — roll back
+            bad_reason = f"non-finite (check_nan_inf): {e}"
+        except GuardError:
+            raise  # stalls/desyncs already counted under their own name
+        except Exception:
+            if _monitor._ENABLED:
+                _monitor.count("guard.step_errors")
+            raise
+        if bad_reason is None and loss is not None:
+            if not np.isfinite(loss):
+                bad_reason = f"non-finite loss {loss}"
+            elif self._is_spike(loss):
+                bad_reason = (f"loss spike {loss:.6g} > "
+                              f"{self.cfg.loss_spike_ratio}x trailing median")
+        if bad_reason is not None:
+            return self._handle_bad_step(loss, bad_reason)
+        # ---- good step ----
+        self._consec_bad = 0
+        self._good_steps += 1
+        self._good_losses.append(loss)
+        if len(self._good_losses) > 64:
+            del self._good_losses[:-64]
+        if _monitor._ENABLED:
+            _monitor.count("guard.steps")
+        self._next_cursor = (self._cursor[0], self._cursor[1] + 1)
+        if self.cfg.snapshot_interval > 0 and \
+                self._good_steps % self.cfg.snapshot_interval == 0:
+            self._take_snapshot()
+        if self._detector is not None and self.cfg.desync_interval > 0 and \
+                self._good_steps % self.cfg.desync_interval == 0:
+            self._desync_round += 1
+            self._detector.check(self._desync_round,
+                                 self._step_fn.named_param_arrays())
+        if self._preempt_signum is not None:
+            signum = self._preempt_signum
+            self._preempt_signum = None
+            if self.ckpt_dir:
+                self.checkpoint()
+            raise PreemptedError(signum, self.ckpt_dir, self._next_cursor)
+        return loss
+
+    def _is_spike(self, loss: float) -> bool:
+        if self.cfg.loss_spike_ratio <= 0 or len(self._good_losses) < 3:
+            return False
+        med = statistics.median(self._good_losses)
+        if med <= 0:  # spike heuristic only meaningful for positive losses
+            return False
+        return loss > self.cfg.loss_spike_ratio * med
+
+    def _handle_bad_step(self, loss, reason: str) -> None:
+        self._consec_bad += 1
+        if _monitor._ENABLED:
+            _monitor.count("guard.bad_steps")
+        self._rollback()
+        if self._consec_bad >= max(1, self.cfg.max_bad_steps):
+            raise DivergedError(bad_steps=self._consec_bad, last_loss=loss,
+                                step=self._good_steps + 1)
+        return None
+
+    # ---- rolling in-memory snapshot / rollback ----
+    def _maybe_first_snapshot(self) -> None:
+        """A last-good snapshot must exist before the first bad step.
+        jit.TrainStep can build (and thus snapshot) without a batch;
+        SPMDTrainStep cannot — its first snapshot lands after step 1."""
+        try:
+            self._take_snapshot()
+        except RuntimeError:
+            pass
+
+    def _take_snapshot(self) -> None:
+        snap = {"step": self._step_fn.state_dict(),
+                "rng": _rnd.get_rng_state()}
+        if self.scaler is not None:
+            snap["scaler"] = self.scaler.state_dict()
+        self._snapshot = snap
+        if _monitor._ENABLED:
+            _monitor.count("guard.snapshots")
+
+    def _rollback(self) -> None:
+        if self._snapshot is None:
+            return
+        self._step_fn.set_state_dict(self._snapshot["step"])
+        _rnd.set_rng_state(self._snapshot["rng"])
+        if self.scaler is not None and "scaler" in self._snapshot:
+            self.scaler.load_state_dict(self._snapshot["scaler"])
+        if _monitor._ENABLED:
+            _monitor.count("guard.rollbacks")
+
+    # ---- durable checkpoint / resume ----
+    def _lr_scheduler(self):
+        opt = getattr(self._step_fn, "optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "state_dict") else None
+
+    def checkpoint(self) -> str:
+        """Commit the FULL loop state crash-atomically to ckpt_dir."""
+        if not self.ckpt_dir:
+            raise ValueError("TrainGuard has no ckpt_dir configured")
+        sd = self._step_fn.state_dict()
+        arrays: Dict[str, np.ndarray] = {}
+        for n, v in sd["params"].items():
+            arrays[f"params/{n}"] = v
+        for i, s in enumerate(sd["slots"]):
+            for k, v in s.items():
+                arrays[f"slots/{i}/{k}"] = v
+        if "rng_key" in sd:
+            arrays["step/rng_key"] = sd["rng_key"]
+        if "t" in sd:
+            arrays["step/t"] = sd["t"]
+        seed, count, kd, pool = _rnd.get_rng_state()
+        if kd is not None:
+            arrays["grng/key"] = np.asarray(kd)
+        for i, p in enumerate(pool):
+            arrays[f"grng/pool/{i}"] = np.asarray(p)
+        meta = {
+            "kind": sd["kind"],
+            "step_count": sd["step_count"],
+            "cursor": list(self._next_cursor),
+            "good_steps": self._good_steps,
+            "good_losses": [float(x) for x in self._good_losses[-16:]],
+            "grng": {"seed": int(seed), "count": int(count),
+                     "pool_len": len(pool), "has_key": kd is not None},
+            "slot_keys": [sorted(s) for s in sd["slots"]],
+            "param_names": sorted(sd["params"]),
+            "wallclock": time.time(),
+        }
+        if self.scaler is not None:
+            meta["scaler"] = {k: float(v) if isinstance(v, float) else v
+                              for k, v in self.scaler.state_dict().items()}
+        sched = self._lr_scheduler()
+        if sched is not None:
+            meta["lr_scheduler"] = sched.state_dict()
+        save_guard_state(self.ckpt_dir, arrays, meta)
+        return self.ckpt_dir
+
+    def resume(self) -> Optional[Tuple[int, int]]:
+        """Restore the loop from the newest intact guard checkpoint.
+        Returns the (epoch, batch) cursor the loop must fast-forward to,
+        or None when no checkpoint exists (fresh start)."""
+        if not self.ckpt_dir or not has_guard_state(self.ckpt_dir):
+            return None
+        arrays, meta = load_guard_state(self.ckpt_dir)
+        params = {n: arrays[f"params/{n}"] for n in meta["param_names"]}
+        slots = [{k: arrays[f"slots/{i}/{k}"] for k in keys}
+                 for i, keys in enumerate(meta["slot_keys"])]
+        sd = {"kind": meta["kind"], "params": params, "slots": slots,
+              "step_count": meta["step_count"]}
+        if "step/rng_key" in arrays:
+            sd["rng_key"] = arrays["step/rng_key"]
+        if "step/t" in arrays:
+            sd["t"] = arrays["step/t"]
+        self._step_fn.set_state_dict(sd)
+        g = meta["grng"]
+        kd = arrays.get("grng/key") if g.get("has_key") else None
+        pool = tuple(arrays[f"grng/pool/{i}"] for i in range(g["pool_len"]))
+        _rnd.set_rng_state((g["seed"], g["count"], kd, pool))
+        if self.scaler is not None and "scaler" in meta:
+            self.scaler.load_state_dict(meta["scaler"])
+        sched = self._lr_scheduler()
+        if sched is not None and "lr_scheduler" in meta:
+            sched.set_state_dict(meta["lr_scheduler"])
+        self._good_steps = int(meta.get("good_steps", 0))
+        self._good_losses = [float(x) for x in meta.get("good_losses", [])]
+        self._consec_bad = 0
+        self._snapshot = None
+        self.resume_cursor = tuple(meta["cursor"])
+        if _monitor._ENABLED:
+            _monitor.count("guard.resumes")
+        return self.resume_cursor

@@ -68,6 +68,11 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
+    """Epoch-end save. Writes go through `Model.save` ->
+    `framework.io.save`, which commits via sharded_io's crash-atomic
+    tmp+fsync+rename path — a SIGKILL mid-epoch-end cannot leave a torn
+    `.pdparams`/`.pdopt` under the committed name."""
+
     def __init__(self, save_freq=1, save_dir=None):
         self.save_freq = save_freq
         self.save_dir = save_dir
@@ -97,6 +102,17 @@ class EarlyStopping(Callback):
         if cur is None:
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        import math
+        if not math.isfinite(cur):
+            # NaN/Inf metric is a strict regression: NaN comparisons are
+            # always False, so without this branch a diverged run would
+            # never trip the stop (and a NaN could be stored as `best`,
+            # poisoning every later comparison)
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped = True
+                self.model.stop_training = True
+            return
         better = (self.best is None or
                   (cur < self.best - self.min_delta if self.mode == "min"
                    else cur > self.best + self.min_delta))

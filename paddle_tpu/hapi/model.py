@@ -87,33 +87,57 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, guard=None):
+        """`guard`: a `paddle_tpu.guard.TrainGuard` wrapping this model's
+        TrainStep. Every train step then runs supervised (watchdog,
+        divergence rollback, desync check, preemption checkpoint), and a
+        prior `guard.resume()` fast-forwards the loop to the checkpointed
+        epoch/batch cursor. A preemption raises `PreemptedError` out of
+        fit AFTER the loop state was committed."""
         loader = self._as_loader(train_data, batch_size, shuffle)
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbs = config_callbacks(callbacks, self, epochs, steps, log_freq, verbose,
                                save_freq, save_dir,
                                metrics=[m.name() for m in self._metrics])
+        if guard is not None and self._train_step is None:
+            raise ValueError("fit(guard=...) requires prepare() with an "
+                             "optimizer and a loss (the jitted TrainStep is "
+                             "what the guard supervises)")
+        cursor = guard.resume_cursor if guard is not None else None
         self.stop_training = False
         for cb in cbs:
             cb.on_train_begin()
         it = 0
         for epoch in range(epochs):
+            if cursor and epoch < cursor[0]:
+                continue  # resumed past this epoch entirely
             for cb in cbs:
                 cb.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             logs = {}
             for step, batch in enumerate(loader):
+                if cursor and (epoch, step) < tuple(cursor):
+                    continue  # resumed past this batch
                 for cb in cbs:
                     cb.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                loss = self.train_batch(inputs, labels)
+                if guard is not None:
+                    self.network.train()
+                    guard.set_cursor(epoch, step)
+                    self._train_step._n_model_inputs = len(inputs)
+                    loss = guard.step(*inputs, *(labels or []))
+                    if loss is None:  # divergence guard skipped the batch
+                        continue
+                else:
+                    loss = self.train_batch(inputs, labels)
                 logs = {"loss": loss}
                 for cb in cbs:
                     cb.on_train_batch_end(step, logs)
                 it += 1
                 if (num_iters and it >= num_iters) or self.stop_training:
                     break
+            cursor = None  # fast-forward applies to the first epoch only
             for cb in cbs:
                 cb.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:

@@ -197,6 +197,48 @@ class TrainStep:
         raise_nonfinite(bad, self._pnames, "jitted train step")
         return Tensor(loss)
 
+    # ---- full loop-state capture (guard plane: preemption-safe resume) ----
+    def named_param_arrays(self):
+        """name -> device array for every trainable param (desync
+        fingerprints; no copy)."""
+        if self._jitted is None:
+            self._build()
+        return {n: t._value for n, t in zip(self._pnames, self._ptensors)}
+
+    def state_dict(self):
+        """Host-side copy of the FULL loop state: params, optimizer slots,
+        the in-program rng carry key and step counter. `set_state_dict` of
+        this dict reproduces the uninterrupted training stream
+        bit-identically — the carry key is the exact key the next step
+        would have split, not a reseeded approximation."""
+        if self._jitted is None:
+            self._build()
+        import numpy as np_
+        return {
+            "kind": "train_step",
+            "params": {n: np_.asarray(t._value)
+                       for n, t in zip(self._pnames, self._ptensors)},
+            "slots": [{k: np_.asarray(v) for k, v in s.items()}
+                      for s in self._slots],
+            "rng_key": np_.asarray(jax.random.key_data(self._key)),
+            "t": np_.asarray(self._t_arr),
+            "step_count": int(self.optimizer._step_count),
+        }
+
+    def set_state_dict(self, sd):
+        if self._jitted is None:
+            self._build()
+        params = sd["params"]
+        for n, t in zip(self._pnames, self._ptensors):
+            if n in params:
+                t._value = jnp.asarray(params[n])
+        self._slots = [{k: jnp.asarray(v) for k, v in s.items()}
+                       for s in sd["slots"]]
+        self._key = jax.random.wrap_key_data(jnp.asarray(sd["rng_key"]))
+        self._t_arr = jnp.asarray(sd["t"], jnp.float32)
+        self.optimizer._step_count = int(sd["step_count"])
+        self._lr_val = None  # force the lr-array cache to refresh
+
     def run(self, *batch):
         """Device-side multi-step loop: every tensor in `batch` is stacked
         along a leading n_steps axis ([n, ...] per step-shape [...]); runs

@@ -15,6 +15,12 @@ registry of counters, gauges and histograms that every layer reports into:
     inbox-depth gauges
   - data loading (`io/dataloader.py`): queue-wait + batch-build histograms
   - optimizer (`optimizer/optimizer.py`): step counts + durations
+  - training guard (`guard/supervisor.py`): `guard.steps`/`guard.bad_steps`/
+    `guard.rollbacks`/`guard.snapshots`/`guard.checkpoints`/`guard.stalls`/
+    `guard.step_errors`/`guard.preempts`/`guard.resumes`/
+    `guard.desync_checks`/`guard.desync_errors` counters — every recovery
+    the supervisor performs is visible next to the fault that provoked it;
+    `amp.skipped_steps`/`amp.scale_updates` from the GradScaler
   - serving (`serving/engine.py`): `serving.queue_depth` gauge,
     `serving.queue_wait`/`serving.e2e_latency`/`serving.batch_size`
     histograms, `serving.padding_waste_elems`/`serving.padded_rows`,

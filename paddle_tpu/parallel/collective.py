@@ -148,6 +148,39 @@ def all_gather_object(obj_list, obj, group=None):
     return obj_list
 
 
+def store_all_gather_object(store, key: str, obj, rank: int, world_size: int,
+                            timeout_s: float = 30.0, poll_s: float = 0.01):
+    """Multi-controller all-gather of a small JSON-able object through a
+    rendezvous store (TCPStore, or any set/get mapping). The eager
+    collectives above cover the single-controller regime where every rank
+    IS this process; cross-PROCESS exchange (guard desync fingerprints,
+    membership votes) goes through the store the job already rendezvoused
+    on. Returns {rank: obj}; raises TimeoutError when a peer's value does
+    not appear within `timeout_s` (a hang, not a desync — callers must not
+    blame a rank for being slow)."""
+    import json as _json
+    import time as _time
+    store.set(f"{key}:{rank}", _json.dumps(obj))
+    if _monitor._ENABLED:
+        _monitor.count("c_store_allgather_obj")
+    out = {}
+    deadline = _time.monotonic() + timeout_s
+    for r in range(world_size):
+        while True:
+            try:
+                raw = store.get(f"{key}:{r}")
+                break
+            except Exception:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"store_all_gather_object: rank {r} never published "
+                        f"{key!r} within {timeout_s}s")
+                _time.sleep(poll_s)
+        out[r] = _json.loads(raw.decode() if isinstance(raw, (bytes, bytearray))
+                             else raw)
+    return out
+
+
 def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     ax = _axis(group) or "dp"

@@ -179,6 +179,61 @@ class SPMDTrainStep:
                        for s, d in zip(self._slots, sspecs)]
         for b, spec in zip(btensors, bspecs):
             b._value = jax.device_put(b._value, ns(spec))
+        pending = getattr(self, "_pending_state", None)
+        if pending is not None:  # set_state_dict before the first step
+            self._pending_state = None
+            self._apply_state(pending)
+
+    # ---- full loop-state capture (guard plane: preemption-safe resume) ----
+    def named_param_arrays(self):
+        """name -> device array for every trainable param (desync
+        fingerprints; no copy)."""
+        trainable, _ = split_state(self.model)
+        names = self._pnames if self._jitted is not None else list(trainable)
+        return {n: trainable[n]._value for n in names}
+
+    def state_dict(self):
+        """Host-side copy of params + optimizer slots + step counter. The
+        per-step rng key is drawn from the global generator (capture it
+        with core.random.get_rng_state alongside this dict — the guard
+        checkpoint does)."""
+        if self._jitted is None:
+            raise RuntimeError("SPMDTrainStep.state_dict() requires a built "
+                               "step — run at least one step first")
+        trainable, _ = split_state(self.model)
+        return {
+            "kind": "spmd_train_step",
+            "params": {n: np.asarray(trainable[n]._value)
+                       for n in self._pnames},
+            "slots": [{k: np.asarray(v) for k, v in s.items()}
+                      for s in self._slots],
+            "step_count": int(self.optimizer._step_count),
+        }
+
+    def set_state_dict(self, sd):
+        if self._jitted is None:
+            # applied at the end of _build, after shardings exist
+            self._pending_state = sd
+            self.optimizer._step_count = int(sd["step_count"])
+            return
+        self._apply_state(sd)
+
+    def _apply_state(self, sd):
+        from jax.sharding import NamedSharding
+
+        def ns(spec):
+            return NamedSharding(self.mesh, spec)
+
+        trainable, _ = split_state(self.model)
+        params = sd["params"]
+        for n, spec in zip(self._pnames, self._pspecs):
+            if n in params:
+                trainable[n]._value = jax.device_put(
+                    jnp.asarray(params[n]), ns(spec))
+        self._slots = [{k: jax.device_put(jnp.asarray(v), ns(d[k]))
+                        for k, v in s.items()}
+                       for s, d in zip(sd["slots"], self._sspecs)]
+        self.optimizer._step_count = int(sd["step_count"])
 
     def __call__(self, *batch):
         arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]

@@ -47,6 +47,32 @@ def _seed_all():
 
 
 @pytest.fixture(autouse=True)
+def _no_guard_leak():
+    """The guard plane installs SIGTERM/SIGINT handlers and spawns
+    `guard-*` watchdog runner threads; either leaking out of a test would
+    corrupt every later test (a stray handler swallows ctrl-C / pytest's
+    own teardown signals, a wedged runner pins the interpreter). Assert
+    both are back to their pre-test state — and restore the handlers, so
+    one offender cannot cascade."""
+    import signal
+    import threading
+    before = {s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)}
+    yield
+    leaked = {s: signal.getsignal(s) for s in before
+              if signal.getsignal(s) is not before[s]}
+    for s, _ in leaked.items():
+        signal.signal(s, before[s])
+    guard_threads = [t.name for t in threading.enumerate()
+                     if t.name.startswith("guard-") and t.is_alive()]
+    assert not leaked, (
+        f"guard signal handlers leaked out of the test: {sorted(leaked)} "
+        f"(TrainGuard.close()/restore_signal_handlers() not called?)")
+    assert not guard_threads, (
+        f"guard watchdog threads leaked out of the test: {guard_threads} "
+        f"(StepWatchdog.close() not called, or a step is still wedged?)")
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_leak():
     """An injection spec leaking out of one test would fail arbitrary
     later tests with injected resets — assert FLAGS_fault_inject and the

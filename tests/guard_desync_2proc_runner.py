@@ -1,0 +1,63 @@
+"""Two-process desync-detection runner (executed by test_guard.py).
+
+Two real OS processes rendezvous on the C++ TCPStore and exchange
+parameter fingerprints through `DesyncDetector`. Rank 1 perturbs one
+parameter by a single ULP before the check — the silent-divergence
+scenario — so BOTH ranks must raise RankDesyncError naming rank 1 (the
+2-rank fingerprint vote ties, and ties break toward rank 0's value).
+No jax/XLA involvement: the detector works on host arrays, which keeps
+the runner fast and backend-free.
+"""
+import json
+import os
+import sys
+
+rank = int(sys.argv[1])
+store_port = int(sys.argv[2])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+# Load the native TCPStore first (same technique as
+# collective_2proc_runner.py), so rendezvous comes up before the heavier
+# paddle_tpu import below.
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "ptpu_native", os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "_native", "__init__.py"))
+_native = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_native)
+
+from paddle_tpu.guard.desync import DesyncDetector  # noqa: E402
+from paddle_tpu.guard.errors import RankDesyncError  # noqa: E402
+
+store = _native.TCPStore("127.0.0.1", store_port, is_master=(rank == 0),
+                         world_size=2)
+
+rng = np.random.RandomState(0)  # same params on both ranks
+params = {"w0": rng.rand(16, 8).astype("float32"),
+          "b0": rng.rand(8).astype("float32")}
+
+det = DesyncDetector(store, rank=rank, world_size=2, timeout_s=60.0)
+
+# round 1: in sync — must pass on both ranks
+fps1 = det.check(1, params)
+assert len(set(fps1.values())) == 1, fps1
+
+# round 2: rank 1 silently diverges by one ULP
+if rank == 1:
+    params["w0"][3, 3] = np.nextafter(params["w0"][3, 3], np.float32(2.0))
+result = {"rank": rank, "round1": "ok"}
+try:
+    det.check(2, params)
+    result["round2"] = "no-error"
+except RankDesyncError as e:
+    result["round2"] = "desync"
+    result["offenders"] = e.offenders
+    result["step"] = e.step
+print(json.dumps(result))
