@@ -1,0 +1,114 @@
+"""paddle_tpu.analysis — tpu-lint, the static-analysis plane.
+
+Three levels (ISSUE: trace safety, graph hygiene, collective-deadlock
+detection), all runnable offline and at compile time:
+
+  1. source lint (`analysis.lint`): AST scan of trace-destined functions
+     for host syncs, tensor-dependent Python control flow, traced print,
+     stdlib RNG, and shape-capture retrace forks;
+  2. graph analysis (`analysis.graph`): jaxpr/Program walks for dead ops,
+     unused inputs, implicit f64 widenings, host callbacks, and
+     collective-ordering verification across ranks/pipeline stages;
+  3. driver: `python -m paddle_tpu.analysis <paths>` (severities,
+     `# tpu-lint: disable=RULE` suppressions, `--json`), the same rules as
+     registered passes (`prog.apply_pass('lint')`, `'dead_op_elim'` in
+     `static/passes.py`), and a trace-time hook behind `FLAGS_lint`
+     (warnings + `lint.findings`/`lint.files` monitor counters; the
+     disabled path is one module-attribute check, like `faults`/`monitor`).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..core import flags as _flags
+from .base import Finding, RULES, Severity  # noqa: F401
+from .lint import (  # noqa: F401
+    lint_callable, lint_file, lint_paths, lint_source)
+
+__all__ = [
+    "Finding", "RULES", "Severity",
+    "lint_source", "lint_file", "lint_paths", "lint_callable",
+    "analyze_jaxpr", "analyze_program",
+    "collective_sequence", "verify_collective_order",
+    "verify_stage_chain", "verify_stage_assignment",
+    "enabled", "enable", "disable", "lint_traced", "main",
+]
+
+# Hot-path gate (faults/monitor pattern): the jit trace hooks read this
+# module attribute; `watch_flag` keeps it in sync with set_flags.
+_ENABLED: bool = bool(_flags.flag("lint"))
+
+
+def _on_flag(value) -> None:
+    global _ENABLED
+    _ENABLED = bool(value)
+
+
+_flags.watch_flag("lint", _on_flag)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable() -> None:
+    _flags.set_flags({"lint": True})
+
+
+def disable() -> None:
+    _flags.set_flags({"lint": False})
+
+
+# jax-dependent level 2 lives in .graph; re-export lazily so importing the
+# linter (pure stdlib ast) never pulls the tracer machinery
+def __getattr__(name):
+    if name in ("analyze_jaxpr", "analyze_program", "collective_sequence",
+                "verify_collective_order", "verify_stage_chain",
+                "verify_stage_assignment", "CollectiveDesc",
+                "iter_eqns", "live_eqn_mask"):
+        from . import graph as _graph
+        return getattr(_graph, name)
+    if name == "main":
+        from .cli import main as _main
+        return _main
+    raise AttributeError(name)
+
+
+# ---- trace-time hook (FLAGS_lint) ------------------------------------------
+
+# functions already linted this process (code object identity): tracing the
+# same capture for a new shape signature must not re-lint or re-warn
+_LINTED_KEYS = set()
+_LINTED_FILES = set()
+
+
+def lint_traced(fn, where: str = "trace") -> List[Finding]:
+    """Lint `fn` as a traced region, once per function per process. Called
+    from `jit/to_static.py` / `jit/train_step.py` / `parallel/spmd.py` at
+    trace time when `FLAGS_lint` is on. Emits a warning per finding and
+    bumps the `lint.findings` / `lint.files` monitor counters."""
+    import warnings
+
+    target = getattr(fn, "__func__", fn)
+    code = getattr(target, "__code__", None)
+    key = code if code is not None else id(target)
+    if key in _LINTED_KEYS:
+        return []
+    _LINTED_KEYS.add(key)
+    findings = lint_callable(fn)
+    from .. import monitor as _monitor
+    src_file = getattr(code, "co_filename", None)
+    if src_file is not None and src_file not in _LINTED_FILES:
+        _LINTED_FILES.add(src_file)
+        _monitor.count("lint.files")
+    if findings:
+        _monitor.count("lint.findings", len(findings))
+        for f in findings:
+            warnings.warn(f"tpu-lint[{where}]: {f.format()}", stacklevel=3)
+    return findings
+
+
+def _reset_trace_cache() -> None:
+    """Test hook: forget which functions were already linted."""
+    _LINTED_KEYS.clear()
+    _LINTED_FILES.clear()

@@ -1,0 +1,145 @@
+"""tpu-lint core: findings, the rule registry, and suppression parsing.
+
+Reference parity: the IR-pass analysis half of `paddle/fluid/framework/ir/`
+(graph pattern detectors like `ir/identity_op_clean_pass`,
+`ir/delete_op_device_pass`'s graph walks) plus the API-misuse checks the
+reference scatters through `enforce`/op-kernel preconditions. TPU-native
+redesign: the hazards worth detecting are the ones that break the
+trace -> ProgramDesc -> HLO path (host syncs, retrace storms, collective
+deadlocks), and all of them are visible STATICALLY — in the Python AST,
+the traced jaxpr, or the StableHLO module — so they are reported before a
+pod slice ever hangs.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Severity", "Rule", "RULES", "Finding", "Suppressions",
+           "severity_at_least"]
+
+
+class Severity:
+    INFO = "info"
+    WARNING = "warning"
+    ERROR = "error"
+
+    _ORDER = {"info": 0, "warning": 1, "error": 2}
+
+
+def severity_at_least(sev: str, threshold: str) -> bool:
+    return Severity._ORDER[sev] >= Severity._ORDER[threshold]
+
+
+class Rule:
+    __slots__ = ("id", "severity", "doc")
+
+    def __init__(self, id_: str, severity: str, doc: str):
+        self.id, self.severity, self.doc = id_, severity, doc
+
+    def __repr__(self):
+        return f"Rule({self.id}, {self.severity})"
+
+
+# The rule table (README "Static analysis" section mirrors this).
+RULES: Dict[str, Rule] = {r.id: r for r in [
+    # -- source rules (analysis/lint.py, AST level) --
+    Rule("host-sync", Severity.ERROR,
+         ".numpy()/.item()/.tolist()/float()/int()/bool() on a tensor in a "
+         "traced region — a device->host sync; under trace it raises "
+         "ConcretizationError or silently pins the step on the host"),
+    Rule("tensor-branch", Severity.ERROR,
+         "Python `if`/`while`/`assert` on a tensor value — data-dependent "
+         "control flow cannot be traced (use lax.cond semantics via "
+         "static.nn.cond, or keep the predicate host-static)"),
+    Rule("traced-print", Severity.WARNING,
+         "print() inside a traced region — runs once at trace time, never "
+         "per step; use jax.debug.print / monitor counters"),
+    Rule("stdlib-random", Severity.ERROR,
+         "stdlib random.* / numpy.random.* inside a traced region — the "
+         "value is burned in at trace time, breaking the carried-key RNG "
+         "regime (use paddle randomness ops, which ride the trace key)"),
+    Rule("shape-capture", Severity.WARNING,
+         "branching on a tensor's .shape/len() — each distinct input shape "
+         "silently compiles a different program (a per-shape retrace fork)"),
+    # -- graph rules (analysis/graph.py, jaxpr/Program level) --
+    Rule("dead-op", Severity.WARNING,
+         "op whose results are never used by any program output — wasted "
+         "trace/compile time and a likely logic error"),
+    Rule("unused-var", Severity.WARNING,
+         "program input consumed by no live op — dead argument traffic"),
+    Rule("dtype-widen", Severity.ERROR,
+         "implicit f32/bf16 -> f64 (or c64 -> c128) widening — float64 is "
+         "emulated on TPU and wrecks step time"),
+    Rule("host-callback", Severity.WARNING,
+         "host callback op inside the compiled program — a device->host "
+         "round trip on every step"),
+    Rule("collective-order", Severity.ERROR,
+         "ranks/stages issue diverging static collective sequences — the "
+         "pod deadlocks at the first mismatched collective at runtime"),
+    Rule("stage-graph", Severity.ERROR,
+         "pipeline stage wiring broken: a stage's output cannot feed the "
+         "next stage, or a stage has no owner — the pipeline hangs"),
+]}
+
+
+class Finding:
+    """One diagnostic. `path`/`line` anchor it; `func` names the traced
+    function (or program/rank) it was found in."""
+
+    __slots__ = ("rule", "severity", "path", "line", "col", "message", "func")
+
+    def __init__(self, rule: str, message: str, path: str = "<program>",
+                 line: int = 0, col: int = 0, func: str = "",
+                 severity: Optional[str] = None):
+        self.rule = rule
+        self.severity = severity or RULES[rule].severity
+        self.path, self.line, self.col = path, line, col
+        self.message = message
+        self.func = func
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        where = f" (in {self.func})" if self.func else ""
+        return f"{loc}: {self.severity}: {self.message}{where} [{self.rule}]"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "func": self.func}
+
+    def __repr__(self):
+        return f"Finding({self.format()})"
+
+
+# `# tpu-lint: disable=rule-a,rule-b` — on a code line it silences those
+# rules for that line; on a comment-only line it silences them for the
+# whole file. `disable=all` silences everything.
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([\w,\-]+)")
+
+
+class Suppressions:
+    """Parsed suppression comments for one source file."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, set] = {}
+        self.file_wide: set = set()
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if line.strip().startswith("#"):
+                self.file_wide |= rules
+            else:
+                self.by_line.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if "all" in self.file_wide or rule in self.file_wide:
+            return True
+        here = self.by_line.get(line, ())
+        return "all" in here or rule in here
+
+    def apply(self, findings: List[Finding]) -> List[Finding]:
+        return [f for f in findings
+                if not self.suppressed(f.rule, f.line)]

@@ -1,0 +1,374 @@
+"""tpu-lint level 1: source lint for trace-destined Python functions.
+
+Reuses the same AST machinery `jit/dy2static.py` parses functions with, but
+for ANALYSIS instead of rewriting: scan functions destined for `@to_static`
+/ `TrainStep` for the hazards that only surface at runtime as a
+ConcretizationError, a silent retrace storm, or a host-pinned step.
+
+Two scan modes per function:
+  - trace-destined (forward methods, @to_static/@declarative/@jax.jit
+    decorated, or names passed as entry points): full rule set, with a
+    light intra-function taint analysis seeding every non-self parameter
+    (minus ones with scalar/str/None defaults) as a tensor;
+  - --all mode (every other def): syntactic rules only (.numpy()-family
+    host syncs, stdlib RNG, print) — the taint assumption "parameters are
+    tensors" is only sound for trace-destined code.
+
+Suppression: `# tpu-lint: disable=rule-a,rule-b` on the offending line, or
+on a comment-only line for file-wide scope (see base.Suppressions).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from .base import Finding, Suppressions
+
+__all__ = ["lint_source", "lint_file", "lint_callable", "lint_paths"]
+
+# method calls that force a device->host sync on a tensor receiver
+_HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
+# builtins that concretize a tensor argument
+_HOST_SYNC_BUILTINS = {"float", "int", "bool"}
+# attribute reads that are STATIC metadata under trace (not data)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "stop_gradient"}
+# builtins whose result is host-static regardless of tensor args (type
+# tests and reflection — never data-dependent)
+_STATIC_BUILTINS = {"isinstance", "issubclass", "hasattr", "callable",
+                    "type", "id", "repr"}
+# decorator name suffixes that mark a function trace-destined
+_TRACED_DECORATORS = {"to_static", "declarative", "jit"}
+# default values that mark a parameter as non-tensor config
+_SCALAR_DEFAULT_TYPES = (bool, int, float, str, bytes, type(None))
+
+
+def _dotted(node) -> Tuple[str, ...]:
+    """('np', 'random', 'rand') for np.random.rand; () when not a pure
+    dotted name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _is_stdlib_random(chain: Tuple[str, ...]) -> bool:
+    if not chain:
+        return False
+    if chain[0] == "random" and len(chain) > 1:
+        return True
+    return len(chain) > 2 and chain[0] in ("np", "numpy") \
+        and chain[1] == "random"
+
+
+def _decorator_traced(dec) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    chain = _dotted(target)
+    return bool(chain) and chain[-1] in _TRACED_DECORATORS
+
+
+class _Taint:
+    """Expression classification: (tensor-tainted, shape-derived)."""
+
+    def __init__(self, tainted: Set[str]):
+        self.tainted = tainted
+
+    def of(self, node) -> Tuple[bool, bool]:
+        t = self._of
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted, False
+        if isinstance(node, ast.Attribute):
+            bt, bs = t(node.value)
+            if node.attr in _STATIC_ATTRS:
+                # x.shape/x.ndim of a tensor: static metadata, but flag
+                # branches on it (shape-capture) — each shape forks a trace
+                return False, (bt or bs) and node.attr in ("shape", "ndim")
+            return bt, bs
+        if isinstance(node, ast.Subscript):
+            bt, bs = t(node.value)
+            it, is_ = t(node.slice)
+            return bt or it, bs or is_
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            args_tb = [t(a) for a in node.args] + \
+                [t(k.value) for k in node.keywords]
+            any_t = any(a for a, _ in args_tb)
+            any_s = any(s for _, s in args_tb)
+            if len(chain) == 1 and chain[0] in _STATIC_BUILTINS:
+                return False, False
+            if chain == ("len",):
+                at, _ = t(node.args[0]) if node.args else (False, False)
+                return False, at          # len(tensor) is static metadata
+            if chain and chain[-1] in _HOST_SYNC_METHODS:
+                return False, False       # result is a host value
+            if len(chain) == 1 and chain[0] in _HOST_SYNC_BUILTINS:
+                return False, any_s       # int(x.shape[0]) stays shapey
+            ft, fs = t(node.func)
+            return ft or any_t, fs or any_s
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False, False       # identity tests are host-static
+            parts = [t(node.left)] + [t(c) for c in node.comparators]
+            any_t = any(a for a, _ in parts)
+            return any_t, (not any_t) and any(s for _, s in parts)
+        if isinstance(node, (ast.BoolOp,)):
+            parts = [t(v) for v in node.values]
+            return any(a for a, _ in parts), any(s for _, s in parts)
+        if isinstance(node, ast.BinOp):
+            lt, ls = t(node.left)
+            rt, rs = t(node.right)
+            return lt or rt, ls or rs
+        if isinstance(node, ast.UnaryOp):
+            return t(node.operand)
+        if isinstance(node, ast.IfExp):
+            parts = [t(node.test), t(node.body), t(node.orelse)]
+            return any(a for a, _ in parts), any(s for _, s in parts)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            parts = [t(e) for e in node.elts]
+            return any(a for a, _ in parts), any(s for _, s in parts)
+        if isinstance(node, ast.Starred):
+            return t(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return t(node.value)
+        return False, False
+
+    _of = of
+
+
+def _seed_params(fdef) -> Set[str]:
+    """Non-self parameters assumed to carry tensors — minus ones whose
+    DEFAULT is a plain scalar/str/None (config knobs, not data)."""
+    a = fdef.args
+    params = [p.arg for p in a.posonlyargs + a.args]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    scalarish: Set[str] = set()
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        if isinstance(d, ast.Constant) and \
+                isinstance(d.value, _SCALAR_DEFAULT_TYPES):
+            scalarish.add(p.arg)
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if isinstance(d, ast.Constant) and \
+                isinstance(d.value, _SCALAR_DEFAULT_TYPES):
+            scalarish.add(p.arg)
+        else:
+            params.append(p.arg)
+    return {p for p in params if p not in scalarish}
+
+
+def _taint_fixpoint(fdef, seeds: Set[str]) -> Set[str]:
+    """Order-insensitive name-taint closure over the function body: a name
+    assigned from a tainted expression becomes tainted. Sound
+    over-approximation (a name reused for host values stays flagged —
+    suppressions cover the rare intentional case)."""
+    tainted = set(seeds)
+    assigns = []
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Assign):
+            assigns.append((n.targets, n.value))
+        elif isinstance(n, ast.AugAssign):
+            assigns.append(([n.target], n.value))
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            assigns.append(([n.target], n.value))
+        elif isinstance(n, ast.NamedExpr):
+            assigns.append(([n.target], n.value))
+        elif isinstance(n, ast.For):
+            assigns.append(([n.target], n.iter))
+    for _ in range(len(assigns) + 1):
+        changed = False
+        tt = _Taint(tainted)
+        for targets, value in assigns:
+            vt, _ = tt.of(value)
+            if not vt:
+                continue
+            for tgt in targets:
+                for nm in ast.walk(tgt):
+                    if isinstance(nm, ast.Name) and nm.id not in tainted:
+                        tainted.add(nm.id)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+class _RegionLinter(ast.NodeVisitor):
+    """Flagging pass over one traced function's body (nested defs and
+    lambdas included — the traced region covers them)."""
+
+    def __init__(self, path: str, func: str, tainted: Set[str],
+                 full: bool):
+        self.path, self.func = path, func
+        self.taint = _Taint(tainted)
+        self.full = full            # taint-based rules enabled
+        self.findings: List[Finding] = []
+
+    def _add(self, rule: str, node, message: str):
+        self.findings.append(Finding(
+            rule, message, path=self.path, line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0), func=self.func))
+
+    # -- calls: host syncs, RNG, print --
+    def visit_Call(self, node):
+        chain = _dotted(node.func)
+        if chain and chain[-1] in _HOST_SYNC_METHODS \
+                and isinstance(node.func, ast.Attribute):
+            self._add("host-sync", node,
+                      f".{chain[-1]}() forces a device->host sync in a "
+                      "traced region")
+        elif _is_stdlib_random(chain):
+            self._add("stdlib-random", node,
+                      f"{'.'.join(chain)}() is host RNG: its value is "
+                      "burned in at trace time (use paddle ops riding the "
+                      "trace key)")
+        elif chain == ("print",):
+            self._add("traced-print", node,
+                      "print() in a traced region runs at trace time only")
+        elif self.full and len(chain) == 1 \
+                and chain[0] in _HOST_SYNC_BUILTINS and node.args:
+            at, _ = self.taint.of(node.args[0])
+            if at:
+                self._add("host-sync", node,
+                          f"{chain[0]}(tensor) concretizes a traced value "
+                          "(device->host sync)")
+        elif self.full and len(chain) == 2 and chain[0] in ("np", "numpy") \
+                and chain[1] in ("asarray", "array") and node.args:
+            at, _ = self.taint.of(node.args[0])
+            if at:
+                self._add("host-sync", node,
+                          f"{'.'.join(chain)}(tensor) pulls a traced value "
+                          "to the host")
+        self.generic_visit(node)
+
+    # -- control flow on tensors / shapes --
+    def _check_test(self, node, test, kind: str):
+        if not self.full:
+            return
+        tt, ts = self.taint.of(test)
+        if tt:
+            self._add("tensor-branch", node,
+                      f"`{kind}` on a tensor value is data-dependent "
+                      "Python control flow (untraceable predicate)")
+        elif ts:
+            self._add("shape-capture", node,
+                      f"`{kind}` on a tensor shape forks a separate "
+                      "compilation per input shape (retrace storm)")
+
+    def visit_If(self, node):
+        self._check_test(node, node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_test(node, node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._check_test(node, node.test, "assert")
+        self.generic_visit(node)
+
+
+def _iter_scan_units(tree) -> Iterable[Tuple[ast.AST, bool]]:
+    """(function node, is_method) for every top-level and class-level def.
+    Nested defs are scanned as part of their parent's region."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, False
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub, True
+
+
+def _is_trace_destined(fdef, is_method: bool, entries) -> bool:
+    if fdef.name in entries or fdef.name == "forward":
+        return True
+    return any(_decorator_traced(d) for d in fdef.decorator_list)
+
+
+def lint_source(source: str, path: str = "<string>",
+                all_functions: bool = False,
+                entries: Sequence[str] = (),
+                assume_traced: bool = False) -> List[Finding]:
+    """Lint one module's source. Returns suppression-filtered findings."""
+    try:
+        tree = ast.parse(textwrap.dedent(source))
+    except SyntaxError as e:
+        return [Finding("parse-error", f"unparseable source: {e}", path=path,
+                        line=getattr(e, "lineno", 0) or 0,
+                        severity="info")]
+    sup = Suppressions(source)
+    findings: List[Finding] = []
+    entries = set(entries)
+    for fdef, is_method in _iter_scan_units(tree):
+        traced = assume_traced or _is_trace_destined(fdef, is_method, entries)
+        if not traced and not all_functions:
+            continue
+        if not traced and (fdef.name.startswith("__")
+                           and fdef.name.endswith("__")):
+            continue                     # dunders are never traced regions
+        tainted = _taint_fixpoint(fdef, _seed_params(fdef)) if traced \
+            else set()
+        linter = _RegionLinter(path, fdef.name, tainted, full=traced)
+        for stmt in fdef.body:
+            linter.visit(stmt)
+        findings.extend(linter.findings)
+    return sup.apply(findings)
+
+
+def lint_file(path: str, all_functions: bool = False,
+              entries: Sequence[str] = ()) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, path=path, all_functions=all_functions,
+                       entries=entries)
+
+
+def lint_paths(paths: Sequence[str], all_functions: bool = False,
+               entries: Sequence[str] = ()) -> Tuple[List[Finding], int]:
+    """Lint files/directories recursively. Returns (findings, n_files).
+    Raises FileNotFoundError for a missing path (CLI maps it to exit 2)."""
+    import os
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        elif os.path.isfile(p):
+            files.append(p)
+        else:
+            raise FileNotFoundError(p)
+    findings: List[Finding] = []
+    for f in files:
+        findings.extend(lint_file(f, all_functions=all_functions,
+                                  entries=entries))
+    return findings, len(files)
+
+
+def lint_callable(fn, path: Optional[str] = None) -> List[Finding]:
+    """Lint a live function/method as a traced region (the trace-time
+    FLAGS_lint hook). Source unavailable -> no findings, never an error."""
+    fn = inspect.unwrap(getattr(fn, "__dy2static_original__", fn))
+    if inspect.ismethod(fn):
+        fn = fn.__func__
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        fname = path or inspect.getsourcefile(fn) or "<callable>"
+    except (OSError, TypeError):
+        return []
+    findings = lint_source(src, path=fname, assume_traced=True)
+    # re-anchor fixture/<string> line numbers onto the real file
+    try:
+        base = fn.__code__.co_firstlineno - 1
+        for f in findings:
+            f.line += base
+    except AttributeError:
+        pass
+    return findings

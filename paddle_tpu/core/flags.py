@@ -80,6 +80,11 @@ define_flag("monitor", False,
             "enable the paddle_tpu.monitor stats registry + trace spans "
             "(platform/monitor.h STAT registry role); off = the dispatch "
             "fast path pays one module-attribute check and nothing else")
+define_flag("lint", False,
+            "run tpu-lint (paddle_tpu.analysis) over functions as they are "
+            "traced by @to_static/TrainStep: trace-hazard warnings + "
+            "lint.findings/lint.files monitor counters, once per function; "
+            "off = one module-attribute check at trace time only")
 
 # ---- resilience plane (paddle_tpu.faults + self-healing knobs) ----
 define_flag("fault_inject", "",

@@ -215,6 +215,15 @@ class FleetExecutor:
         self.stages = list(stages)
         self.max_inflight = max_inflight
 
+    def verify(self, sample):
+        """Static task-graph check (tpu-lint stage-graph rule): prove each
+        stage's output can feed the next stage by abstract evaluation,
+        naming the first broken edge instead of hanging run() until its
+        timeout. `sample` is an example stage-0 microbatch (array or
+        ShapeDtypeStruct). Returns the findings list (empty = clean)."""
+        from ..analysis.graph import verify_stage_chain
+        return verify_stage_chain(self.stages, sample)
+
     def run(self, microbatches: Sequence, timeout: float = 120.0) -> List:
         """Feed microbatches into stage 0; returns ordered stage-N outputs."""
         bus = MessageBus()
@@ -528,6 +537,17 @@ class DistFleetExecutor:
         owner_map = dict(stage_owner)
         owner_map[self.sink_id] = self.sink_owner
         bus.owner_of.update(owner_map)
+
+    def verify(self):
+        """Static ownership check of the distributed task graph (tpu-lint
+        stage-graph rule): every stage must have exactly one owning rank
+        and this rank must only host stages mapped to it — an unowned or
+        doubly-hosted stage is a pipeline that hangs or double-consumes.
+        Returns the findings list (empty = clean)."""
+        from ..analysis.graph import verify_stage_assignment
+        return verify_stage_assignment(self.stage_owner, self.n_stages,
+                                       my_rank=self.bus.rank,
+                                       my_stages=self.my_stages.keys())
 
     def run(self, microbatches: Optional[Sequence] = None, n_micro: int = 0,
             timeout: float = 120.0):

@@ -18,6 +18,7 @@ import functools
 
 import jax
 
+from .. import analysis as _analysis
 from .. import monitor as _monitor
 from ..core import random as rnd
 from ..core.tensor import Tensor
@@ -156,6 +157,10 @@ class StaticFunction:
         # cost on the hot path).
         sig = tuple((t._value.shape, str(t._value.dtype)) for t in diff_inputs)
         if getattr(self, "_prog_sig", None) != sig:
+            if _analysis._ENABLED:
+                # trace-time tpu-lint: novel-signature block only, so the
+                # steady-state call path never reaches this check
+                _analysis.lint_traced(self._function, "to_static")
             if sig not in self._seen_sigs:
                 # a NOVEL signature on a to_static capture = retrace: the
                 # whole program recompiles for the new shapes/dtypes. A

@@ -19,6 +19,7 @@ import time as _time
 import jax
 import jax.numpy as jnp
 
+from .. import analysis as _analysis
 from .. import monitor as _monitor
 from ..core import random as rnd
 from ..core.tensor import Tensor
@@ -69,6 +70,12 @@ class TrainStep:
         from ..core import flags as _flags
         if _monitor._ENABLED:
             _monitor.count("jit.train_step.builds")
+        if _analysis._ENABLED:
+            # trace-time tpu-lint on the functions about to be traced into
+            # the step executable (build runs once; __call__ pays nothing)
+            _analysis.lint_traced(getattr(self.model, "forward", self.model),
+                                  "train_step")
+            _analysis.lint_traced(self.loss_fn, "train_step")
         # FLAGS_check_nan_inf for the COMPILED hot loop (operator.cc:1171
         # role): the per-op eager scan can't see inside a jitted step, so
         # the finite-check is traced INTO the executable — one fused

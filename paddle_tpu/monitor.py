@@ -21,6 +21,10 @@ registry of counters, gauges and histograms that every layer reports into:
     `guard.desync_checks`/`guard.desync_errors` counters — every recovery
     the supervisor performs is visible next to the fault that provoked it;
     `amp.skipped_steps`/`amp.scale_updates` from the GradScaler
+  - static analysis (`analysis/` tpu-lint, behind `FLAGS_lint`):
+    `lint.findings` (trace hazards found at trace time) / `lint.files`
+    (distinct source files linted) — a nonzero findings counter in a
+    training job is a retrace storm or host sync waiting to happen
   - serving (`serving/engine.py`): `serving.queue_depth` gauge,
     `serving.queue_wait`/`serving.e2e_latency`/`serving.batch_size`
     histograms, `serving.padding_waste_elems`/`serving.padded_rows`,

@@ -151,7 +151,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
     spatial = x.shape[2:] if cf else x.shape[1:-1]
     if size is not None:
         if isinstance(size, Tensor):
-            size = size.tolist()
+            size = size.tolist()  # tpu-lint: disable=host-sync (paddle API: Tensor size -> static ints)
         out_spatial = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
     else:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
@@ -409,7 +409,7 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
     import jax.numpy as jnp
     from ...ops._dispatch import ensure_tensor as _et, run_op
     x = _et(x)
-    l, r, t, b = [int(v) for v in (padding.numpy() if hasattr(padding, "numpy")
+    l, r, t, b = [int(v) for v in (padding.numpy() if hasattr(padding, "numpy")  # tpu-lint: disable=host-sync (paddle API: Tensor padding -> static ints)
                                    else padding)]
 
     def f(a):

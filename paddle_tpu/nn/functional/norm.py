@@ -28,9 +28,14 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     use_batch = training and not use_global_stats
     if use_batch:
         with no_grad():
-            bm = jnp.mean(x._value, axis=reduce_axes)
-            bv = jnp.var(x._value, axis=reduce_axes)
-            if running_mean is not None and not isinstance(bm, jax.core.Tracer):
+            # the tracer check gates the COMPUTATION, not just the buffer
+            # write: under trace the update is discarded anyway, and
+            # computing bm/bv first left 3 dead eqns per BN layer in every
+            # traced training program (found by tpu-lint's dead-op rule)
+            if running_mean is not None and not isinstance(
+                    x._value, jax.core.Tracer):
+                bm = jnp.mean(x._value, axis=reduce_axes)
+                bv = jnp.var(x._value, axis=reduce_axes)
                 running_mean._value = (momentum * running_mean._value
                                        + (1 - momentum) * bm.astype(running_mean._value.dtype))
                 running_var._value = (momentum * running_var._value

@@ -109,7 +109,7 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
     """theta [N,2,3] -> sampling grid [N,H,W,2] for grid_sample."""
     theta = ensure_tensor(theta)
     if hasattr(out_shape, "numpy"):
-        out_shape = [int(v) for v in out_shape.numpy().reshape(-1)]
+        out_shape = [int(v) for v in out_shape.numpy().reshape(-1)]  # tpu-lint: disable=host-sync (paddle API: Tensor out_shape -> static ints)
     N, _, H, W = [int(v) for v in out_shape]
 
     def f(th):

@@ -129,7 +129,7 @@ class Assign(Initializer):
         self.value = value
 
     def _generate(self, shape, dtype):
-        arr = self.value.numpy() if isinstance(self.value, Tensor) else np.asarray(self.value)
+        arr = self.value.numpy() if isinstance(self.value, Tensor) else np.asarray(self.value)  # tpu-lint: disable=host-sync (host-side param init)
         if tuple(arr.shape) != tuple(shape):
             arr = arr.reshape(shape)
         return jnp.asarray(arr, dtype=dtype)

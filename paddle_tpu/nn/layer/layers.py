@@ -233,7 +233,7 @@ class Layer:
             if k not in own:
                 unexpected.append(k)
                 continue
-            arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+            arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)  # tpu-lint: disable=host-sync (host-side state load)
             tgt = own[k]
             if tuple(arr.shape) != tuple(tgt.shape):
                 raise ValueError(f"shape mismatch for {k}: {arr.shape} vs {tuple(tgt.shape)}")

@@ -20,7 +20,7 @@ from ._dispatch import ensure_tensor, inplace_from, nondiff_op, run_op, to_arr
 def _norm_shape(shape, cur_shape):
     """Paddle reshape semantics: -1 infers, 0 copies the input dim."""
     if isinstance(shape, Tensor):
-        shape = shape.tolist()
+        shape = shape.tolist()  # tpu-lint: disable=host-sync (paddle API: Tensor shape -> static ints)
     shape = [int(s) for s in shape]
     out = []
     for i, s in enumerate(shape):
@@ -144,8 +144,8 @@ def unbind(x, axis=0, name=None):
 def slice(x, axes, starts, ends, name=None):
     x = ensure_tensor(x)
     axes = [int(a) for a in axes]
-    starts = [int(to_arr(s)) for s in (starts.tolist() if isinstance(starts, Tensor) else starts)]
-    ends = [int(to_arr(e)) for e in (ends.tolist() if isinstance(ends, Tensor) else ends)]
+    starts = [int(to_arr(s)) for s in (starts.tolist() if isinstance(starts, Tensor) else starts)]  # tpu-lint: disable=host-sync (paddle API: Tensor starts -> static ints)
+    ends = [int(to_arr(e)) for e in (ends.tolist() if isinstance(ends, Tensor) else ends)]  # tpu-lint: disable=host-sync (paddle API: Tensor ends -> static ints)
 
     def f(a):
         idx = [builtins.slice(None)] * a.ndim
@@ -257,7 +257,7 @@ def scatter_nd(index, updates, shape, name=None):
 def tile(x, repeat_times, name=None):
     x = ensure_tensor(x)
     if isinstance(repeat_times, Tensor):
-        repeat_times = repeat_times.tolist()
+        repeat_times = repeat_times.tolist()  # tpu-lint: disable=host-sync (paddle API: Tensor repeats -> static ints)
     reps = [int(r) for r in repeat_times]
     return run_op(lambda a: jnp.tile(a, reps), [x], "tile")
 
@@ -265,7 +265,7 @@ def tile(x, repeat_times, name=None):
 def expand(x, shape, name=None):
     x = ensure_tensor(x)
     if isinstance(shape, Tensor):
-        shape = shape.tolist()
+        shape = shape.tolist()  # tpu-lint: disable=host-sync (paddle API: Tensor shape -> static ints)
     tgt = []
     shape = [int(s) for s in shape]
     xs = [1] * (len(shape) - x.ndim) + x.shape
@@ -322,7 +322,7 @@ def where(condition, x=None, y=None, name=None):
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     x = ensure_tensor(x)
     if isinstance(pad, Tensor):
-        pad = pad.tolist()
+        pad = pad.tolist()  # tpu-lint: disable=host-sync (paddle API: Tensor pad -> static ints)
     pad = [int(p) for p in pad]
     nd = x.ndim
     if len(pad) == 2 * nd:
@@ -506,7 +506,7 @@ def crop(x, shape=None, offsets=None, name=None):
     (`python/paddle/tensor/manipulation.py` crop / crop_tensor op).
     shape entries of -1 keep the rest of that dim; offsets default 0."""
     x = ensure_tensor(x)
-    get = lambda v: [int(i) for i in (v.numpy().reshape(-1) if hasattr(v, "numpy")
+    get = lambda v: [int(i) for i in (v.numpy().reshape(-1) if hasattr(v, "numpy")  # tpu-lint: disable=host-sync (paddle API: Tensor box -> static ints)
                                       else v)]  # noqa: E731
     shp = get(shape) if shape is not None else list(x.shape)
     offs = get(offsets) if offsets is not None else [0] * len(shp)

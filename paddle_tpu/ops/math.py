@@ -198,7 +198,7 @@ def _axis_arg(axis):
     if axis is None:
         return None
     if isinstance(axis, Tensor):
-        axis = axis.tolist()
+        axis = axis.tolist()  # tpu-lint: disable=host-sync (paddle API: Tensor axis -> static ints)
     if isinstance(axis, (list, tuple)):
         return tuple(int(a) for a in axis)
     return int(axis)

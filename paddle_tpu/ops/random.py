@@ -26,7 +26,7 @@ __all__ = [
 
 def _shape_list(shape):
     if isinstance(shape, Tensor):
-        shape = shape.tolist()
+        shape = shape.tolist()  # tpu-lint: disable=host-sync (paddle API: Tensor shape -> static ints)
     if isinstance(shape, (int, np.integer)):
         shape = [int(shape)]
     return [int(s) for s in shape]

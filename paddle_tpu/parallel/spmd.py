@@ -169,8 +169,14 @@ class SPMDTrainStep:
         donate = (0, 1) if self._donate else ()
         self._jitted = jax.jit(pure, in_shardings=in_sh, out_shardings=out_sh,
                                donate_argnums=donate)
+        self._pure = pure   # unjitted body: collective_signature/tpu-lint
         self._pspecs = pspecs
         self._sspecs = sspecs
+        from .. import analysis as _analysis
+        if _analysis._ENABLED:
+            _analysis.lint_traced(getattr(model, "forward", model),
+                                  "spmd_train_step")
+            _analysis.lint_traced(loss_fn, "spmd_train_step")
 
         # place params/slots/buffers on the mesh once (avoids per-step resharding)
         for p, spec in zip(ptensors, pspecs):
@@ -183,6 +189,29 @@ class SPMDTrainStep:
         if pending is not None:  # set_state_dict before the first step
             self._pending_state = None
             self._apply_state(pending)
+
+    def collective_signature(self, *batch):
+        """The step's static collective sequence (tpu-lint collective-order
+        rule): trace the unjitted step body and extract every explicit
+        collective as `analysis.graph.CollectiveDesc`s. Feed the per-rank /
+        per-stage results to `analysis.verify_collective_order` to prove
+        the sequences agree BEFORE a pod slice deadlocks on a divergence.
+        (GSPMD-inserted collectives are compiler-chosen and not part of the
+        static signature; explicit ones — mp/pp/sp ops traced through
+        `parallel.collective` inside shard_map regions — are.)"""
+        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in batch]
+        if self._jitted is None:
+            self._build(arrs)
+        trainable, frozen = split_state(self.model)
+        params = [trainable[n]._value for n in self._pnames]
+        buffers = [frozen[n]._value for n in self._bnames]
+        key = rnd.default_generator().next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        t = jnp.asarray(self.optimizer._step_count + 1, jnp.float32)
+        from ..analysis.graph import collective_sequence
+        return collective_sequence(self._pure, params, self._slots, buffers,
+                                   key, lr, t, arrs)
 
     # ---- full loop-state capture (guard plane: preemption-safe resume) ----
     def named_param_arrays(self):

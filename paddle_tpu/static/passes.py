@@ -62,9 +62,16 @@ def list_passes():
 
 
 def apply_pass(program, name: str, **options):
-    """Return a NEW Program with the named pass applied to its function."""
+    """Return a NEW Program with the named pass applied to its function.
+
+    Two pass kinds: function passes (`Callable[[fn], fn]`, the default) and
+    PROGRAM passes (marked `_program_pass = True`) which receive the whole
+    Program — analysis passes like 'lint' need the arg specs, not just the
+    function."""
     from .program import Program
     p = get_pass(name)
+    if getattr(p, "_program_pass", False):
+        return p(program, **options)
     new_fn = p(program._fn, **options)
     return Program.from_callable(new_fn, program._arg_specs,
                                  name=f"{program.name}+{name}")
@@ -193,3 +200,92 @@ def _bf16_io_pass(fn):
                 else a for a in args]
         return fn(*cast)
     return wrapped
+
+
+def _eval_live(jaxpr, consts, live, *args):
+    """Re-execute only the live eqns (liveness guarantees a dead eqn's
+    outputs are never read downstream). Recurses into single-body regions
+    (pjit/jit, remat, closed_call — same region policy as
+    `_eval_with_rewrites`), so a to_static capture's pjit wrapper is DCE'd
+    through; scan/while/cond bodies stay atomic."""
+    from ..analysis.graph import live_eqn_mask
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, jex_core.Literal) else env[v]
+
+    def write(v, val):
+        env[v] = val
+
+    for v, c in zip(jaxpr.constvars, consts):
+        write(v, c)
+    for v, a in zip(jaxpr.invars, args):
+        write(v, a)
+    for eqn, keep in zip(jaxpr.eqns, live):
+        if not keep:
+            continue
+        invals = [read(v) for v in eqn.invars]
+        prim = eqn.primitive.name
+        if "jaxpr" in eqn.params and prim not in ("scan", "while", "cond"):
+            inner = eqn.params["jaxpr"]
+            if hasattr(inner, "jaxpr"):        # ClosedJaxpr
+                sub, consts_ = inner.jaxpr, inner.consts
+            else:                              # plain Jaxpr (remat)
+                sub, consts_ = inner, ()
+            outs = _eval_live(sub, consts_, live_eqn_mask(sub), *invals)
+        else:
+            outs = eqn.primitive.bind(*invals, **eqn.params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+        for v, o in zip(eqn.outvars, outs):
+            write(v, o)
+    return [read(v) for v in jaxpr.outvars]
+
+
+@register_pass("dead_op_elim")
+def _dead_op_elim_pass(fn):
+    """Dead-op elimination backed by tpu-lint's liveness analysis
+    (`analysis.graph.live_eqn_mask`) — the reference's
+    `identity_op_clean`/DCE pass family. XLA would DCE the dead work at
+    compile anyway; eliminating it HERE shrinks the traced program, so
+    introspection (`ops()`, golden snapshots), lowering, and compile all
+    stop paying for ops whose results nothing consumes. Descends through
+    single-body regions (pjit/remat); scan/while/cond bodies stay atomic
+    (live iff consumed)."""
+    def rewritten(*args):
+        from ..analysis.graph import live_eqn_mask
+        closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+        live = live_eqn_mask(closed.jaxpr)
+        out = _eval_live(closed.jaxpr, closed.consts, live, *args)
+        treedef = jax.tree_util.tree_structure(out_shape)
+        return jax.tree_util.tree_unflatten(treedef, out)
+    return rewritten
+
+
+def _lint_pass(program, fail_on: str = None):
+    """Analysis-only PROGRAM pass: run tpu-lint's graph rules (dead ops,
+    unused inputs, f64 widenings, host callbacks) over the program and its
+    source lint over the captured function. Findings are warned and stored
+    on the returned program as `.lint_findings`; with `fail_on=` set
+    ('warning'/'error'), findings at/above that severity raise ValueError
+    — the compile-time gate (`apply_pass(prog, 'lint', fail_on='error')`)."""
+    import warnings
+    from ..analysis import lint_callable
+    from ..analysis.base import severity_at_least
+    from ..analysis.graph import analyze_program
+    findings = analyze_program(program)
+    findings += lint_callable(program._fn)
+    for f in findings:
+        warnings.warn(f"tpu-lint[pass]: {f.format()}")
+    program.lint_findings = findings
+    if fail_on is not None:
+        bad = [f for f in findings if severity_at_least(f.severity, fail_on)]
+        if bad:
+            raise ValueError(
+                f"lint pass: {len(bad)} finding(s) at/above {fail_on}:\n" +
+                "\n".join(f.format() for f in bad))
+    return program
+
+
+_lint_pass._program_pass = True
+register_pass("lint", _lint_pass)

@@ -1,0 +1,733 @@
+"""tpu-lint static-analysis plane: source rules (one positive + one clean
+fixture per rule), suppressions, graph rules (dead ops, unused inputs, f64
+widening, host callbacks), collective-ordering verification between
+deliberately-skewed pipeline-stage programs, the dead_op_elim/lint passes,
+the CLI (exit codes + JSON), FLAGS_lint trace-time wiring with its
+disabled-path overhead guard, and the repo self-lint gate (shipped models/
+nn/ops must stay trace-clean).
+
+Reference roles: the analysis half of `paddle/fluid/framework/ir/` (pass
+framework graph walks) + compile-time precondition checks.
+"""
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import analysis, monitor
+from paddle_tpu.analysis import cli as lint_cli
+from paddle_tpu.analysis import graph as agraph
+from paddle_tpu.analysis.lint import lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+@pytest.fixture()
+def linted():
+    """Enable FLAGS_lint on a clean registry/cache; always restore."""
+    monitor.reset()
+    analysis._reset_trace_cache()
+    paddle.set_flags({"FLAGS_lint": True})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_lint": False})
+        analysis._reset_trace_cache()
+        monitor.reset()
+
+
+# ---------------------------------------------------------------------------
+# level 1: source lint
+# ---------------------------------------------------------------------------
+
+class TestSourceLint:
+    def test_host_sync_positive(self):
+        src = """
+def forward(self, x):
+    y = x.numpy()
+    z = float(x)
+    w = x.item()
+    return y, z, w
+"""
+        rules = rules_of(lint_source(src, "f.py"))
+        assert rules.count("host-sync") == 3
+
+    def test_host_sync_clean(self):
+        src = """
+def forward(self, x):
+    return (x * 2 + 1).reshape([-1])
+"""
+        assert lint_source(src, "f.py") == []
+
+    def test_tensor_branch_positive(self):
+        src = """
+def forward(self, x):
+    if x > 0:
+        x = x * 2
+    while x.sum() < 10:
+        x = x + 1
+    assert x.mean() > 0
+    return x
+"""
+        assert rules_of(lint_source(src, "f.py")) == [
+            "tensor-branch", "tensor-branch", "tensor-branch"]
+
+    def test_tensor_branch_clean_static_predicates(self):
+        # identity tests, self attrs, scalar-default kwargs, isinstance —
+        # all host-static predicates that must NOT flag
+        src = """
+def forward(self, x, mask=None, use_cache=False):
+    if mask is not None:
+        x = x + mask
+    if use_cache:
+        x = x * 1
+    if self.training:
+        x = x * 2
+    if isinstance(x, tuple):
+        x = x[0]
+    return x
+"""
+        assert lint_source(src, "f.py") == []
+
+    def test_taint_propagates_through_assignment(self):
+        src = """
+def forward(self, x):
+    y = x * 2
+    z = y + 1
+    if z > 0:
+        z = z - 1
+    return z
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["tensor-branch"]
+
+    def test_traced_print(self):
+        src = """
+def forward(self, x):
+    print(x)
+    return x
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["traced-print"]
+
+    def test_stdlib_random_positive(self):
+        src = """
+def forward(self, x):
+    import random
+    a = random.random()
+    b = np.random.rand(3)
+    c = numpy.random.randint(0, 2)
+    return x + a + b + c
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["stdlib-random"] * 3
+
+    def test_stdlib_random_clean_framework_rng(self):
+        src = """
+def forward(self, x):
+    noise = paddle.rand([4])      # rides the trace key: fine
+    return x + noise
+"""
+        assert lint_source(src, "f.py") == []
+
+    def test_shape_capture_positive(self):
+        src = """
+def forward(self, x):
+    if x.shape[0] > 8:
+        x = x * 2
+    while len(x) > 4:
+        x = x[:-1]
+    return x
+"""
+        assert rules_of(lint_source(src, "f.py")) == [
+            "shape-capture", "shape-capture"]
+
+    def test_shape_capture_clean_static_uses(self):
+        src = """
+def forward(self, x):
+    b = x.shape[0]
+    for i in range(x.shape[1]):
+        x = x + i
+    return x.reshape([b, -1])
+"""
+        assert lint_source(src, "f.py") == []
+
+    def test_default_mode_scans_only_trace_destined(self):
+        src = """
+def helper(x):
+    return x.numpy()
+
+def forward(self, x):
+    return x + 1
+"""
+        assert lint_source(src, "f.py") == []
+        rules = rules_of(lint_source(src, "f.py", all_functions=True))
+        assert rules == ["host-sync"]
+
+    def test_decorated_function_is_trace_destined(self):
+        src = """
+@paddle.jit.to_static
+def step(x):
+    print(x)
+    return x
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["traced-print"]
+
+    def test_nested_functions_are_in_region(self):
+        src = """
+def forward(self, x):
+    def inner(v):
+        return v.numpy()
+    return inner(x)
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["host-sync"]
+
+    def test_suppression_same_line(self):
+        src = """
+def forward(self, x):
+    y = x.numpy()  # tpu-lint: disable=host-sync
+    z = x.numpy()
+    return y, z
+"""
+        fs = lint_source(src, "f.py")
+        assert rules_of(fs) == ["host-sync"] and fs[0].line == 4
+
+    def test_suppression_file_wide_and_all(self):
+        src = """
+# tpu-lint: disable=host-sync
+def forward(self, x):
+    print(x)
+    return x.numpy()
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["traced-print"]
+        src_all = src.replace("disable=host-sync", "disable=all")
+        assert lint_source(src_all, "f.py") == []
+
+
+# ---------------------------------------------------------------------------
+# level 2: graph analysis
+# ---------------------------------------------------------------------------
+
+class TestGraphAnalysis:
+    def test_dead_op_and_unused_var(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x, y):
+            dead = jnp.sin(x) * 3.0   # noqa: F841 — the fixture hazard
+            return x + 1.0
+
+        j = jax.make_jaxpr(f)(jnp.ones(3), jnp.ones(3))
+        fs = agraph.analyze_jaxpr(j, "f")
+        assert "dead-op" in rules_of(fs)
+        assert any(f.rule == "unused-var" and "#1" in f.message for f in fs)
+        assert any("sin" in f.message for f in fs)
+
+    def test_clean_program_has_no_findings(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x, y):
+            return (x * y).sum()
+
+        assert agraph.analyze_jaxpr(jax.make_jaxpr(f)(
+            jnp.ones(3), jnp.ones(3)), "f") == []
+
+    def test_dtype_widen(self):
+        import jax
+        import jax.numpy as jnp
+
+        with jax.experimental.enable_x64():
+            def f(x):
+                return x.astype(jnp.float64) * 2.0
+
+            j = jax.make_jaxpr(f)(jnp.ones(3, jnp.float32))
+
+            def g(x):
+                return x * 2.0
+
+            j_clean = jax.make_jaxpr(g)(jnp.ones(3, jnp.float32))
+        fs = agraph.analyze_jaxpr(j, "f")
+        assert rules_of(fs) == ["dtype-widen"]
+        assert "float64" in fs[0].message
+        assert agraph.analyze_jaxpr(j_clean, "g") == []
+
+    def test_host_callback(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            jax.debug.print("x={}", x)
+            return x * 2
+
+        fs = agraph.analyze_jaxpr(jax.make_jaxpr(f)(jnp.ones(3)), "f")
+        assert "host-callback" in rules_of(fs)
+
+    def test_analyze_program(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static.program import Program
+
+        def f(x):
+            dead = jnp.cos(x)         # noqa: F841
+            return x + 1
+
+        prog = Program.from_callable(
+            f, [jax.ShapeDtypeStruct((4,), jnp.float32)])
+        assert "dead-op" in rules_of(agraph.analyze_program(prog))
+
+
+# ---------------------------------------------------------------------------
+# collective-ordering verification
+# ---------------------------------------------------------------------------
+
+def _mesh(axis="pp"):
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:8]).reshape(8), (axis,))
+
+
+def _shmap(fn, mesh, **kw):
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    return shard_map(fn, mesh=mesh, in_specs=P("pp"), out_specs=P("pp"),
+                     **kw)
+
+
+_PERM = [(i, (i + 1) % 8) for i in range(8)]
+
+
+class TestCollectiveOrder:
+    def test_sequence_extraction(self):
+        import jax
+        import jax.numpy as jnp
+
+        def stage(x):
+            x = jax.lax.psum(x, "pp")
+            return jax.lax.ppermute(x, "pp", _PERM)
+
+        seq = agraph.collective_sequence(_shmap(stage, _mesh()),
+                                         jnp.ones((8, 4)))
+        assert [c.op for c in seq] == ["psum", "ppermute"]
+        assert seq[0].axis == "pp" and seq[0].dtype == "float32"
+
+    def test_check_rep_does_not_change_signature(self):
+        # psum is rewritten to psum2+pbroadcast under check_rep=True; the
+        # signature must be invariant to that bookkeeping
+        import jax
+        import jax.numpy as jnp
+
+        def stage(x):
+            x = jax.lax.psum(x, "pp")
+            return jax.lax.ppermute(x, "pp", _PERM)
+
+        m = _mesh()
+        x = jnp.ones((8, 4))
+        a = agraph.collective_sequence(_shmap(stage, m), x)
+        b = agraph.collective_sequence(_shmap(stage, m, check_rep=False), x)
+        assert a == b
+
+    def test_mismatch_names_first_divergence(self):
+        # two 2-stage pipeline programs, deliberately skewed: rank1 swaps
+        # the order of its first stage's collectives
+        import jax
+        import jax.numpy as jnp
+
+        def r0_s0(x):
+            x = jax.lax.psum(x, "pp")
+            return jax.lax.ppermute(x, "pp", _PERM)
+
+        def r1_s0(x):
+            x = jax.lax.ppermute(x, "pp", _PERM)
+            return jax.lax.psum(x, "pp")
+
+        m = _mesh()
+        x = jnp.ones((8, 4))
+        fs = agraph.verify_collective_order(
+            {"rank0": _shmap(r0_s0, m), "rank1": _shmap(r1_s0, m)},
+            specs={"rank0": [x], "rank1": [x]})
+        assert rules_of(fs) == ["collective-order"]
+        msg = fs[0].message
+        assert "#0" in msg and "psum" in msg and "ppermute" in msg
+        assert "rank1" in msg
+
+    def test_length_mismatch_detected(self):
+        import jax
+        import jax.numpy as jnp
+
+        def long_stage(x):
+            x = jax.lax.psum(x, "pp")
+            return jax.lax.ppermute(x, "pp", _PERM)
+
+        def short_stage(x):
+            return jax.lax.psum(x, "pp")
+
+        m = _mesh()
+        x = jnp.ones((8, 4))
+        fs = agraph.verify_collective_order(
+            {"rank0": _shmap(long_stage, m), "rank1": _shmap(short_stage, m)},
+            specs={"rank0": [x], "rank1": [x]})
+        assert rules_of(fs) == ["collective-order"]
+        assert "never reaches" in fs[0].message
+
+    def test_matching_programs_clean(self):
+        import jax
+        import jax.numpy as jnp
+
+        def stage(x):
+            return jax.lax.psum(x, "pp")
+
+        m = _mesh()
+        x = jnp.ones((8, 4))
+        assert agraph.verify_collective_order(
+            {"rank0": _shmap(stage, m), "rank1": _shmap(stage, m)},
+            specs={"rank0": [x], "rank1": [x]}) == []
+
+    def test_precomputed_sequences_accepted(self):
+        a = [agraph.CollectiveDesc("psum", "dp", (4,), "float32")]
+        b = [agraph.CollectiveDesc("all_gather", "dp", (4,), "float32")]
+        fs = agraph.verify_collective_order({"r0": a, "r1": b})
+        assert rules_of(fs) == ["collective-order"]
+
+    def test_spmd_train_step_signature(self):
+        from paddle_tpu.parallel import (HybridCommunicateGroup,
+                                         SPMDTrainStep)
+        paddle.seed(0)
+        hcg = HybridCommunicateGroup(hybrid_configs={"dp_degree": 8})
+        model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+        opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                                   learning_rate=0.1)
+        step = SPMDTrainStep(model, nn.CrossEntropyLoss(), opt,
+                             mesh=hcg.get_mesh(), donate=False)
+        x = paddle.to_tensor(np.random.rand(16, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+        sig = step.collective_signature(x, y)
+        assert isinstance(sig, list)
+        # same-program signatures must verify clean rank-to-rank
+        assert agraph.verify_collective_order({"r0": sig, "r1": sig}) == []
+
+
+# ---------------------------------------------------------------------------
+# pipeline/task-graph verification
+# ---------------------------------------------------------------------------
+
+class TestStageGraph:
+    def test_chain_clean(self):
+        import jax.numpy as jnp
+        stages = [lambda x: x.reshape(4, 8),
+                  lambda x: x @ jnp.ones((8, 2))]
+        assert agraph.verify_stage_chain(stages, jnp.ones(32)) == []
+
+    def test_chain_broken_edge_named(self):
+        import jax.numpy as jnp
+        stages = [lambda x: x.reshape(4, 8),
+                  lambda x: x @ jnp.ones((5, 2))]
+        fs = agraph.verify_stage_chain(stages, jnp.ones(32))
+        assert rules_of(fs) == ["stage-graph"]
+        assert "stage 1" in fs[0].message and "stage 0" in fs[0].message
+
+    def test_fleet_executor_verify(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.fleet_executor import FleetExecutor
+        good = FleetExecutor([lambda x: x * 2, lambda x: x.sum()])
+        assert good.verify(jnp.ones(4)) == []
+        bad = FleetExecutor([lambda x: x.reshape(2, 2),
+                             lambda x: x @ jnp.ones((3, 3))])
+        assert rules_of(bad.verify(jnp.ones(4))) == ["stage-graph"]
+
+    def test_stage_assignment(self):
+        fs = agraph.verify_stage_assignment({0: 0, 2: 1}, 3)
+        assert rules_of(fs) == ["stage-graph"]
+        assert "stage 1" in fs[0].message
+        fs = agraph.verify_stage_assignment({0: 0, 1: 1}, 2, my_rank=0,
+                                            my_stages=[0, 1])
+        assert rules_of(fs) == ["stage-graph"]      # rank 0 hosting stage 1
+        assert agraph.verify_stage_assignment(
+            {0: 0, 1: 1}, 2, my_rank=1, my_stages=[1]) == []
+
+
+# ---------------------------------------------------------------------------
+# passes: dead_op_elim + lint
+# ---------------------------------------------------------------------------
+
+class TestPasses:
+    def _prog(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static.program import Program
+
+        def f(x):
+            dead = jnp.sin(x) * 2.0   # noqa: F841
+            return (x + 1.0).sum()
+
+        return Program.from_callable(
+            f, [jax.ShapeDtypeStruct((4,), jnp.float32)])
+
+    def test_dead_op_elim_removes_dead_eqns(self):
+        import jax
+        prog = self._prog()
+        opt = prog.apply_pass("dead_op_elim")
+        orig = [e.primitive.name
+                for e in jax.make_jaxpr(prog._fn)(*prog._arg_specs).eqns]
+        after = [e.primitive.name
+                 for e in jax.make_jaxpr(opt._fn)(*opt._arg_specs).eqns]
+        assert "sin" in orig and "sin" not in after
+        assert len(after) < len(orig)
+
+    def test_dead_op_elim_preserves_results(self):
+        import jax.numpy as jnp
+        prog = self._prog()
+        opt = prog.apply_pass("dead_op_elim")
+        x = jnp.arange(4.0)
+        np.testing.assert_allclose(np.asarray(opt.run(x)),
+                                   np.asarray(prog.run(x)), rtol=1e-6)
+
+    def test_lint_pass_warns_and_attaches_findings(self):
+        prog = self._prog()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = prog.apply_pass("lint")
+        assert any("tpu-lint" in str(x.message) for x in w)
+        assert "dead-op" in rules_of(out.lint_findings)
+
+    def test_lint_pass_gate_raises(self):
+        prog = self._prog()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(ValueError, match="dead-op"):
+                prog.apply_pass("lint", fail_on="warning")
+
+    def test_passes_registered(self):
+        from paddle_tpu.static.passes import list_passes
+        assert {"lint", "dead_op_elim"} <= set(list_passes())
+
+
+# ---------------------------------------------------------------------------
+# CLI driver
+# ---------------------------------------------------------------------------
+
+HAZARD_SRC = """
+def forward(self, x):
+    print(x)
+    return x.numpy()
+"""
+
+CLEAN_SRC = """
+def forward(self, x):
+    return x + 1
+"""
+
+
+class TestCLI:
+    def test_exit_1_on_errors(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(HAZARD_SRC)
+        assert lint_cli.main([str(p)]) == 1
+        out = capsys.readouterr().out
+        assert "host-sync" in out and "bad.py" in out
+
+    def test_exit_0_on_clean(self, tmp_path):
+        p = tmp_path / "ok.py"
+        p.write_text(CLEAN_SRC)
+        assert lint_cli.main([str(p)]) == 0
+
+    def test_exit_2_on_missing_path(self, tmp_path):
+        assert lint_cli.main([str(tmp_path / "nope.py")]) == 2
+
+    def test_fail_on_never_and_warning(self, tmp_path):
+        p = tmp_path / "warn.py"
+        p.write_text("def forward(self, x):\n    print(x)\n    return x\n")
+        assert lint_cli.main([str(p)]) == 0            # warning < error
+        assert lint_cli.main([str(p), "--fail-on", "warning"]) == 1
+        bad = tmp_path / "bad.py"
+        bad.write_text(HAZARD_SRC)
+        assert lint_cli.main([str(bad), "--fail-on", "never"]) == 0
+
+    def test_json_output(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(HAZARD_SRC)
+        rc = lint_cli.main([str(p), "--json", "--fail-on", "never"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["version"] == 1 and doc["files"] == 1
+        assert doc["counts"]["error"] == 1
+        rules = {f["rule"] for f in doc["findings"]}
+        assert rules == {"host-sync", "traced-print"}
+        assert all({"path", "line", "severity", "message"} <=
+                   set(f) for f in doc["findings"])
+
+    def test_rules_filter(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(HAZARD_SRC)
+        lint_cli.main([str(p), "--rules", "traced-print", "--json",
+                       "--fail-on", "never"])
+        doc = json.loads(capsys.readouterr().out)
+        assert {f["rule"] for f in doc["findings"]} == {"traced-print"}
+        lint_cli.main([str(p), "--disable", "host-sync", "--json",
+                       "--fail-on", "never"])
+        doc = json.loads(capsys.readouterr().out)
+        assert {f["rule"] for f in doc["findings"]} == {"traced-print"}
+
+    def test_directory_recursion_and_suppression(self, tmp_path, capsys):
+        sub = tmp_path / "pkg"
+        sub.mkdir()
+        (sub / "a.py").write_text(
+            "def forward(self, x):\n"
+            "    return x.numpy()  # tpu-lint: disable=host-sync\n")
+        (sub / "b.py").write_text(CLEAN_SRC)
+        assert lint_cli.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "2 file(s)" in out
+
+    def test_list_rules(self, capsys):
+        assert lint_cli.main(["--list-rules", "x"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("host-sync", "collective-order", "dead-op"):
+            assert rule in out
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_lint trace-time wiring + overhead guard
+# ---------------------------------------------------------------------------
+
+HAZARD_MODULE = """
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+@paddle.jit.to_static
+def noisy(x):
+    print("traced")
+    return x * 2
+
+class NoisyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 2)
+
+    def forward(self, x):
+        print("step")
+        return self.fc(x)
+"""
+
+
+def _load_module(tmp_path, name="lint_fixture"):
+    import importlib.util
+    p = tmp_path / f"{name}.py"
+    p.write_text(HAZARD_MODULE)
+    spec = importlib.util.spec_from_file_location(name, p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTraceTimeLint:
+    def test_to_static_warns_once_and_counts(self, tmp_path, linted):
+        mod = _load_module(tmp_path)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            mod.noisy(paddle.ones([3]))
+            mod.noisy(paddle.ones([5]))    # novel sig: no duplicate lint
+        msgs = [str(x.message) for x in w if "tpu-lint" in str(x.message)]
+        assert len(msgs) == 1 and "traced-print" in msgs[0]
+        snap = monitor.snapshot()["counters"]
+        assert snap.get("lint.findings") == 1
+        assert snap.get("lint.files") == 1
+
+    def test_train_step_lints_forward(self, tmp_path, linted):
+        mod = _load_module(tmp_path, "lint_fixture_ts")
+        model = mod.NoisyNet()
+        opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                                   learning_rate=0.1)
+        step = paddle.jit.TrainStep(
+            model, lambda out, y: ((out - y) ** 2).mean(), opt)
+        x = paddle.ones([2, 4])
+        y = paddle.zeros([2, 2])
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            step(x, y)
+        msgs = [str(m.message) for m in w if "tpu-lint" in str(m.message)]
+        assert any("traced-print" in m for m in msgs)
+        assert monitor.snapshot()["counters"].get("lint.findings", 0) >= 1
+
+    def test_disabled_no_lint_no_counters(self, tmp_path):
+        monitor.reset()
+        analysis._reset_trace_cache()
+        assert analysis._ENABLED is False
+        mod = _load_module(tmp_path, "lint_fixture_off")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            mod.noisy(paddle.ones([3]))
+        assert not [m for m in w if "tpu-lint" in str(m.message)]
+        snap = monitor.snapshot()["counters"]
+        assert "lint.findings" not in snap and "lint.files" not in snap
+
+    def test_disabled_gate_is_one_attribute_check(self):
+        assert analysis._ENABLED is False
+
+        def gated():
+            if analysis._ENABLED:
+                analysis.lint_traced(gated)
+
+        def baseline():
+            pass
+
+        n = 20000
+        gated(), baseline()                 # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            gated()
+        t_gate = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            baseline()
+        t_base = time.perf_counter() - t0
+        # generous: anything near this bound means the disabled path grew
+        # a lookup/allocation (same guard style as faults/monitor)
+        assert t_gate < t_base + 0.05
+
+
+# ---------------------------------------------------------------------------
+# repo self-lint: shipped code must stay trace-clean (tier-1 CI gate)
+# ---------------------------------------------------------------------------
+
+class TestSelfLint:
+    def test_shipped_packages_are_lint_clean(self):
+        """A future PR introducing a trace hazard into shipped models/nn/
+        ops fails here — run the FULL rule set (--all) like the CI recipe
+        in README; intentional host syncs carry explicit suppressions."""
+        findings, n_files = analysis.lint_paths(
+            [os.path.join(PKG, "models"), os.path.join(PKG, "nn"),
+             os.path.join(PKG, "ops")], all_functions=True)
+        assert n_files > 20
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_shipped_model_programs_are_graph_clean(self):
+        """Dead ops / f64 widenings in a shipped model's traced program
+        (both modes — the BN running-stat fix keeps train mode clean)."""
+        import jax
+        from paddle_tpu.jit.functional import functional_call, split_state
+        from paddle_tpu.models.lenet import LeNet
+
+        for train in (False, True):
+            model = LeNet()
+            model.train() if train else model.eval()
+            trainable, frozen = split_state(model)
+            pn, bn = list(trainable), list(frozen)
+
+            def pure(params, buffers, inputs):
+                return functional_call(model, pn, params, bn, buffers,
+                                       *inputs)
+
+            j = jax.make_jaxpr(pure)(
+                [trainable[n]._value for n in pn],
+                [frozen[n]._value for n in bn],
+                [paddle.rand([2, 1, 28, 28])._value])
+            fs = [f for f in agraph.analyze_jaxpr(j, "lenet")
+                  if f.rule != "unused-var"]
+            assert fs == [], "\n".join(f.format() for f in fs)
