@@ -53,6 +53,7 @@ from . import inference  # noqa: F401
 from . import serving  # noqa: F401  (dynamic-batching inference engine)
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401  (stats registry + trace spans plane)
+from . import obs  # noqa: F401  (step timeline + flight recorder plane)
 from . import analysis  # noqa: F401  (tpu-lint static-analysis plane)
 from . import faults  # noqa: F401  (deterministic fault injection plane)
 from . import guard  # noqa: F401  (training guard plane: resume/watchdog/rollback/desync)

@@ -165,3 +165,30 @@ define_flag("serving_learn_buckets", True,
 define_flag("serving_warmup", True,
             "serving engine: pre-run every declared bucket x batch size "
             "at start() so steady-state serving never compiles")
+
+# ---- observability plane (paddle_tpu.obs: step timeline + flight recorder) --
+define_flag("obs_timeline", False,
+            "record a per-step phase timeline (data_wait/h2d/trace_compile/"
+            "device_compute/collective/optimizer/snapshot ...) into a "
+            "bounded ring (paddle_tpu.obs.StepTimeline); adds a "
+            "block_until_ready fence per step so device compute is "
+            "attributed honestly; off = one module-attribute check per "
+            "instrumented site")
+define_flag("obs_flight_recorder", False,
+            "keep the black-box flight recorder armed: last-N step "
+            "records + monitor-counter deltas + recent collectives + "
+            "guard/fault events, dumped to one JSON artifact on guard "
+            "errors, serving overload, SIGTERM preemption, or dump(); "
+            "off = one module-attribute check per instrumented site")
+define_flag("obs_ring_steps", 64,
+            "obs: step records kept in the timeline/flight-recorder ring")
+define_flag("obs_ring_snapshots", 16,
+            "obs: per-step monitor-counter deltas kept in the flight "
+            "recorder ring")
+define_flag("obs_dump_dir", "flight_recorder",
+            "obs: directory flight-recorder dumps are written to when no "
+            "explicit path is given")
+define_flag("obs_dump_min_interval_s", 30.0,
+            "obs: min seconds between AUTOMATIC dumps for the same reason "
+            "(overload storms must not flood the disk); explicit "
+            "dump(path=...) calls are never rate-limited")

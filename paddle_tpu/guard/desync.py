@@ -22,6 +22,7 @@ from typing import Dict, List
 import numpy as np
 
 from .. import monitor as _monitor
+from .. import obs as _obs
 from .errors import RankDesyncError
 
 
@@ -82,8 +83,15 @@ class DesyncDetector:
         if offenders:
             if _monitor._ENABLED:
                 _monitor.count("guard.desync_errors")
-            raise RankDesyncError(step=step, offenders=offenders,
+            err = RankDesyncError(step=step, offenders=offenders,
                                   fingerprints=fps)
+            if _obs._FR_ENABLED:
+                _obs.record_event("guard.desync", step=step,
+                                  offenders=offenders,
+                                  fingerprints={str(r): v
+                                                for r, v in fps.items()})
+                _obs.dump_on_error(err)
+            raise err
         return fps
 
     @staticmethod

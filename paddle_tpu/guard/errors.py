@@ -79,3 +79,19 @@ class RankDesyncError(GuardError):
             f"cross-rank parameter desync at step {step}: rank(s) "
             f"{self.offenders} disagree with the group "
             f"(fingerprints: {self.fingerprints})")
+
+
+# ---- flight-recorder dump triggers (paddle_tpu.obs) -------------------------
+# Every guard failure must leave a black box behind: each error type
+# registers its dump reason here, and the raise sites call
+# `obs.dump_on_error(exc)` — which (when FLAGS_obs_flight_recorder is on)
+# writes the artifact and appends its path to the error message. A tier-1
+# test walks GuardError.__subclasses__ and fails on any class without a
+# trigger (directly or inherited), so a future guard error without
+# forensics cannot ship.
+from .. import obs as _obs  # noqa: E402
+
+_obs.register_dump_trigger(PreemptedError, "preempted")
+_obs.register_dump_trigger(StepStalledError, "step_stalled")
+_obs.register_dump_trigger(DivergedError, "diverged")
+_obs.register_dump_trigger(RankDesyncError, "rank_desync")

@@ -41,6 +41,7 @@ import numpy as np
 
 from .. import faults as _faults
 from .. import monitor as _monitor
+from .. import obs as _obs
 from ..core import flags as _flags
 from ..core import random as _rnd
 from .checkpoint import has_guard_state, load_guard_state, save_guard_state
@@ -118,6 +119,10 @@ class TrainGuard:
             warmup_steps=self.cfg.warmup_steps,
             factor=self.cfg.timeout_factor,
             min_timeout_s=self.cfg.min_timeout_s)
+        self._store = store
+        self._rank = int(rank)
+        self._world_size = int(world_size)
+        self._tl_round = 0
         self._detector = None
         if store is not None and world_size > 1:
             self._detector = DesyncDetector(
@@ -188,6 +193,13 @@ class TrainGuard:
         PreemptedError as typed failures."""
         if self._closed:
             raise RuntimeError("TrainGuard is closed")
+        # one step record wraps everything the guard does for this batch —
+        # the wrapped TrainStep joins it (step_record is reentrant), and
+        # snapshot/desync/checkpoint overhead lands in the same record
+        with _obs.step_record():
+            return self._step_guarded(*batch)
+
+    def _step_guarded(self, *batch) -> Optional[float]:
         if self._snapshot is None:
             self._maybe_first_snapshot()
         watchdog = self._watchdog
@@ -237,14 +249,23 @@ class TrainGuard:
         if self._detector is not None and self.cfg.desync_interval > 0 and \
                 self._good_steps % self.cfg.desync_interval == 0:
             self._desync_round += 1
-            self._detector.check(self._desync_round,
-                                 self._step_fn.named_param_arrays())
+            with _obs.phase("desync"):
+                self._detector.check(self._desync_round,
+                                     self._step_fn.named_param_arrays())
         if self._preempt_signum is not None:
             signum = self._preempt_signum
             self._preempt_signum = None
             if self.ckpt_dir:
                 self.checkpoint()
-            raise PreemptedError(signum, self.ckpt_dir, self._next_cursor)
+            err = PreemptedError(signum, self.ckpt_dir, self._next_cursor)
+            if _obs._FR_ENABLED:
+                # SIGTERM black box: the dump records the last steps and
+                # where the preempted run stood, next to the checkpoint
+                _obs.record_event("guard.preempt", signum=signum,
+                                  ckpt_dir=self.ckpt_dir,
+                                  cursor=list(self._next_cursor))
+                _obs.dump_on_error(err)
+            raise err
         return loss
 
     def _is_spike(self, loss: float) -> bool:
@@ -259,10 +280,17 @@ class TrainGuard:
         self._consec_bad += 1
         if _monitor._ENABLED:
             _monitor.count("guard.bad_steps")
+        if _obs._FR_ENABLED:
+            _obs.record_event("guard.bad_step", reason=reason,
+                              consec_bad=self._consec_bad,
+                              step=self._good_steps + 1)
         self._rollback()
         if self._consec_bad >= max(1, self.cfg.max_bad_steps):
-            raise DivergedError(bad_steps=self._consec_bad, last_loss=loss,
+            err = DivergedError(bad_steps=self._consec_bad, last_loss=loss,
                                 step=self._good_steps + 1)
+            if _obs._FR_ENABLED:
+                _obs.dump_on_error(err)
+            raise err
         return None
 
     # ---- rolling in-memory snapshot / rollback ----
@@ -276,11 +304,12 @@ class TrainGuard:
             pass
 
     def _take_snapshot(self) -> None:
-        snap = {"step": self._step_fn.state_dict(),
-                "rng": _rnd.get_rng_state()}
-        if self.scaler is not None:
-            snap["scaler"] = self.scaler.state_dict()
-        self._snapshot = snap
+        with _obs.phase("snapshot"):
+            snap = {"step": self._step_fn.state_dict(),
+                    "rng": _rnd.get_rng_state()}
+            if self.scaler is not None:
+                snap["scaler"] = self.scaler.state_dict()
+            self._snapshot = snap
         if _monitor._ENABLED:
             _monitor.count("guard.snapshots")
 
@@ -293,6 +322,8 @@ class TrainGuard:
             self.scaler.load_state_dict(self._snapshot["scaler"])
         if _monitor._ENABLED:
             _monitor.count("guard.rollbacks")
+        if _obs._FR_ENABLED:
+            _obs.record_event("guard.rollback", step=self._good_steps + 1)
 
     # ---- durable checkpoint / resume ----
     def _lr_scheduler(self):
@@ -304,6 +335,10 @@ class TrainGuard:
         """Commit the FULL loop state crash-atomically to ckpt_dir."""
         if not self.ckpt_dir:
             raise ValueError("TrainGuard has no ckpt_dir configured")
+        with _obs.phase("checkpoint"):
+            return self._checkpoint_impl()
+
+    def _checkpoint_impl(self) -> str:
         sd = self._step_fn.state_dict()
         arrays: Dict[str, np.ndarray] = {}
         for n, v in sd["params"].items():
@@ -374,4 +409,32 @@ class TrainGuard:
         self.resume_cursor = tuple(meta["cursor"])
         if _monitor._ENABLED:
             _monitor.count("guard.resumes")
+        if _obs._FR_ENABLED:
+            _obs.record_event("guard.resume", ckpt_dir=self.ckpt_dir,
+                              cursor=list(self.resume_cursor))
         return self.resume_cursor
+
+    # ---- pod timeline (obs cross-rank merge) ----
+    def timeline_report(self, timeout_s: Optional[float] = None):
+        """Merge every rank's step timeline into one pod timeline and name
+        the straggler rank per phase. Multi-rank (a rendezvous store was
+        passed): all ranks MUST call this collectively — records are
+        exchanged through the store like desync fingerprints. Single rank:
+        a local merge of this process's timeline. Returns
+        (merged_dict, report_str); timeline disabled -> (None, explanation).
+        """
+        if not _obs._TL_ENABLED:
+            return None, ("step timeline disabled — set "
+                          "FLAGS_obs_timeline=1 to record phases")
+        records = _obs.timeline().records()
+        if self._store is not None and self._world_size > 1:
+            self._tl_round += 1
+            per_rank = _obs.gather_timelines(
+                self._store, self._rank, self._world_size, records,
+                key=f"obs/tl/{self._tl_round}",
+                timeout_s=timeout_s if timeout_s is not None
+                else self.cfg.desync_timeout_s)
+        else:
+            per_rank = {self._rank: _obs.slim_records(records)}
+        merged = _obs.merge_timelines(per_rank)
+        return merged, _obs.straggler_report(merged)

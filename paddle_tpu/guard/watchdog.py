@@ -24,6 +24,7 @@ import time
 from typing import List, Optional
 
 from .. import monitor as _monitor
+from .. import obs as _obs
 from .errors import StepStalledError
 
 
@@ -55,6 +56,10 @@ class StepWatchdog:
     # ---- phase + deadline ----
     def phase(self, name: str) -> None:
         self._phase = name
+        if _obs._ENABLED:
+            # timeline marker: a wedge between phase spans still gets its
+            # last-known position into the flight-recorder dump
+            _obs.mark(name)
 
     def record(self, duration_s: float) -> None:
         self._durations.append(float(duration_s))
@@ -131,8 +136,15 @@ class StepWatchdog:
         self._runner = None
         if _monitor._ENABLED:
             _monitor.count("guard.stalls")
-        raise StepStalledError(phase=self._phase, deadline_s=dl,
+        err = StepStalledError(phase=self._phase, deadline_s=dl,
                                step=self._step)
+        if _obs._FR_ENABLED:
+            # black box FIRST, while the wedged step is still in flight —
+            # the dump's inflight_phase/open_step name where it hung
+            _obs.record_event("guard.stall", phase=self._phase,
+                              step=self._step, deadline_s=dl)
+            _obs.dump_on_error(err)
+        raise err
 
     # ---- lifecycle ----
     def alive_threads(self) -> List[threading.Thread]:

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import monitor as _monitor
+from .. import obs as _obs
 from ..core.tensor import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
@@ -106,13 +107,17 @@ class _PrefetchIter:
             # all workers done → every produced batch is already queued/pending
             if self._done_workers >= self._n_workers and self.queue.empty():
                 raise StopIteration
-            if _monitor._ENABLED:
+            if _monitor._ENABLED or _obs._TL_ENABLED:
                 # how long the consumer stalls on the workers: the signal
                 # that the input pipeline (not the device) is the bottleneck
                 _tw = _time.time()
                 seq, batch = self.queue.get()
-                _monitor.observe("io.dataloader.queue_wait",
-                                 _time.time() - _tw)
+                _t1 = _time.time()
+                if _monitor._ENABLED:
+                    _monitor.observe("io.dataloader.queue_wait", _t1 - _tw)
+                # timeline: this wait sits BETWEEN steps, so it folds into
+                # the next step record's `between` bucket (obs/timeline.py)
+                _obs.add_phase("data_wait", _t1 - _tw, _tw, _t1)
             else:
                 seq, batch = self.queue.get()
             if seq is None:

@@ -243,6 +243,19 @@ class MultiprocessIter:
                 self._respawn_dead_worker(wid)
 
     def __next__(self):
+        from .. import obs as _obs
+        if not _obs._TL_ENABLED:
+            return self._next_impl()
+        # timeline: consumer-side wait on the worker processes — lands in
+        # the NEXT step record's `between` bucket as data_wait
+        _t0 = time.time()
+        try:
+            return self._next_impl()
+        finally:
+            _t1 = time.time()
+            _obs.add_phase("data_wait", _t1 - _t0, _t0, _t1)
+
+    def _next_impl(self):
         deadline = (time.monotonic() + self._timeout) \
             if self._timeout else None
         while True:

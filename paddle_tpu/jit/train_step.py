@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from .. import analysis as _analysis
 from .. import monitor as _monitor
+from .. import obs as _obs
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from .functional import functional_call, split_state
@@ -156,12 +157,18 @@ class TrainStep:
 
     def _prepare(self, batch):
         """Shared prep for __call__/run: param/buffer arrays, model-input vs
-        label split, lr-array cache refresh."""
+        label split, lr-array cache refresh. Returns the batch split plus a
+        `novel` flag — True when this batch signature has not been
+        dispatched before, i.e. the jitted call ahead pays trace+compile
+        (the timeline attributes it to `trace_compile`, not compute)."""
         if self._jitted is None:
-            self._build()
+            with _obs.phase("build"):
+                self._build()
         params = [t._value for t in self._ptensors]
         buffers = [t._value for t in self._btensors]
-        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        with _obs.phase("h2d"):
+            arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                    for b in batch]
         n_mi = self._n_model_inputs
         if n_mi is None:
             n_mi = len(arrs) if len(arrs) <= 1 else len(arrs) - 1
@@ -169,40 +176,65 @@ class TrainStep:
         if lr_val != self._lr_val:
             self._lr_val = lr_val
             self._lr_arr = jnp.asarray(lr_val, jnp.float32)
-        if _monitor._ENABLED:
+        novel = False
+        if _monitor._ENABLED or _obs._TL_ENABLED:
             # retrace accounting: the jitted step recompiles for every novel
             # batch signature — the dominant TPU perf hazard. The signature
-            # that caused each retrace is logged for diagnosis.
+            # that caused each retrace is logged for diagnosis (and the
+            # timeline books the compile under trace_compile).
             sig = _monitor.arg_signature(arrs)
             if sig not in self._seen_sigs:
-                _monitor.record_retrace("train_step", sig,
-                                        first=not self._seen_sigs)
+                novel = True
+                if _monitor._ENABLED:
+                    _monitor.record_retrace("train_step", sig,
+                                            first=not self._seen_sigs)
                 self._seen_sigs.add(sig)
-        return params, buffers, arrs[:n_mi], arrs[n_mi:]
+        return params, buffers, arrs[:n_mi], arrs[n_mi:], novel
 
     def __call__(self, *batch):
         """batch: input tensors consumed by model.forward; loss_fn receives the
         model output(s) — close labels into loss_fn or pass them as model inputs.
         """
-        params, buffers, inputs, labels = self._prepare(batch)
-        _mon = _monitor._ENABLED
-        if _mon:
-            _t0 = _time.time()
-        new_params, self._slots, loss, self._key, self._t_arr, bad = \
-            self._jitted(params, self._slots, buffers, self._key,
-                         self._lr_arr, self._t_arr, inputs, labels)
-        # commit ALL state before any debug raise: the old param buffers
-        # were DONATED to the jit call, so bailing out early would leave
-        # every tensor pointing at a deleted buffer (and slots/step_count
-        # desynced)
-        for tns, v in zip(self._ptensors, new_params):
-            tns._value = v
-        self.optimizer._step_count += 1
-        if _mon:
-            _monitor.count("jit.train_step.steps")
-            _monitor.observe("jit.train_step.dur", _time.time() - _t0)
-        raise_nonfinite(bad, self._pnames, "jitted train step")
-        return Tensor(loss)
+        with _obs.step_record():
+            params, buffers, inputs, labels, novel = self._prepare(batch)
+            _mon = _monitor._ENABLED
+            if _mon:
+                _t0 = _time.time()
+            _tl = _obs._TL_ENABLED
+            with _obs.phase("trace_compile" if novel else "device_compute"):
+                new_params, self._slots, loss, self._key, self._t_arr, bad = \
+                    self._jitted(params, self._slots, buffers, self._key,
+                                 self._lr_arr, self._t_arr, inputs, labels)
+                if _tl:
+                    # fence: on an async backend the dispatch above returns
+                    # before the chip finishes; without this the device time
+                    # would leak into whatever phase syncs next
+                    jax.block_until_ready(loss)
+            # commit ALL state before any debug raise: the old param buffers
+            # were DONATED to the jit call, so bailing out early would leave
+            # every tensor pointing at a deleted buffer (and slots/step_count
+            # desynced)
+            for tns, v in zip(self._ptensors, new_params):
+                tns._value = v
+            self.optimizer._step_count += 1
+            if _mon:
+                _monitor.count("jit.train_step.steps")
+                _monitor.observe("jit.train_step.dur", _time.time() - _t0)
+            raise_nonfinite(bad, self._pnames, "jitted train step")
+            return Tensor(loss)
+
+    def cost_analysis(self, *batch):
+        """XLA's own cost estimate for THIS step executable at `batch`'s
+        signature: {"flops", "bytes_accessed", ...} via AOT
+        lower().compile().cost_analysis() (obs/cost.py). The compile hits
+        the same cache as __call__ for an already-dispatched signature.
+        bench.py uses it to report *attributed* MFU — the compiler-counted
+        FLOPs over measured step time — next to the formula-derived one."""
+        params, buffers, inputs, labels, _ = self._prepare(batch)
+        lowered = self._jitted.lower(params, self._slots, buffers, self._key,
+                                     self._lr_arr, self._t_arr, inputs,
+                                     labels)
+        return _obs.executable_cost(lowered.compile())
 
     # ---- full loop-state capture (guard plane: preemption-safe resume) ----
     def named_param_arrays(self):
@@ -253,7 +285,7 @@ class TrainStep:
         history as a Tensor. One host dispatch + one sync per span instead
         of per step — the eager/tunnel dispatch tax disappears.
         """
-        params, buffers, inputs, labels = self._prepare(batch)
+        params, buffers, inputs, labels, _novel = self._prepare(batch)
         n_steps = int(inputs[0].shape[0]) if inputs else int(labels[0].shape[0])
         new_params, self._slots, losses, self._key, self._t_arr, bads = \
             self._jitted_scan(params, self._slots, buffers, self._key,

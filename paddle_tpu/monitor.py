@@ -410,22 +410,31 @@ def reset() -> None:
 
 def report(time_unit: str = "ms") -> str:
     """Rendered stats table (Profiler.summary() sibling for the stats plane)."""
+    return render_snapshot(
+        _REGISTRY.snapshot(), time_unit=time_unit,
+        title_right=f"(FLAGS_monitor={'1' if _ENABLED else '0'})")
+
+
+def render_snapshot(snap: Dict[str, Any], time_unit: str = "ms",
+                    title_right: str = "") -> str:
+    """Render ANY snapshot()-shaped dict (live registry, or a JSON artifact
+    loaded back by the `python -m paddle_tpu.monitor show` CLI)."""
     scale = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
-    snap = _REGISTRY.snapshot()
     width = 78
-    lines = ["-" * width, f"{'paddle_tpu.monitor':<58}{'(FLAGS_monitor=' + ('1' if _ENABLED else '0') + ')':>20}",
+    lines = ["-" * width,
+             f"{'paddle_tpu.monitor':<58}{title_right:>20}",
              "-" * width]
-    if snap["counters"]:
+    if snap.get("counters"):
         lines.append(f"{'Counter':<52}{'Value':>24}")
         for name in sorted(snap["counters"]):
             lines.append(f"{name[:51]:<52}{snap['counters'][name]:>24}")
         lines.append("-" * width)
-    if snap["gauges"]:
+    if snap.get("gauges"):
         lines.append(f"{'Gauge':<52}{'Value':>24}")
         for name in sorted(snap["gauges"]):
             lines.append(f"{name[:51]:<52}{snap['gauges'][name]:>24}")
         lines.append("-" * width)
-    if snap["histograms"]:
+    if snap.get("histograms"):
         lines.append(f"{'Histogram':<38}{'Count':>8}"
                      f"{'Avg(' + time_unit + ')':>11}"
                      f"{'Min':>10}{'Max':>11}")
@@ -435,7 +444,7 @@ def report(time_unit: str = "ms") -> str:
                 f"{name[:37]:<38}{st['count']:>8}{st['avg'] * scale:>11.3f}"
                 f"{st['min'] * scale:>10.3f}{st['max'] * scale:>11.3f}")
         lines.append("-" * width)
-    if snap["events"]:
+    if snap.get("events"):
         lines.append(f"events: {len(snap['events'])} "
                      f"(last: {snap['events'][-1].get('event')})")
         lines.append("-" * width)
@@ -492,3 +501,150 @@ def export_prometheus(path: str) -> str:
     with open(path, "w") as f:
         f.write(prometheus_text())
     return path
+
+
+# ---- CLI: the CI-artifact inspection tool ----------------------------------
+# `python -m paddle_tpu.monitor show|diff|trace ...` — pretty-print a
+# snapshot JSON (or flight-recorder dump), diff two snapshots (what did
+# this run do that the good run didn't?), and convert a flight-recorder
+# dump into a chrome://tracing file.
+
+def _load_artifact(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _is_flight_dump(doc: Dict[str, Any]) -> bool:
+    return str(doc.get("schema", "")).startswith("paddle_tpu.flight_recorder")
+
+
+def _render_flight_dump(doc: Dict[str, Any]) -> str:
+    lines = ["-" * 78,
+             f"flight recorder dump — reason: {doc.get('reason')!r}  "
+             f"rank {doc.get('rank')}  pid {doc.get('pid')}",
+             "-" * 78,
+             f"in-flight phase: {doc.get('inflight_phase')!r}"]
+    steps = doc.get("steps", [])
+    open_step = doc.get("open_step")
+    lines.append(f"step records: {len(steps)}"
+                 + (" (+1 open/in-flight)" if open_step else ""))
+    for rec in ([open_step] if open_step else []) + steps[-3:][::-1]:
+        phases = ", ".join(f"{k}={v * 1e3:.2f}ms"
+                           for k, v in sorted(rec.get("phases", {}).items(),
+                                              key=lambda kv: -kv[1]))
+        tag = "OPEN " if rec is open_step else ""
+        wall = rec.get("wall")
+        lines.append(f"  {tag}step {rec.get('step')}: "
+                     f"wall={wall * 1e3:.2f}ms " if wall is not None
+                     else f"  {tag}step {rec.get('step')} (unfinished) ")
+        if phases:
+            lines[-1] += f"[{phases}]"
+        if rec.get("error"):
+            lines.append(f"    error: {rec['error']}")
+    evs = doc.get("events", [])
+    if evs:
+        lines.append(f"events ({len(evs)}, newest last):")
+        for ev in evs[-8:]:
+            extra = {k: v for k, v in ev.items() if k not in ("ts", "event")}
+            lines.append(f"  {ev.get('event')} {extra}")
+    colls = doc.get("collectives", [])
+    if colls:
+        lines.append(f"recent collectives ({len(colls)}): "
+                     + ", ".join(f"{c[1]}({c[2]}B)" for c in colls[-8:]))
+    counters = doc.get("monitor", {}).get("counters", {})
+    if counters:
+        lines.append(f"monitor counters: {len(counters)} "
+                     f"(use `show` on a snapshot export for the full table)")
+    lines.append("-" * 78)
+    return "\n".join(lines)
+
+
+def _diff_snapshots(a: Dict[str, Any], b: Dict[str, Any]) -> str:
+    """b - a for counters/gauges and histogram count/sum: what happened
+    between the two exports."""
+    lines = ["-" * 78, f"{'monitor diff (b - a)':<52}{'a':>8}{'b':>9}{'Δ':>9}",
+             "-" * 78]
+    for kind in ("counters", "gauges"):
+        ka, kb = a.get(kind, {}), b.get(kind, {})
+        names = sorted(set(ka) | set(kb))
+        rows = []
+        for n in names:
+            va, vb = ka.get(n, 0), kb.get(n, 0)
+            if va != vb:
+                rows.append((n, va, vb))
+        if rows:
+            lines.append(kind + ":")
+            for n, va, vb in rows:
+                try:
+                    delta = f"{vb - va:+}"
+                except TypeError:
+                    delta = "?"
+                lines.append(f"  {n[:49]:<50}{va:>8}{vb:>9}{delta:>9}")
+    ha, hb = a.get("histograms", {}), b.get("histograms", {})
+    rows = []
+    for n in sorted(set(ha) | set(hb)):
+        ca = ha.get(n, {}).get("count", 0)
+        cb = hb.get(n, {}).get("count", 0)
+        if ca != cb:
+            rows.append(f"  {n[:49]:<50}{ca:>8}{cb:>9}{cb - ca:>+9}")
+    if rows:
+        lines.append("histogram counts:")
+        lines.extend(rows)
+    if len(lines) == 3:
+        lines.append("(no differences)")
+    lines.append("-" * 78)
+    return "\n".join(lines)
+
+
+def _main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.monitor",
+        description="inspect monitor/flight-recorder CI artifacts")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    p_show = sub.add_parser(
+        "show", help="pretty-print a monitor snapshot JSON or a "
+                     "flight-recorder dump")
+    p_show.add_argument("path")
+    p_diff = sub.add_parser(
+        "diff", help="diff two monitor snapshot JSONs (b - a)")
+    p_diff.add_argument("a")
+    p_diff.add_argument("b")
+    p_trace = sub.add_parser(
+        "trace", help="convert a flight-recorder dump to a chrome trace")
+    p_trace.add_argument("dump")
+    p_trace.add_argument("-o", "--out", default=None,
+                         help="output path (default: <dump>.trace.json)")
+    args = p.parse_args(argv)
+    if args.cmd == "show":
+        doc = _load_artifact(args.path)
+        if _is_flight_dump(doc):
+            print(_render_flight_dump(doc))
+        else:
+            print(render_snapshot(doc, title_right=f"({args.path})"))
+        return 0
+    if args.cmd == "diff":
+        print(_diff_snapshots(_load_artifact(args.a),
+                              _load_artifact(args.b)))
+        return 0
+    if args.cmd == "trace":
+        doc = _load_artifact(args.dump)
+        if not _is_flight_dump(doc):
+            print(f"error: {args.dump} is not a flight-recorder dump "
+                  f"(schema: {doc.get('schema')!r})")
+            return 2
+        from .obs import dump_to_chrome_events
+        out = args.out or (args.dump + ".trace.json")
+        events = dump_to_chrome_events(doc)
+        os.makedirs(os.path.dirname(os.path.abspath(out)) or ".",
+                    exist_ok=True)
+        with open(out, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        print(out)
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    import sys as _sys
+    _sys.exit(_main())

@@ -1,0 +1,63 @@
+"""XLA cost-analysis hook — attributed FLOPs/bytes per compiled executable.
+
+`bench.py`'s MFU was one opaque number derived from a hand-written FLOP
+formula; XLA already knows the real count. `compiled.cost_analysis()`
+exposes the compiler's own per-executable estimate (flops, bytes accessed),
+so MFU can be *attributed* — the executable's true FLOPs over the measured
+step time — and the roofline gap split per phase by the step timeline.
+`TrainStep.cost_analysis()` / `SPMDTrainStep.cost_analysis()` wrap this for
+the training step executable.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["executable_cost", "attributed_mfu", "roofline_gap"]
+
+
+def executable_cost(compiled) -> Dict[str, float]:
+    """Normalized {flops, bytes_accessed, ...} from an AOT-compiled
+    executable's cost_analysis(). jax returns a dict or a one-element list
+    of dicts depending on version; keys are XLA's ('flops',
+    'bytes accessed', 'utilization0{}', ...). Absent/failed analysis
+    (some backends) -> {}."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for key, norm in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed"),
+                      ("transcendentals", "transcendentals")):
+        if key in ca and isinstance(ca[key], (int, float)):
+            out[norm] = float(ca[key])
+    return out
+
+
+def attributed_mfu(flops_per_step: float, step_time_s: float,
+                   peak_flops: float) -> float:
+    """MFU from the compiler-attributed FLOP count: what fraction of the
+    chip's peak the executable actually sustained."""
+    if step_time_s <= 0 or peak_flops <= 0:
+        return 0.0
+    return flops_per_step / (step_time_s * peak_flops)
+
+
+def roofline_gap(cost: Dict[str, float], step_time_s: float,
+                 peak_flops: float,
+                 hbm_bytes_per_s: Optional[float] = None) -> Dict[str, Any]:
+    """Which wall is the step leaning on: compute (MFU) vs memory
+    (HBM-roofline fraction), both from the SAME attributed cost dict."""
+    out: Dict[str, Any] = {}
+    if "flops" in cost:
+        out["mfu"] = attributed_mfu(cost["flops"], step_time_s, peak_flops)
+    if hbm_bytes_per_s and "bytes_accessed" in cost and step_time_s > 0:
+        out["hbm_frac"] = cost["bytes_accessed"] / (step_time_s *
+                                                    hbm_bytes_per_s)
+    if "mfu" in out and "hbm_frac" in out:
+        out["bound"] = "memory" if out["hbm_frac"] > out["mfu"] else "compute"
+    return out

@@ -84,15 +84,18 @@ class Optimizer:
     # ---- step ----
     def step(self):
         from .. import monitor as _monitor
-        if not _monitor._ENABLED:
+        from .. import obs as _obs
+        if not (_monitor._ENABLED or _obs._TL_ENABLED):
             return self._step_impl()
         import time as _time
         _t0 = _time.time()
         try:
-            return self._step_impl()
+            with _obs.phase("optimizer"):
+                return self._step_impl()
         finally:
-            _monitor.count("optimizer.steps")
-            _monitor.observe("optimizer.step_dur", _time.time() - _t0)
+            if _monitor._ENABLED:
+                _monitor.count("optimizer.steps")
+                _monitor.observe("optimizer.step_dur", _time.time() - _t0)
 
     def _step_impl(self):
         from ..core.selected_rows import SelectedRows

@@ -26,23 +26,29 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import monitor as _monitor
+from .. import obs as _obs
 from ..core.jaxcompat import axis_size as _axis_size
 from ..core.tensor import Tensor
 from ..ops._dispatch import ensure_tensor, run_op
 
 
 def _record(name: str, t) -> None:
-    """Monitor plane: count the collective and its logical payload bytes.
-    Works on tracers too (shape/dtype are static), so SPMD-region
-    collectives are accounted once per trace."""
-    if not _monitor._ENABLED:
+    """Monitor + flight-recorder planes: count the collective and its
+    logical payload bytes. Works on tracers too (shape/dtype are static),
+    so SPMD-region collectives are accounted once per trace. The flight
+    recorder keeps the recent (name, bytes) sequence — after a wedged
+    collective, the dump shows what the rank issued leading up to it."""
+    if not (_monitor._ENABLED or _obs._FR_ENABLED):
         return
     v = getattr(t, "_value", t)
     try:
         nbytes = int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
     except Exception:
         nbytes = 0
-    _monitor.record_collective(name, nbytes)
+    if _monitor._ENABLED:
+        _monitor.record_collective(name, nbytes)
+    if _obs._FR_ENABLED:
+        _obs.record_collective(name, nbytes)
 
 
 class ReduceOp:
@@ -112,15 +118,16 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group) or "dp"
     if _in_spmd(ax):
         red = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax, ReduceOp.MIN: lax.pmin}
-        if op == ReduceOp.AVG:
-            out = run_op(lambda a: lax.pmean(a, ax), [t], "c_allreduce_avg")
-        else:
-            fn = red.get(op)
-            if fn is None:  # PROD via exp-sum-log not safe; use reduce then broadcast
-                out = run_op(lambda a: jnp.exp(lax.psum(jnp.log(a), ax)), [t],
-                             "c_allreduce_prod")
+        with _obs.phase("collective"):
+            if op == ReduceOp.AVG:
+                out = run_op(lambda a: lax.pmean(a, ax), [t], "c_allreduce_avg")
             else:
-                out = run_op(lambda a: fn(a, ax), [t], "c_allreduce")
+                fn = red.get(op)
+                if fn is None:  # PROD via exp-sum-log not safe; use reduce then broadcast
+                    out = run_op(lambda a: jnp.exp(lax.psum(jnp.log(a), ax)), [t],
+                                 "c_allreduce_prod")
+                else:
+                    out = run_op(lambda a: fn(a, ax), [t], "c_allreduce")
         from ..ops._dispatch import inplace_from
         return inplace_from(t, out)
     # eager single-controller: the global array already holds the logical value
@@ -132,7 +139,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     _record("c_allgather", t)
     ax = _axis(group) or "dp"
     if _in_spmd(ax):
-        out = run_op(lambda a: lax.all_gather(a, ax, tiled=False), [t], "c_allgather")
+        with _obs.phase("collective"):
+            out = run_op(lambda a: lax.all_gather(a, ax, tiled=False), [t],
+                         "c_allgather")
         n = _axis_size(ax)
         parts = [Tensor(out._value[i]) for i in range(n)]
         if tensor_list is not None:
@@ -160,24 +169,27 @@ def store_all_gather_object(store, key: str, obj, rank: int, world_size: int,
     blame a rank for being slow)."""
     import json as _json
     import time as _time
-    store.set(f"{key}:{rank}", _json.dumps(obj))
-    if _monitor._ENABLED:
-        _monitor.count("c_store_allgather_obj")
-    out = {}
-    deadline = _time.monotonic() + timeout_s
-    for r in range(world_size):
-        while True:
-            try:
-                raw = store.get(f"{key}:{r}")
-                break
-            except Exception:
-                if _time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"store_all_gather_object: rank {r} never published "
-                        f"{key!r} within {timeout_s}s")
-                _time.sleep(poll_s)
-        out[r] = _json.loads(raw.decode() if isinstance(raw, (bytes, bytearray))
-                             else raw)
+    with _obs.phase("collective"):
+        store.set(f"{key}:{rank}", _json.dumps(obj))
+        if _monitor._ENABLED:
+            _monitor.count("c_store_allgather_obj")
+        if _obs._FR_ENABLED:
+            _obs.record_collective("store_allgather_obj", 0)
+        out = {}
+        deadline = _time.monotonic() + timeout_s
+        for r in range(world_size):
+            while True:
+                try:
+                    raw = store.get(f"{key}:{r}")
+                    break
+                except Exception:
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"store_all_gather_object: rank {r} never published "
+                            f"{key!r} within {timeout_s}s")
+                    _time.sleep(poll_s)
+            out[r] = _json.loads(raw.decode() if isinstance(raw, (bytes, bytearray))
+                                 else raw)
     return out
 
 
@@ -191,7 +203,9 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
     t = ensure_tensor(src)
     _record("c_reducescatter", t)
     if _in_spmd(ax):
-        out = run_op(lambda a: lax.psum_scatter(a, ax, tiled=True), [t], "c_reducescatter")
+        with _obs.phase("collective"):
+            out = run_op(lambda a: lax.psum_scatter(a, ax, tiled=True), [t],
+                         "c_reducescatter")
         if tensor is not None:
             tensor._value = out._value
         return out

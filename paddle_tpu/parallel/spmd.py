@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs as _obs
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from ..jit.functional import functional_call, split_state
@@ -265,7 +266,44 @@ class SPMDTrainStep:
         self.optimizer._step_count = int(sd["step_count"])
 
     def __call__(self, *batch):
-        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        with _obs.step_record():
+            with _obs.phase("h2d"):
+                arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                        for b in batch]
+            first = self._jitted is None
+            if first:
+                with _obs.phase("build"):
+                    self._build(arrs)
+            trainable, frozen = split_state(self.model)
+            params = [trainable[n]._value for n in self._pnames]
+            buffers = [frozen[n]._value for n in self._bnames]
+            key = rnd.default_generator().next_key()
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            t = jnp.asarray(self.optimizer._step_count + 1, jnp.float32)
+            # GSPMD folds the collectives INTO the executable, so the
+            # timeline cannot fence them apart from compute here — the
+            # device_compute phase is the whole sharded step; explicit
+            # eager collectives (parallel/collective.py) get their own
+            # `collective` phase.
+            with _obs.phase("trace_compile" if first else "device_compute"):
+                new_params, self._slots, loss, bad = self._jitted(
+                    params, self._slots, buffers, key, lr, t, arrs)
+                if _obs._TL_ENABLED:
+                    jax.block_until_ready(loss)
+            # commit before the debug raise — old buffers were donated
+            for n, v in zip(self._pnames, new_params):
+                trainable[n]._value = v
+            self.optimizer._step_count += 1
+            from ..jit.train_step import raise_nonfinite
+            raise_nonfinite(bad, self._pnames, "jitted SPMD train step")
+            return Tensor(loss)
+
+    def cost_analysis(self, *batch):
+        """Compiler-attributed {flops, bytes_accessed} for the sharded step
+        executable (see jit.TrainStep.cost_analysis). Per-device numbers:
+        XLA reports the cost of one shard of the SPMD program."""
+        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in batch]
         if self._jitted is None:
             self._build(arrs)
         trainable, frozen = split_state(self.model)
@@ -274,12 +312,6 @@ class SPMDTrainStep:
         key = rnd.default_generator().next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.optimizer._step_count + 1, jnp.float32)
-        new_params, self._slots, loss, bad = self._jitted(
-            params, self._slots, buffers, key, lr, t, arrs)
-        # commit before the debug raise — old buffers were donated
-        for n, v in zip(self._pnames, new_params):
-            trainable[n]._value = v
-        self.optimizer._step_count += 1
-        from ..jit.train_step import raise_nonfinite
-        raise_nonfinite(bad, self._pnames, "jitted SPMD train step")
-        return Tensor(loss)
+        lowered = self._jitted.lower(params, self._slots, buffers, key, lr,
+                                     t, arrs)
+        return _obs.executable_cost(lowered.compile())

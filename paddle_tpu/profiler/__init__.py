@@ -210,6 +210,11 @@ class Profiler:
             events.append({"name": e.name, "ph": "X", "cat": e.kind,
                            "ts": e.start * 1e6, "dur": e.dur * 1e6,
                            "pid": os.getpid(), "tid": e.tid})
+        # merge the obs plane: step-timeline phase spans ride along on their
+        # own tids so one trace shows ops AND per-step phase attribution
+        from .. import obs as _obs
+        if _obs._TL_ENABLED:
+            events.extend(_obs.timeline().chrome_events())
         # merge the stats plane: monitor counters ride along as metadata so
         # ONE artifact carries both spans and counters
         from .. import monitor as _monitor

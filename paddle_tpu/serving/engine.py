@@ -35,6 +35,7 @@ import numpy as np
 
 from .. import faults as _faults
 from .. import monitor as _monitor
+from .. import obs as _obs
 from ..core import flags as _flags
 from .bucket import BucketSet, ShapeBucket, default_batch_sizes, signature_of
 
@@ -70,6 +71,13 @@ class EngineStoppedError(ServingError):
 class NoBucketError(ServingError):
     """No declared bucket accepts this shape and learning is disabled."""
     wire_status = 1
+
+
+# an overloaded engine dumps the flight recorder (rate-limited — one dump
+# per FLAGS_obs_dump_min_interval_s, not one per rejected request): the
+# black box shows queue depth, batch sizes, and latency counters leading
+# into the overload
+_obs.register_dump_trigger(ServerOverloadedError, "serving_overload")
 
 
 class ResponseFuture:
@@ -299,9 +307,15 @@ class ServingEngine:
                 self._counts["rejected"] += 1
                 if _monitor._ENABLED:
                     _monitor.count("serving.rejected")
-                raise ServerOverloadedError(
+                err = ServerOverloadedError(
                     f"queue at capacity ({self.config.queue_depth} "
                     "pending); back off and retry")
+                if _obs._FR_ENABLED:
+                    _obs.record_event("serving.overload",
+                                      queue_depth=self.config.queue_depth,
+                                      pending=self._pending)
+                    _obs.dump_on_error(err)
+                raise err
             self._lanes.setdefault(bucket.key(), []).append(req)
             self._pending += 1
             self._counts["requests"] += 1

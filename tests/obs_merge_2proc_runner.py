@@ -1,0 +1,68 @@
+"""Two-process cross-rank timeline merge runner (executed by test_obs.py).
+
+Two real OS processes rendezvous on the C++ TCPStore, each records a small
+step timeline, and rank 1 sleeps an extra ~80ms inside its `collective`
+phase every step — the classic straggler. Both ranks gather the timelines
+through the store (`obs.gather_timelines`), merge, and must produce the
+SAME verdict: rank 1 is the straggler for the `collective` phase (and the
+slowest rank overall). No jax/XLA involvement — the timeline is pure host
+bookkeeping, which keeps the runner fast and backend-free.
+"""
+import json
+import os
+import sys
+import time
+
+rank = int(sys.argv[1])
+store_port = int(sys.argv[2])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Load the native TCPStore first (same technique as
+# guard_desync_2proc_runner.py) so rendezvous comes up before the heavier
+# paddle_tpu import below.
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "ptpu_native", os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "_native", "__init__.py"))
+_native = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_native)
+
+from paddle_tpu.core import flags as _flags  # noqa: E402
+from paddle_tpu import obs  # noqa: E402
+
+store = _native.TCPStore("127.0.0.1", store_port, is_master=(rank == 0),
+                         world_size=2)
+
+_flags.set_flags({"obs_timeline": True})
+tl = obs.timeline()
+
+for _ in range(4):
+    with tl.step_record():
+        with tl.phase("h2d"):
+            time.sleep(0.005)
+        with tl.phase("device_compute"):
+            time.sleep(0.02)
+        with tl.phase("collective"):
+            time.sleep(0.01 + (0.08 if rank == 1 else 0.0))
+
+per_rank = obs.gather_timelines(store, rank, 2, tl.records(),
+                                key="obs/tl/test", timeout_s=60.0)
+merged = obs.merge_timelines(per_rank)
+report = obs.straggler_report(merged)
+
+result = {
+    "rank": rank,
+    "world_size": merged["world_size"],
+    "collective_straggler": merged["stragglers"]["collective"]["rank"],
+    "collective_skew": merged["stragglers"]["collective"]["skew"],
+    "slowest_rank": merged["slowest_rank"],
+    "report_names_rank1": "rank 1" in report,
+    "steps_rank0": merged["ranks"][0]["steps"],
+    "steps_rank1": merged["ranks"][1]["steps"],
+}
+print(json.dumps(result))
