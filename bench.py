@@ -83,6 +83,78 @@ def _timeline_breakdown(step, batch_tensors, n_steps):
     return phases_ms, round(wall_ms, 3), round(coverage, 3), cost
 
 
+def _overlap_ab(step, batch_np, n_steps, depth=2):
+    """Prefetch on/off A/B on the per-step path: same host batches, same
+    step executable — measure samples/s and the per-phase time both ways.
+    The win to look for: the data_wait+h2d share of total wall collapses
+    when the feeder thread hides them under the previous step (they
+    reappear as hidden `prefetch_h2d` in the between bucket). Knob:
+    BENCH_PREFETCH=ab|on|off (default ab runs both arms)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import obs
+    from paddle_tpu.io.prefetch import DevicePrefetcher
+
+    arm = os.environ.get("BENCH_PREFETCH", "ab").lower()
+    arms = {"ab": ("prefetch_off", "prefetch_on"),
+            "on": ("prefetch_on",), "off": ("prefetch_off",)}.get(arm)
+    if arms is None:
+        arms = ("prefetch_off", "prefetch_on")
+    batch_size = batch_np[0].shape[0]
+    out = {}
+    for mode in arms:
+        src = [tuple(a.copy() for a in batch_np) for _ in range(n_steps)]
+        feed = src if mode == "prefetch_off" \
+            else DevicePrefetcher(src, depth=depth)
+        paddle.set_flags({"FLAGS_obs_timeline": True})
+        obs.reset()
+        try:
+            t0 = time.perf_counter()
+            loss = None
+            for b in feed:
+                loss = step(*b)
+            _sync(loss._value)
+            dt = time.perf_counter() - t0
+            recs = [r for r in obs.timeline().records()
+                    if "trace_compile" not in r.get("phases", {})
+                    and "build" not in r.get("phases", {})]
+        finally:
+            paddle.set_flags({"FLAGS_obs_timeline": False})
+            if feed is not src:
+                feed.close()
+        agg, between = {}, {}
+        for r in recs:
+            for k, v in r.get("phases", {}).items():
+                agg[k] = agg.get(k, 0.0) + v
+            for k, v in r.get("between", {}).items():
+                between[k] = between.get(k, 0.0) + v
+        n = max(len(recs), 1)
+        wall = sum(r["wall"] for r in recs)
+        total = wall + sum(between.values()) or 1e-9
+        # visible input-feed cost: in-step h2d + consumer stalls between
+        # steps; the hidden feeder-thread prefetch_h2d is NOT charged here
+        # (it overlapped compute) but stays reported for the books
+        feed_share = (agg.get("h2d", 0.0) + agg.get("data_wait", 0.0)
+                      + between.get("data_wait", 0.0)
+                      + between.get("h2d", 0.0)) / total
+        out[mode] = {
+            "samples_per_sec": round(batch_size * n_steps / dt, 2),
+            "phases_ms": {k: round(v / n * 1e3, 3)
+                          for k, v in sorted(agg.items())},
+            "between_ms": {k: round(v / n * 1e3, 3)
+                           for k, v in sorted(between.items())},
+            "data_wait_h2d_share": round(feed_share, 4),
+        }
+    if len(arms) == 2:
+        out["share_delta"] = round(
+            out["prefetch_off"]["data_wait_h2d_share"]
+            - out["prefetch_on"]["data_wait_h2d_share"], 4)
+        off_sps = out["prefetch_off"]["samples_per_sec"]
+        if off_sps:
+            out["speedup"] = round(
+                out["prefetch_on"]["samples_per_sec"] / off_sps, 3)
+    return out
+
+
 def bench_ernie_train(backend):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -131,6 +203,15 @@ def bench_ernie_train(backend):
     tl_ms, tl_wall_ms, tl_cov, cost = _timeline_breakdown(
         step, (ids0, ids0, nsp0), 5 if backend == "tpu" else 2)
 
+    # prefetch on/off A/B: per-optimisation attribution of the win — the
+    # data_wait/h2d phase share before vs after async device prefetch, on
+    # the same step executable (BENCH_r06 records this next to the
+    # headline samples/s)
+    ids_np = np.asarray(ids0._value)
+    nsp_np = np.asarray(nsp0._value)
+    overlap = _overlap_ab(step, (ids_np, ids_np, nsp_np),
+                          20 if backend == "tpu" else 3)
+
     # train matmul FLOPs/sample ~= 6*N_matmul*S + 3*L*4*S^2*H (PaLM-style)
     # + the weight-tied MLM head (6*S*H*V: its [V,H] weight is the embedding
     # table, excluded from n_matmul, but its 3 matmuls are ~25% of the work)
@@ -152,6 +233,7 @@ def bench_ernie_train(backend):
             "bytes_per_step_attributed": cost.get("bytes_accessed"),
             "timeline_ms": tl_ms, "timeline_wall_ms": tl_wall_ms,
             "timeline_phase_coverage": tl_cov,
+            "overlap": overlap,
             "batch": batch, "seqlen": seqlen,
             "attention": "XLA fused (measured r5: forcing the Pallas flash "
                          "kernel into this s128 training path loses 14% — "
@@ -622,6 +704,8 @@ def main():
 
     extra = {}
     ernie = _run_workload("ernie_train", bench_ernie_train, backend, extra)
+    if isinstance(ernie, dict) and "overlap" in ernie:
+        extra["overlap"] = ernie.pop("overlap")
     flash = _run_workload("flash_attention", bench_flash_attention, backend,
                           extra)
     for key, fn in (("resnet50_infer", bench_resnet50_infer),

@@ -4,12 +4,24 @@ Reference parity: `python/paddle/amp/grad_scaler.py:26` wrapping AmpScaler
 (`fluid/dygraph/amp/loss_scaler.py`): scale loss, unscale grads, skip step on
 non-finite grads, grow/shrink the scale. The reference fuses the finiteness
 scan into one kernel (`operators/amp/check_finite_and_unscale_op.cu`); here
-the same fusion is a single jitted reduction over all grads — one device
-program, one host sync per unscale, instead of a per-parameter D2H loop.
+the fusion goes further (FLAGS_amp_fused_update, default on): `step()` hands
+the optimizer a device `inv_scale` scalar and the unscale, the finite-scan,
+the found_inf GATE and the parameter update all run inside the optimizer's
+single donated executable — no host sync sits between backward and the
+update dispatch. The found_inf flag is read (one host sync) only afterwards,
+in `update()`, where the scale grow/shrink decision needs it; by then it
+overlaps the device work instead of serializing it.
+
+The scale itself lives as a CACHED DEVICE SCALAR (re-uploaded only when the
+scale changes, i.e. every `incr_every_n_steps` good steps or on overflow) and
+enters `scale(loss)` as an array argument — never a fresh Python float burned
+into the traced multiply, which would force a recompile at every scale
+change.
 
 Per-optimizer state (reference OptimizerState, grad_scaler.py:192-207)
 guarantees grads are unscaled exactly once even in the
-`scaler.unscale_(opt) -> clip -> scaler.step(opt)` pattern.
+`scaler.unscale_(opt) -> clip -> scaler.step(opt)` pattern — that explicit
+pattern keeps its legacy semantics (host-synced found_inf before step).
 """
 from __future__ import annotations
 
@@ -17,7 +29,9 @@ import jax
 import jax.numpy as jnp
 
 from .. import monitor as _monitor
+from ..core import flags as _flags
 from ..core.tensor import Tensor
+from ..ops._dispatch import ensure_tensor, run_op
 
 
 @jax.jit
@@ -28,6 +42,12 @@ def _fused_unscale(grads, inv):
     for g in scaled:
         finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
     return scaled, jnp.logical_not(finite)
+
+
+def _scale_mul(a, s):
+    # s enters as an ARRAY argument: the jitted multiply is shape-keyed, so
+    # a scale change re-uses the same executable (no constant burn-in)
+    return a * s.astype(a.dtype)
 
 
 class GradScaler:
@@ -47,6 +67,12 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._opt_states: dict = {}
+        # device-scalar cache for the scale (H2D only on change) + the
+        # deferred found_inf flags of fused (gated) optimizer steps
+        self._scale_cached = None
+        self._scale_arr = None
+        self._inv_scale_arr = None
+        self._pending_found: list = []
 
     def is_enable(self):
         return self._enable
@@ -57,10 +83,19 @@ class GradScaler:
     def get_loss_scaling(self):
         return self._scale
 
+    def _dev_scales(self):
+        if self._scale_cached != self._scale or self._scale_arr is None:
+            self._scale_cached = self._scale
+            self._scale_arr = jnp.asarray(self._scale, jnp.float32)
+            self._inv_scale_arr = jnp.asarray(1.0 / self._scale, jnp.float32)
+        return self._scale_arr, self._inv_scale_arr
+
     def scale(self, loss):
         if not self._enable:
             return loss
-        return loss * self._scale
+        s, _ = self._dev_scales()
+        return run_op(_scale_mul, [ensure_tensor(loss), Tensor(s)],
+                      "amp_scale")
 
     def unscale_(self, optimizer):
         if not self._enable:
@@ -76,12 +111,25 @@ class GradScaler:
         if params:
             grads = [p.grad._value if isinstance(p.grad, Tensor) else p.grad
                      for p in params]
-            inv = jnp.float32(1.0 / self._scale)
+            _, inv = self._dev_scales()
             scaled, found = _fused_unscale(grads, inv)
             self._found_inf = bool(found) or self._found_inf  # one host sync
             for p, g in zip(params, scaled):
                 p.grad = g
         self._opt_states[id(optimizer)] = self._UNSCALED
+
+    def _can_fuse(self, optimizer) -> bool:
+        """Fused path: unscale+gate inside the optimizer's donated
+        executable. Needs the flag, a fused-capable optimizer, and no
+        SelectedRows grads (the sparse rule runs eagerly)."""
+        if not _flags.flag("amp_fused_update"):
+            return False
+        if not hasattr(optimizer, "_fused_cache"):
+            return False
+        from ..core.selected_rows import SelectedRows
+        return not any(isinstance(p.grad, SelectedRows)
+                       for p in (optimizer._parameter_list or [])
+                       if p.grad is not None)
 
     def step(self, optimizer):
         if not self._enable:
@@ -91,12 +139,21 @@ class GradScaler:
         if state == self._STEPPED:
             raise RuntimeError("step() has already been called on this "
                                "optimizer since the last update().")
-        if state != self._UNSCALED:
-            self.unscale_(optimizer)
-        if not self._found_inf:
-            optimizer.step()
-        elif _monitor._ENABLED:
-            _monitor.count("amp.skipped_steps")
+        if state != self._UNSCALED and self._can_fuse(optimizer):
+            # fused: ONE dispatch does unscale + finite-scan + gate +
+            # update; found_inf comes back as a device flag whose host
+            # read is deferred to update()
+            _, inv = self._dev_scales()
+            found = optimizer.step(inv_scale=inv)
+            if found is not None:
+                self._pending_found.append((optimizer, found))
+        else:
+            if state != self._UNSCALED:
+                self.unscale_(optimizer)
+            if not self._found_inf:
+                optimizer.step()
+            elif _monitor._ENABLED:
+                _monitor.count("amp.skipped_steps")
         self._opt_states[id(optimizer)] = self._STEPPED
         # Auto-advance the scale only once every optimizer seen this round
         # has stepped — a second optimizer still in UNSCALED state must keep
@@ -108,7 +165,20 @@ class GradScaler:
         scaled_loss.backward()
         self.step(optimizer)
 
+    def _resolve_found(self):
+        """Read deferred fused found_inf flags (the one host sync of the
+        fused path — it lands AFTER the update dispatch) and commit each
+        optimizer's step count accordingly."""
+        for opt, _found in self._pending_found:
+            found = opt._resolve_pending()
+            if found:
+                self._found_inf = True
+                if _monitor._ENABLED:
+                    _monitor.count("amp.skipped_steps")
+        self._pending_found = []
+
     def update(self):
+        self._resolve_found()
         self._opt_states.clear()
         if not (self._enable and self._dynamic):
             self._found_inf = False
@@ -137,6 +207,7 @@ class GradScaler:
         step()/update() had not landed yet — so a guard checkpoint cut
         between unscale_ and step resumes with the identical
         grow/shrink trajectory."""
+        self._resolve_found()
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
                 "decr_count": self._bad_steps,
@@ -147,3 +218,4 @@ class GradScaler:
         self._good_steps = state_dict.get("incr_count", 0)
         self._bad_steps = state_dict.get("decr_count", 0)
         self._found_inf = bool(state_dict.get("found_inf", False))
+        self._pending_found = []

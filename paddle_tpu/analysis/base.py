@@ -62,6 +62,12 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
     Rule("shape-capture", Severity.WARNING,
          "branching on a tensor's .shape/len() — each distinct input shape "
          "silently compiles a different program (a per-shape retrace fork)"),
+    Rule("fused-update", Severity.INFO,
+         "advisory (--all scans): a per-parameter Python loop doing array "
+         "math inside an eager step/update function dispatches one "
+         "executable per parameter — fuse it into one jitted tree-level "
+         "update (optimizer.Optimizer._make_fused_update pattern); loops "
+         "inside traced regions unroll into one executable and are exempt"),
     # -- graph rules (analysis/graph.py, jaxpr/Program level) --
     Rule("dead-op", Severity.WARNING,
          "op whose results are never used by any program output — wasted "

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import ast
 import inspect
+import re
 import textwrap
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -40,6 +41,11 @@ _STATIC_BUILTINS = {"isinstance", "issubclass", "hasattr", "callable",
                     "type", "id", "repr"}
 # decorator name suffixes that mark a function trace-destined
 _TRACED_DECORATORS = {"to_static", "declarative", "jit"}
+# fused-update advisory: eager step/update functions looping per-parameter
+_UPDATE_FUNC_RE = re.compile(r"step|update", re.IGNORECASE)
+_PARAMISH_RE = re.compile(r"param|grad|slot|moment|velocit", re.IGNORECASE)
+# call roots/attrs in a loop body that indicate per-iteration device work
+_ARRAY_CALL_ROOTS = {"jnp", "jax", "lax", "paddle", "run_op"}
 # default values that mark a parameter as non-tensor config
 _SCALAR_DEFAULT_TYPES = (bool, int, float, str, bytes, type(None))
 
@@ -258,6 +264,47 @@ class _RegionLinter(ast.NodeVisitor):
             self._add("shape-capture", node,
                       f"`{kind}` on a tensor shape forks a separate "
                       "compilation per input shape (retrace storm)")
+
+    # -- per-param dispatch loops (fused-update advisory) --
+    def visit_For(self, node):
+        # Traced regions (full=True) unroll loops into ONE executable, so
+        # the per-param-dispatch hazard only exists in eager step/update
+        # functions scanned under --all.
+        if not self.full and _UPDATE_FUNC_RE.search(self.func) \
+                and self._iterates_params(node.iter) \
+                and self._loop_dispatches(node):
+            self._add("fused-update", node,
+                      "per-parameter Python loop doing array math in an "
+                      "eager step/update function — each iteration "
+                      "dispatches its own executable; fuse into one jitted "
+                      "tree-level update (donated, single dispatch)")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _iterates_params(iter_node) -> bool:
+        names = [n.id for n in ast.walk(iter_node)
+                 if isinstance(n, ast.Name)]
+        names += [n.attr for n in ast.walk(iter_node)
+                  if isinstance(n, ast.Attribute)]
+        return any(_PARAMISH_RE.search(s) for s in names)
+
+    @staticmethod
+    def _loop_dispatches(node) -> bool:
+        targets = {n.id for n in ast.walk(node.target)
+                   if isinstance(n, ast.Name)}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                chain = _dotted(sub.func)
+                if chain and (chain[0] in _ARRAY_CALL_ROOTS
+                              or chain[-1].lstrip("_").startswith("apply")):
+                    return True
+            elif isinstance(sub, (ast.Assign, ast.AugAssign)):
+                val = sub.value
+                if isinstance(val, ast.BinOp) and any(
+                        isinstance(n, ast.Name) and n.id in targets
+                        for n in ast.walk(val)):
+                    return True
+        return False
 
     def visit_If(self, node):
         self._check_test(node, node.test, "if")

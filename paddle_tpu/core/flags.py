@@ -166,6 +166,30 @@ define_flag("serving_warmup", True,
             "serving engine: pre-run every declared bucket x batch size "
             "at start() so steady-state serving never compiles")
 
+# ---- hot-path overlap plane (io/prefetch.py, parallel/reducer.py, fused opt) --
+define_flag("prefetch", False,
+            "async double-buffered host->device prefetch: hapi.Model.fit "
+            "feeds the train step through io.prefetch.DevicePrefetcher (a "
+            "feeder thread runs jax.device_put FLAGS_prefetch_depth batches "
+            "ahead, hiding h2d + host batch assembly under the previous "
+            "step); off = one module-attribute check per epoch (maybe_wrap)")
+define_flag("prefetch_depth", 2,
+            "prefetch: batches the feeder thread stages on device ahead of "
+            "the consumer (the reference buffered_reader double-buffer "
+            "depth); also the drop bound on preemption — at most this many "
+            "staged batches are discarded, the resume cursor only counts "
+            "CONSUMED batches")
+define_flag("dp_bucket_mb", 25,
+            "bucketed gradient reduction (parallel/reducer.py): gradient "
+            "bytes coalesced per collective in the backward-interleaved "
+            "DP reduction (reference DataParallel comm_buffer_size=25MB); "
+            "smaller = earlier overlap, larger = fewer collectives")
+define_flag("amp_fused_update", True,
+            "GradScaler.step folds unscale + found_inf check + gate into "
+            "the optimizer's fused update executable (one dispatch, no "
+            "pre-dispatch host sync on found_inf); off = the legacy "
+            "unscale_-then-step path with its per-step host sync")
+
 # ---- observability plane (paddle_tpu.obs: step timeline + flight recorder) --
 define_flag("obs_timeline", False,
             "record a per-step phase timeline (data_wait/h2d/trace_compile/"

@@ -87,13 +87,22 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None, guard=None):
+            accumulate_grad_batches=1, num_iters=None, guard=None,
+            prefetch=None):
         """`guard`: a `paddle_tpu.guard.TrainGuard` wrapping this model's
         TrainStep. Every train step then runs supervised (watchdog,
         divergence rollback, desync check, preemption checkpoint), and a
         prior `guard.resume()` fast-forwards the loop to the checkpointed
         epoch/batch cursor. A preemption raises `PreemptedError` out of
-        fit AFTER the loop state was committed."""
+        fit AFTER the loop state was committed.
+
+        `prefetch`: feed the train loop through an async device prefetcher
+        (io.prefetch.DevicePrefetcher): a feeder thread stages batches on
+        device FLAGS_prefetch_depth ahead, hiding h2d + host batch assembly
+        under the previous step. None = follow FLAGS_prefetch. Composes
+        with `guard`: the cursor counts CONSUMED batches only, so a
+        preemption drops at most `depth` staged batches — they are
+        re-produced on resume (never double-trained, never skipped)."""
         loader = self._as_loader(train_data, batch_size, shuffle)
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbs = config_callbacks(callbacks, self, epochs, steps, log_freq, verbose,
@@ -103,50 +112,64 @@ class Model:
             raise ValueError("fit(guard=...) requires prepare() with an "
                              "optimizer and a loss (the jitted TrainStep is "
                              "what the guard supervises)")
+        from ..io import prefetch as _prefetch
+        if prefetch is None:
+            feed = _prefetch.maybe_wrap(loader, step=self._train_step)
+        elif prefetch:
+            feed = _prefetch.DevicePrefetcher(loader, step=self._train_step)
+        else:
+            feed = loader
         cursor = guard.resume_cursor if guard is not None else None
         self.stop_training = False
         for cb in cbs:
             cb.on_train_begin()
         it = 0
-        for epoch in range(epochs):
-            if cursor and epoch < cursor[0]:
-                continue  # resumed past this epoch entirely
-            for cb in cbs:
-                cb.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                if cursor and (epoch, step) < tuple(cursor):
-                    continue  # resumed past this batch
+        try:
+            for epoch in range(epochs):
+                if cursor and epoch < cursor[0]:
+                    continue  # resumed past this epoch entirely
                 for cb in cbs:
-                    cb.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                if guard is not None:
-                    self.network.train()
-                    guard.set_cursor(epoch, step)
-                    self._train_step._n_model_inputs = len(inputs)
-                    loss = guard.step(*inputs, *(labels or []))
-                    if loss is None:  # divergence guard skipped the batch
-                        continue
-                else:
-                    loss = self.train_batch(inputs, labels)
-                logs = {"loss": loss}
+                    cb.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(feed):
+                    if cursor and (epoch, step) < tuple(cursor):
+                        continue  # resumed past this batch
+                    for cb in cbs:
+                        cb.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    if guard is not None:
+                        self.network.train()
+                        guard.set_cursor(epoch, step)
+                        self._train_step._n_model_inputs = len(inputs)
+                        loss = guard.step(*inputs, *(labels or []))
+                        if loss is None:  # divergence guard skipped the batch
+                            continue
+                    else:
+                        loss = self.train_batch(inputs, labels)
+                    logs = {"loss": loss}
+                    for cb in cbs:
+                        cb.on_train_batch_end(step, logs)
+                    it += 1
+                    if (num_iters and it >= num_iters) or self.stop_training:
+                        break
+                cursor = None  # fast-forward applies to the first epoch only
                 for cb in cbs:
-                    cb.on_train_batch_end(step, logs)
-                it += 1
+                    cb.on_epoch_end(epoch, logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                              verbose=0, num_workers=num_workers)
+                    for cb in cbs:
+                        cb.on_eval_end(eval_logs)
                 if (num_iters and it >= num_iters) or self.stop_training:
                     break
-            cursor = None  # fast-forward applies to the first epoch only
-            for cb in cbs:
-                cb.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
-                                          verbose=0, num_workers=num_workers)
-                for cb in cbs:
-                    cb.on_eval_end(eval_logs)
-            if (num_iters and it >= num_iters) or self.stop_training:
-                break
+        finally:
+            # stop the feeder and DROP in-flight prefetched batches — on a
+            # preemption they sit beyond the committed cursor and will be
+            # re-produced by the resumed run's fast-forwarded loader
+            if feed is not loader:
+                feed.close()
         for cb in cbs:
             cb.on_train_end(logs)
         return self

@@ -29,14 +29,18 @@ class LookAhead:
     def step(self):
         params = self.inner_optimizer._parameter_list
         if self._slow is None:
-            self._slow = [jnp.asarray(p._value) for p in params]
+            # materialized COPIES, not aliases: the inner fused step DONATES
+            # the live param buffers, so an alias here would be deleted
+            self._slow = [jnp.array(p._value, copy=True) for p in params]
         self.inner_optimizer.step()
         self._steps += 1
         if self._steps % self.k == 0:
             a = self.alpha
             for i, p in enumerate(params):
                 self._slow[i] = self._slow[i] + a * (p._value - self._slow[i])
-                p._value = self._slow[i]
+                # fast := slow by VALUE: an alias would hand _slow[i]'s
+                # buffer to the next step's donation
+                p._value = jnp.array(self._slow[i], copy=True)
 
     def clear_grad(self, *a, **kw):
         self.inner_optimizer.clear_grad(*a, **kw)

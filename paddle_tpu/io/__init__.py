@@ -8,6 +8,7 @@ from .sampler import (  # noqa: F401
     SequenceSampler, WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .prefetch import DevicePrefetcher  # noqa: F401
 
 
 class WorkerInfo:

@@ -1,0 +1,241 @@
+"""Async double-buffered host→device prefetch — the device feed queue.
+
+Reference parity: the DataFeed / `operators/reader/buffered_reader.cc`
+double-buffer (PAPER.md §2): the C++ reader stages the NEXT batch on the
+device while the current step computes, so H2D transfer and host batch
+assembly never appear on the step's critical path. TPU-native version: a
+feeder thread runs `jax.device_put` (with the step's input shardings when
+the step is SPMD) `FLAGS_prefetch_depth` batches ahead of the consumer.
+
+Sits BETWEEN any batch iterable (`io.DataLoader`, a list of numpy tuples, a
+generator) and `TrainStep`/`SPMDTrainStep`: batches come out as Tensors
+whose arrays are already device-resident, so the step's own `h2d` phase
+collapses to a metadata check and the consumer's `data_wait` collapses to a
+queue pop of a ready item.
+
+Timeline booking (obs plane): the feeder's device_put time is booked as
+`prefetch_h2d` through `add_async_phase` — it ran concurrently with steps,
+so it must stay visible WITHOUT being charged against any step window (no
+double-count against device_compute, and the phases-sum≈wall invariant
+holds). The consumer's residual stall books `data_wait` as before.
+
+TrainGuard contract: the resume cursor counts CONSUMED batches only
+(`Model.fit` sets the cursor as it pulls from this iterator), so a
+preemption drops at most `depth` staged batches — they are re-produced
+from the source on resume, never double-trained. `stats()["in_flight"]`
+exposes the staged count; `close()` discards it.
+
+Disabled path: `maybe_wrap` is ONE module-attribute check (`_ENABLED`,
+kept in sync with FLAGS_prefetch by watch_flag) — the PR-1-style overhead
+contract, enforced by a tier-1 guard test.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+from typing import Any, Optional, Sequence
+
+import jax
+
+from .. import monitor as _monitor
+from .. import obs as _obs
+from ..core import flags as _flags
+from ..core.tensor import Tensor
+
+__all__ = ["DevicePrefetcher", "maybe_wrap"]
+
+_ENABLED: bool = bool(_flags.flag("prefetch"))
+
+
+def _sync_enabled(v) -> None:
+    global _ENABLED
+    _ENABLED = bool(v)
+
+
+_flags.watch_flag("prefetch", _sync_enabled)
+
+
+def maybe_wrap(source, step=None, depth: Optional[int] = None):
+    """Wrap `source` in a DevicePrefetcher when FLAGS_prefetch is on;
+    return it unchanged otherwise. The disabled path is this one attribute
+    check — no allocation, no thread."""
+    if not _ENABLED:
+        return source
+    return DevicePrefetcher(source, step=step, depth=depth)
+
+
+def _device_put_batch(batch, shardings):
+    """numpy/Tensor batch structure -> device-resident Tensor structure.
+    `shardings` is a flat per-position list (or None) for tuple batches."""
+    if isinstance(batch, (list, tuple)):
+        out = []
+        for i, b in enumerate(batch):
+            sh = shardings[i] if shardings is not None and \
+                i < len(shardings) else None
+            out.append(_device_put_one(b, sh))
+        return tuple(out) if isinstance(batch, tuple) else out
+    return _device_put_one(batch, shardings[0] if shardings else None)
+
+
+def _device_put_one(b, sharding):
+    if isinstance(b, Tensor):
+        arr = jax.device_put(b._value, sharding) if sharding is not None \
+            else b._value
+        return Tensor(arr) if arr is not b._value else b
+    if isinstance(b, dict):
+        return {k: _device_put_one(v, sharding) for k, v in b.items()}
+    return Tensor(jax.device_put(b, sharding))
+
+
+class _Session:
+    """One epoch's feeder thread + bounded device queue."""
+
+    _END = object()
+
+    def __init__(self, it, depth: int, shardings, step):
+        self._it = it
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._shardings = shardings
+        self._step = step
+        self._produced = 0
+        self._consumed = 0
+        self._thread = threading.Thread(target=self._feed, daemon=True,
+                                        name="prefetch-feeder")
+        self._thread.start()
+
+    # ---- feeder side ----
+    def _resolve_shardings(self, batch):
+        """First batch: ask the step for its input shardings (SPMD steps
+        build + expose them; single-device steps return None -> plain
+        device_put to the default device)."""
+        if self._shardings is not None or self._step is None:
+            return
+        fn = getattr(self._step, "input_shardings", None)
+        if fn is not None:
+            try:
+                self._shardings = fn(*batch) if isinstance(batch, (list, tuple)) \
+                    else fn(batch)
+            except Exception:
+                self._shardings = None
+        self._step = None  # resolve once
+
+    def _feed(self) -> None:
+        mon = _monitor._ENABLED
+        try:
+            for batch in self._it:
+                if self._stop.is_set():
+                    return
+                self._resolve_shardings(batch)
+                _t0 = _time.time()
+                staged = _device_put_batch(batch, self._shardings)
+                _t1 = _time.time()
+                if _obs._TL_ENABLED:
+                    # hidden time: ran under the previous step, so it books
+                    # through add_async_phase (between bucket), never inside
+                    # a step window
+                    _obs.add_async_phase("prefetch_h2d", _t1 - _t0, _t0, _t1)
+                if mon:
+                    _monitor.observe("io.prefetch.h2d", _t1 - _t0)
+                    _monitor.count("io.prefetch.batches")
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.1)
+                        self._produced += 1
+                        break
+                    except queue.Full:
+                        if mon:
+                            _monitor.count("io.prefetch.stalls")
+        except Exception as e:  # propagate to the consumer
+            self._q.put(e)
+            return
+        self._q.put(self._END)
+
+    # ---- consumer side ----
+    def next(self):
+        if _monitor._ENABLED or _obs._TL_ENABLED:
+            _tw = _time.time()
+            item = self._q.get()
+            _t1 = _time.time()
+            if _monitor._ENABLED:
+                _monitor.observe("io.prefetch.queue_wait", _t1 - _tw)
+            # residual stall (feeder slower than the device): between-steps
+            # data_wait, exactly like the DataLoader consumer booking
+            _obs.add_phase("data_wait", _t1 - _tw, _tw, _t1)
+        else:
+            item = self._q.get()
+        if item is self._END:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        self._consumed += 1
+        return item
+
+    @property
+    def in_flight(self) -> int:
+        return self._produced - self._consumed
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so a feeder blocked on put() can observe the stop event
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+
+class DevicePrefetcher:
+    """Re-iterable device feed queue over any batch iterable. Each
+    `iter()` starts a fresh feeder session (one per epoch); `close()`
+    stops the active session and discards staged batches."""
+
+    def __init__(self, source, step=None, depth: Optional[int] = None,
+                 shardings: Optional[Sequence[Any]] = None):
+        self.source = source
+        self.depth = int(depth) if depth is not None \
+            else int(_flags.flag("prefetch_depth"))
+        self._shardings = list(shardings) if shardings is not None else None
+        self._step = step
+        self._session: Optional[_Session] = None
+
+    def __iter__(self):
+        if self._session is not None:
+            self._session.close()
+        self._session = _Session(iter(self.source), self.depth,
+                                 self._shardings, self._step)
+        return self
+
+    def __next__(self):
+        if self._session is None:
+            iter(self)
+        return self._session.next()
+
+    def __len__(self):
+        return len(self.source)
+
+    def stats(self) -> dict:
+        s = self._session
+        return {"depth": self.depth,
+                "in_flight": s.in_flight if s is not None else 0,
+                "produced": s._produced if s is not None else 0,
+                "consumed": s._consumed if s is not None else 0}
+
+    def close(self) -> None:
+        """Stop the feeder and DROP staged batches. Safe after a
+        preemption: the resume cursor only counts consumed batches, so the
+        dropped ones are re-produced from the source on the next run."""
+        if self._session is not None:
+            dropped = self._session.in_flight
+            if dropped and _monitor._ENABLED:
+                _monitor.count("io.prefetch.dropped", dropped)
+            self._session.close()
+            self._session = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

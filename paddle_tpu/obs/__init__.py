@@ -40,7 +40,7 @@ from .timeline import NULL_CTX, PHASES, StepTimeline  # noqa: F401
 __all__ = [
     "StepTimeline", "FlightRecorder", "PHASES", "DUMP_SCHEMA",
     "enabled", "enable", "disable", "timeline", "recorder",
-    "phase", "step_record", "add_phase", "mark",
+    "phase", "step_record", "add_phase", "add_async_phase", "mark",
     "record_event", "record_collective",
     "dump", "dump_on_error", "register_dump_trigger", "dump_triggers",
     "trigger_reason", "gather_timelines", "merge_timelines",
@@ -147,6 +147,14 @@ def add_phase(name: str, dur: float, t0=None, t1=None) -> None:
     tl = _TIMELINE
     if tl is not None and _TL_ENABLED:
         tl.add_phase(name, dur, t0, t1)
+
+
+def add_async_phase(name: str, dur: float, t0=None, t1=None) -> None:
+    """Book concurrent (hidden) work — always into the between-steps
+    bucket, never the open step record (see StepTimeline.add_async_phase)."""
+    tl = _TIMELINE
+    if tl is not None and _TL_ENABLED:
+        tl.add_async_phase(name, dur, t0, t1)
 
 
 def mark(name: str) -> None:

@@ -25,6 +25,9 @@ one artifact carries op dispatch, monitor spans, and step phases.
 Phase vocabulary used by the instrumented call sites:
   data_wait       DataLoader consumer stalled on the worker queue (io/)
   h2d             batch → device-array conversion (jit/, parallel/)
+  prefetch_h2d    async feeder-thread device_put (io/prefetch.py) — HIDDEN
+                  time booked via add_async_phase: it overlaps steps, so it
+                  lands in `between`, never inside a step window
   build           TrainStep._build: module-tree walk + slot init
   trace_compile   first dispatch of a novel batch signature (jax trace +
                   XLA compile + run)
@@ -46,8 +49,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["StepTimeline", "PHASES"]
 
-PHASES = ("data_wait", "h2d", "build", "trace_compile", "device_compute",
-          "collective", "optimizer", "snapshot", "checkpoint", "desync")
+PHASES = ("data_wait", "h2d", "prefetch_h2d", "build", "trace_compile",
+          "device_compute", "collective", "optimizer", "snapshot",
+          "checkpoint", "desync")
 
 _MAX_SPANS_PER_STEP = 128
 
@@ -184,6 +188,21 @@ class StepTimeline:
                 phases, spans = rec["phases"], rec["spans"]
             else:
                 phases, spans = self._pending, self._pending_spans
+            phases[name] = phases.get(name, 0.0) + float(dur)
+            if t0 is not None and len(spans) < _MAX_SPANS_PER_STEP:
+                spans.append([name, t0, t1 if t1 is not None else t0 + dur])
+
+    def add_async_phase(self, name: str, dur: float,
+                        t0: Optional[float] = None,
+                        t1: Optional[float] = None) -> None:
+        """Book time that ran CONCURRENTLY with steps on another thread
+        (prefetch feeder h2d, background checkpoint IO). It always lands in
+        the pending between-steps bucket — never inside the open step
+        record — so hidden work stays visible in summaries without breaking
+        the in-window phases-sum≈wall invariant or double-counting against
+        device_compute."""
+        with self._lock:
+            phases, spans = self._pending, self._pending_spans
             phases[name] = phases.get(name, 0.0) + float(dur)
             if t0 is not None and len(spans) < _MAX_SPANS_PER_STEP:
                 spans.append([name, t0, t1 if t1 is not None else t0 + dur])

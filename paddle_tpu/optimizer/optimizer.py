@@ -6,10 +6,15 @@ fluid optimizer ops (`operators/optimizers/sgd_op.cc`, `momentum_op.cc`,
 
 TPU-first design: `step()` applies ONE jitted, fused update over all
 parameters at once (the multi-tensor "merged" optimizer the reference only
-has for adam) — gradient clip, weight decay, and the update rule all fuse
-into a single XLA program per parameter-group structure. The same pure
-`_apply` core is reused by the jitted train-step builder (paddle_tpu.jit)
-so eager and static training share optimizer semantics.
+has for adam) — gradient clip, weight decay, the update rule, and (when a
+GradScaler drives the step) the unscale + found_inf gate all fuse into a
+single DONATED XLA executable per parameter-group structure: param and slot
+buffers are donated (reused in place, no per-step re-allocation), the step
+counter `t` rides as device carry state, and the learning rate enters as a
+cached device scalar — steady state pays ONE dispatch and ZERO host→device
+scalar transfers per step. The same pure `_apply` core is reused by the
+jitted train-step builder (paddle_tpu.jit) so eager and static training
+share optimizer semantics.
 """
 from __future__ import annotations
 
@@ -40,6 +45,17 @@ class Optimizer:
         self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
         self._step_count = 0
         self._jit_cache = {}
+        # fused eager-step state: donated executables per structure key,
+        # cached lr device scalar, and the device step-counter carry (host
+        # mirror `_t_host` detects external _step_count writes — rollback,
+        # set_state_dict — and refreshes the carry)
+        self._fused_cache = {}
+        self._lr_arr = None
+        self._lr_host = None
+        self._t_arr = None
+        self._t_host = None
+        self._pending = None  # (expected_t, found_inf array): scaler-gated
+        #                       step whose commit awaits the found_inf read
 
     # ---- lr ----
     def get_lr(self) -> float:
@@ -81,30 +97,78 @@ class Optimizer:
             return 0.0
         return self._weight_decay
 
+    # ---- per-step device scalars (no fresh float() feeds) ----
+    def _lr_scalar(self):
+        """Learning rate as a cached device scalar: the H2D transfer happens
+        only when the host value CHANGES (scheduler tick), never per step."""
+        lr_val = self.get_lr()
+        if lr_val != self._lr_host or self._lr_arr is None:
+            self._lr_host = lr_val
+            self._lr_arr = jnp.asarray(lr_val, jnp.float32)
+        return self._lr_arr
+
+    def _t_scalar(self):
+        """Step counter as device carry state: the fused update returns
+        t+1 (gated on found_inf), so steady state never re-uploads it. The
+        host mirror catches external _step_count writes (set_state_dict,
+        guard rollback) and refreshes the carry from the host."""
+        expected = float(self._step_count + 1)
+        if self._t_arr is None or self._t_host != expected:
+            self._t_arr = jnp.asarray(expected, jnp.float32)
+            self._t_host = expected
+        return self._t_arr
+
+    def _resolve_pending(self):
+        """Commit a scaler-gated step once its found_inf flag is read on
+        the host. Returns found_inf (True = the update was gated away) or
+        None when nothing is pending."""
+        if self._pending is None:
+            return None
+        expected, found_arr = self._pending
+        self._pending = None
+        found = bool(found_arr)
+        if not found:
+            self._step_count += 1
+            self._t_host = expected + 1.0
+        # gated: device t stayed at `expected` (the in-program where), and
+        # _t_host already equals expected — carry stays consistent
+        return found
+
     # ---- step ----
-    def step(self):
+    def step(self, inv_scale=None):
+        """Apply one fused update. `inv_scale` (internal, set by
+        GradScaler.step under FLAGS_amp_fused_update) folds unscale +
+        found_inf check + gate into the same executable and returns the
+        device found_inf flag; the commit (step_count++) is deferred to
+        `_resolve_pending` so no host sync happens before dispatch."""
         from .. import monitor as _monitor
         from .. import obs as _obs
         if not (_monitor._ENABLED or _obs._TL_ENABLED):
-            return self._step_impl()
+            return self._step_impl(inv_scale)
         import time as _time
         _t0 = _time.time()
         try:
             with _obs.phase("optimizer"):
-                return self._step_impl()
+                return self._step_impl(inv_scale)
         finally:
             if _monitor._ENABLED:
                 _monitor.count("optimizer.steps")
                 _monitor.observe("optimizer.step_dur", _time.time() - _t0)
 
-    def _step_impl(self):
+    def _step_impl(self, inv_scale=None):
         from ..core.selected_rows import SelectedRows
+        self._resolve_pending()
         params = [p for p in (self._parameter_list or [])
                   if not p.stop_gradient and p.grad is not None]
         # sparse (SelectedRows) grads take the row-wise path (reference
         # sparse sgd/adam kernels); dense grads go through the fused jit
         sparse = [p for p in params if isinstance(p.grad, SelectedRows)]
         params = [p for p in params if not isinstance(p.grad, SelectedRows)]
+        if inv_scale is not None and sparse:
+            raise RuntimeError("fused scaler update does not support "
+                               "SelectedRows grads — use scaler.unscale_() "
+                               "then step() (GradScaler falls back "
+                               "automatically)")
         grads = [p.grad._value if isinstance(p.grad, Tensor) else p.grad for p in params]
         clip = self._grad_clip
         clip_in_jit = clip
@@ -126,8 +190,8 @@ class Optimizer:
                           for m, v in zip(merged, all_g[len(grads):])]
                 clip_in_jit = None  # already applied
 
-        lr_s = jnp.asarray(self.get_lr(), jnp.float32)
-        t_s = jnp.asarray(self._step_count + 1, jnp.float32)
+        lr_s = self._lr_scalar()
+        t_s = self._t_scalar()
         for p, sr in zip(sparse, merged):
             if id(p) not in self._accumulators:
                 self._accumulators[id(p)] = self._create_slots(p)
@@ -137,7 +201,8 @@ class Optimizer:
                 wd=self._param_wd(p))
         if not params:
             self._step_count += 1
-            return
+            self._t_host = None  # sparse path did not advance the carry
+            return None
 
         for p in params:
             if id(p) not in self._accumulators:
@@ -150,18 +215,72 @@ class Optimizer:
                     for p in params)
 
         key = (tuple((tuple(p.shape), str(p.dtype)) for p in params), wds, need_clip, lrs,
-               type(clip_in_jit).__name__)
-        fn = self._jit_cache.get(key)
+               type(clip_in_jit).__name__, inv_scale is not None)
+        fn = self._fused_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._make_update(clip_in_jit, wds, need_clip, lrs))
-            self._jit_cache[key] = fn
+            # ONE donated executable for the whole update: params (0), slots
+            # (2) and the t carry (4) are donated, so steady-state stepping
+            # re-uses the buffers in place instead of re-allocating per step.
+            # grads (1) and lr (3) are NOT donated — grads stay readable
+            # until clear_grad, lr is a cached scalar reused across steps.
+            fn = jax.jit(
+                self._make_fused_update(clip_in_jit, wds, need_clip, lrs,
+                                        scaled=inv_scale is not None),
+                donate_argnums=(0, 2, 4))
+            self._fused_cache[key] = fn
 
-        new_vals, new_slots = fn([p._value for p in params], grads, slots,
-                                 lr_s, t_s)
+        from .. import monitor as _monitor
+        if _monitor._ENABLED:
+            _monitor.count("optimizer.fused_dispatches")
+        if inv_scale is None:
+            new_vals, new_slots, new_t = fn([p._value for p in params],
+                                            grads, slots, lr_s, t_s)
+            found = None
+        else:
+            new_vals, new_slots, new_t, found = fn(
+                [p._value for p in params], grads, slots, lr_s, t_s,
+                inv_scale)
         for p, v, s in zip(params, new_vals, new_slots):
             p._value = v
             self._accumulators[id(p)] = s
-        self._step_count += 1
+        self._t_arr = new_t
+        if inv_scale is None:
+            self._step_count += 1
+            self._t_host = self._t_host + 1.0
+        else:
+            # deferred commit: whether this step counted is decided by the
+            # found_inf flag, read by GradScaler.update (no sync here)
+            self._pending = (self._t_host, found)
+        return found
+
+    def _make_fused_update(self, clip, wds, need_clip, lrs, scaled=False):
+        """The single-block eager update: dtype harmonization, (optional)
+        unscale + finite-scan, grad clip, weight decay, per-param rule, and
+        the found_inf gate — one traced program, unrolled over the tree.
+        The per-param loop below unrolls INSIDE the jitted block (one
+        executable), it is not a per-param dispatch."""
+        inner = self._make_update(clip, wds, need_clip, lrs)
+
+        def update(values, grads, slots, lr, t, *scale_args):
+            if scaled:
+                inv = scale_args[0]
+                grads = [g * inv.astype(g.dtype) for g in grads]
+                finite = jnp.asarray(True)
+                for g in grads:  # tpu-lint: disable=fused-update
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
+                found = jnp.logical_not(finite)
+            outs, outslots = inner(values, grads, slots, lr, t)
+            if scaled:
+                # gate: a non-finite grad set keeps params/slots/t frozen
+                outs = [jnp.where(found, v, nv)
+                        for v, nv in zip(values, outs)]
+                outslots = [{k: jnp.where(found, s[k], ns[k]) for k in ns}
+                            for s, ns in zip(slots, outslots)]
+                return outs, outslots, jnp.where(found, t, t + 1.0), found
+            return outs, outslots, t + 1.0
+
+        return update
 
     def _make_update(self, clip, wds, need_clip, lrs):
         def update(values, grads, slots, lr, t):
@@ -170,7 +289,8 @@ class Optimizer:
                      for g, v in zip(grads, values)]
             grads = _clip_fn(clip, grads, need_clip)
             outs, outslots = [], []
-            for v, g, s, wd, plr in zip(values, grads, slots, wds, lrs):
+            # unrolls inside ONE traced executable (not per-param dispatch)
+            for v, g, s, wd, plr in zip(values, grads, slots, wds, lrs):  # tpu-lint: disable=fused-update
                 nv, ns = self._apply(v, g.astype(v.dtype), s, lr=lr * plr, t=t, wd=wd)
                 outs.append(nv)
                 outslots.append(ns)
@@ -191,6 +311,7 @@ class Optimizer:
 
     # ---- state dict ----
     def state_dict(self):
+        self._resolve_pending()
         sd = {"step_count": self._step_count, "accumulators": {}}
         if self._parameter_list:
             for i, p in enumerate(self._parameter_list):
@@ -203,6 +324,7 @@ class Optimizer:
         return sd
 
     def set_state_dict(self, state_dict):
+        self._resolve_pending()
         self._step_count = state_dict.get("step_count", 0)
         accs = state_dict.get("accumulators", {})
         if self._parameter_list:

@@ -4,6 +4,8 @@ Map (reference → TPU-native):
   NCCL rings / ProcessGroup     → mesh axes + XLA collectives (collective.py)
   topology.HybridCommunicateGroup → jax.sharding.Mesh (topology.py)
   dygraph Reducer DP            → batch sharding in the jitted step (data_parallel.py)
+  imperative/reducer.cc buckets → backward-interleaved per-bucket allreduce
+                                  (reducer.py, SPMDTrainStep grad_reduction="bucketed")
   mp_layers manual collectives  → GSPMD sharding annotations (mp_layers.py)
   PipelineParallel 1F1B + p2p   → per-stage submesh programs + device_put ICI hops
   Sharding stage 1/2/3 (ZeRO)   → PartitionSpecs on opt state/grads/params (spmd.py)
@@ -30,6 +32,7 @@ from .mp_layers import (  # noqa: F401
 )
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .reducer import Reducer  # noqa: F401
 from .spmd import SPMDTrainStep  # noqa: F401
 from .sp import (  # noqa: F401
     SequenceParallelAttention, ring_attention_local, sequence_parallel_attention,

@@ -47,7 +47,9 @@ def _shard_biggest_axis(shape, axis_name, axis_size):
 class SPMDTrainStep:
     def __init__(self, model, loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
                  sharding_stage: int = 0, amp_dtype=None, donate: bool = True,
-                 batch_specs: Optional[Sequence] = None, n_model_inputs=None):
+                 batch_specs: Optional[Sequence] = None, n_model_inputs=None,
+                 grad_reduction: str = "gspmd",
+                 bucket_bytes: Optional[int] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -59,8 +61,25 @@ class SPMDTrainStep:
         self._donate = donate
         self._batch_specs = batch_specs
         self._n_model_inputs = n_model_inputs
+        # "gspmd": the compiler inserts/fuses the gradient reduction.
+        # "bucketed": explicit backward-interleaved per-bucket allreduce via
+        # parallel.reducer.Reducer inside shard_map over the dp axis (the
+        # reference imperative Reducer role) — the collectives are visible
+        # to collective_signature()/tpu-lint instead of compiler-hidden.
+        if grad_reduction not in ("gspmd", "bucketed"):
+            raise ValueError(f"grad_reduction must be 'gspmd' or 'bucketed', "
+                             f"got {grad_reduction!r}")
+        self.grad_reduction = grad_reduction
+        self._bucket_bytes = bucket_bytes  # None -> FLAGS_dp_bucket_mb
+        self.reducer = None
         self._jitted = None
         self._slots = None
+        # per-step device scalars: lr re-uploads only on value change, the
+        # step counter t rides as donated carry state through the program
+        self._lr_arr = None
+        self._lr_host = None
+        self._t_arr = None
+        self._t_host = None
 
     # ---- sharding policies ----
     def _data_axes(self):
@@ -129,11 +148,14 @@ class SPMDTrainStep:
         self._n_mi = n_mi
         in_batch_specs = [self._batch_spec(a.ndim, i) for i, a in enumerate(batch_arrs)]
 
-        def pure(params, slots, buffers, rng_key, lr, t, batch):
-            rnd.push_trace_key(rng_key)
+        def step_body(params, slots, buffers, step_key, lr, t, inputs,
+                      labels, reducer=None):
+            """Shared fwd+bwd+update core. With a reducer, grads are
+            reduced per size-capped bucket in backward order (explicit
+            collectives the latency-hiding scheduler can overlap with the
+            remaining backward); without one, GSPMD owns the reduction."""
+            rnd.push_trace_key(step_key)
             try:
-                inputs, labels = batch[:n_mi], batch[n_mi:]
-
                 def fwd(ps):
                     from ..jit.functional import amp_functional_call
                     out = amp_functional_call(model, pnames, ps, bnames,
@@ -144,16 +166,66 @@ class SPMDTrainStep:
                     return loss._value if isinstance(loss, Tensor) else loss
 
                 loss, grads = jax.value_and_grad(fwd)(params)
+                if reducer is not None:
+                    grads = reducer.reduce(grads)
+                    from jax import lax as _lax
+                    loss = _lax.pmean(loss, reducer.axis)
                 new_params, new_slots = optimizer.functional_update(
                     params, grads, slots, lr, t, params_meta=ptensors)
                 if nan_check:
                     bad = jnp.stack(
                         [~jnp.isfinite(loss)]
                         + [~jnp.all(jnp.isfinite(g)) for g in grads])
-                    return new_params, new_slots, loss, bad
-                return new_params, new_slots, loss, None
+                    return new_params, new_slots, loss, t + 1.0, bad
+                return new_params, new_slots, loss, t + 1.0, None
             finally:
                 rnd.pop_trace_key()
+
+        use_reducer = self.grad_reduction == "bucketed"
+        if use_reducer:
+            if "dp" not in mesh.shape:
+                raise ValueError("grad_reduction='bucketed' needs a 'dp' "
+                                 "mesh axis (the reducer allreduces over it)")
+            if self.sharding_stage != 0 or len(mesh.shape) != 1:
+                raise ValueError(
+                    "grad_reduction='bucketed' supports the pure-DP regime "
+                    "(1-axis dp mesh, sharding_stage=0); hybrid layouts use "
+                    "grad_reduction='gspmd' where the compiler owns the "
+                    "reduction")
+            bad_specs = [n for n, s in zip(self._pnames, pspecs) if s != P()]
+            if bad_specs:
+                raise ValueError("bucketed reduction requires replicated "
+                                 f"params; sharded: {bad_specs[:3]}")
+            from .reducer import Reducer
+            self.reducer = Reducer(ptensors, axis="dp",
+                                   bucket_bytes=self._bucket_bytes)
+
+            def pure(params, slots, buffers, rng_key, lr, t, batch):
+                from jax.experimental.shard_map import shard_map
+
+                def body(params, slots, buffers, rng_key, lr, t, *batch):
+                    inputs, labels = batch[:n_mi], batch[n_mi:]
+                    return step_body(params, slots, buffers, rng_key, lr, t,
+                                     inputs, labels, reducer=self.reducer)
+
+                in_specs = ([P() for _ in params],
+                            [{k: P() for k in d} for d in slots],
+                            [P() for _ in buffers],
+                            P(), P(), P(),
+                            *[P(*s) if not isinstance(s, P) else s
+                              for s in in_batch_specs])
+                out_specs = ([P() for _ in params],
+                             [{k: P() for k in d} for d in slots],
+                             P(), P(),
+                             P() if nan_check else None)
+                return shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)(
+                    params, slots, buffers, rng_key, lr, t, *batch)
+        else:
+            def pure(params, slots, buffers, rng_key, lr, t, batch):
+                inputs, labels = batch[:n_mi], batch[n_mi:]
+                return step_body(params, slots, buffers, rng_key, lr, t,
+                                 inputs, labels)
 
         def ns(spec):
             return NamedSharding(mesh, spec)
@@ -161,13 +233,15 @@ class SPMDTrainStep:
         in_sh = ([ns(s) for s in pspecs],
                  [{k: ns(v) for k, v in d.items()} for d in sspecs],
                  [ns(s) for s in bspecs],
-                 None, None, None,
+                 None, ns(P()), ns(P()),
                  [ns(s) for s in in_batch_specs])
         out_sh = ([ns(s) for s in pspecs],
                   [{k: ns(v) for k, v in d.items()} for d in sspecs],
                   ns(P()),
+                  ns(P()),
                   ns(P()) if nan_check else None)
-        donate = (0, 1) if self._donate else ()
+        # donate params (0), slots (1) and the t carry (5)
+        donate = (0, 1, 5) if self._donate else ()
         self._jitted = jax.jit(pure, in_shardings=in_sh, out_shardings=out_sh,
                                donate_argnums=donate)
         self._pure = pure   # unjitted body: collective_signature/tpu-lint
@@ -265,6 +339,41 @@ class SPMDTrainStep:
                        for s, d in zip(sd["slots"], self._sspecs)]
         self.optimizer._step_count = int(sd["step_count"])
 
+    # ---- per-step device scalars (no fresh float() feeds per step) ----
+    def _lr_scalar(self):
+        """lr as a mesh-replicated cached scalar: H2D only on value change."""
+        lr_val = self.optimizer.get_lr()
+        if lr_val != self._lr_host or self._lr_arr is None:
+            self._lr_host = lr_val
+            self._lr_arr = jax.device_put(
+                jnp.asarray(lr_val, jnp.float32),
+                NamedSharding(self.mesh, P()))
+        return self._lr_arr
+
+    def _t_scalar(self):
+        """Step counter as donated device carry (the program returns t+1);
+        the host mirror catches external _step_count writes (guard
+        rollback/resume) and refreshes the carry from the host."""
+        expected = float(self.optimizer._step_count + 1)
+        if self._t_arr is None or self._t_host != expected:
+            self._t_arr = jax.device_put(
+                jnp.asarray(expected, jnp.float32),
+                NamedSharding(self.mesh, P()))
+            self._t_host = expected
+        return self._t_arr
+
+    def input_shardings(self, *batch):
+        """NamedShardings for the step's batch arguments — what the
+        io.prefetch feeder uses so its device_put stages each batch
+        DIRECTLY into the layout the executable consumes (no resharding
+        on the step's critical path). Builds the step if needed."""
+        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in batch]
+        if self._jitted is None:
+            self._build(arrs)
+        return [NamedSharding(self.mesh, self._batch_spec(a.ndim, i))
+                for i, a in enumerate(arrs)]
+
     def __call__(self, *batch):
         with _obs.step_record():
             with _obs.phase("h2d"):
@@ -278,21 +387,23 @@ class SPMDTrainStep:
             params = [trainable[n]._value for n in self._pnames]
             buffers = [frozen[n]._value for n in self._bnames]
             key = rnd.default_generator().next_key()
-            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-            t = jnp.asarray(self.optimizer._step_count + 1, jnp.float32)
+            lr = self._lr_scalar()
+            t = self._t_scalar()
             # GSPMD folds the collectives INTO the executable, so the
             # timeline cannot fence them apart from compute here — the
             # device_compute phase is the whole sharded step; explicit
             # eager collectives (parallel/collective.py) get their own
             # `collective` phase.
             with _obs.phase("trace_compile" if first else "device_compute"):
-                new_params, self._slots, loss, bad = self._jitted(
+                new_params, self._slots, loss, new_t, bad = self._jitted(
                     params, self._slots, buffers, key, lr, t, arrs)
                 if _obs._TL_ENABLED:
                     jax.block_until_ready(loss)
             # commit before the debug raise — old buffers were donated
             for n, v in zip(self._pnames, new_params):
                 trainable[n]._value = v
+            self._t_arr = new_t
+            self._t_host = self._t_host + 1.0
             self.optimizer._step_count += 1
             from ..jit.train_step import raise_nonfinite
             raise_nonfinite(bad, self._pnames, "jitted SPMD train step")

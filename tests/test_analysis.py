@@ -703,8 +703,13 @@ class TestSelfLint:
         in README; intentional host syncs carry explicit suppressions."""
         findings, n_files = analysis.lint_paths(
             [os.path.join(PKG, "models"), os.path.join(PKG, "nn"),
-             os.path.join(PKG, "ops")], all_functions=True)
-        assert n_files > 20
+             os.path.join(PKG, "ops"),
+             # hot-path overlap plane (ISSUE 7): the prefetch feeder and
+             # the bucketed reducer ride the same gate
+             os.path.join(PKG, "io", "prefetch.py"),
+             os.path.join(PKG, "parallel", "reducer.py")],
+            all_functions=True)
+        assert n_files > 22
         assert findings == [], "\n".join(f.format() for f in findings)
 
     def test_shipped_model_programs_are_graph_clean(self):

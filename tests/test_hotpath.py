@@ -1,0 +1,658 @@
+"""Hot-path overlap & fusion plane (ISSUE 7): async device prefetch
+(io/prefetch.py), the fused/donated eager optimizer update + scaler gate
+(optimizer/optimizer.py, amp/grad_scaler.py), and bucketed
+backward-interleaved gradient reduction (parallel/reducer.py,
+SPMDTrainStep grad_reduction="bucketed").
+
+Acceptance properties:
+  - prefetch-fed training is BIT-identical to sync-fed, including a
+    TrainGuard SIGTERM resume cut mid-prefetch (in-flight staged batches
+    are dropped and re-produced, never double-trained);
+  - the eager optimizer step is ONE dispatched executable with donated
+    param/slot/t buffers (monitor op-count + is_deleted prove it), and the
+    fused unscale+clip+update math matches the unfused per-param reference;
+  - steady state pays zero retraces and zero per-step host scalar H2D
+    (lr/scale enter as cached device scalars, t as donated carry);
+  - the bucketed reducer emits one collective PER BUCKET in backward
+    order — visible in collective_signature() — not one end-of-step
+    reduction, and matches single-device math.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import monitor, obs
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.guard import GuardConfig, PreemptedError, TrainGuard
+from paddle_tpu.io.prefetch import DevicePrefetcher, maybe_wrap
+from paddle_tpu.jit import TrainStep
+
+
+@pytest.fixture
+def with_monitor():
+    _flags.set_flags({"monitor": True})
+    monitor.reset()
+    yield
+    monitor.reset()
+    _flags.set_flags({"monitor": False})
+
+
+@pytest.fixture
+def with_timeline():
+    _flags.set_flags({"obs_timeline": True})
+    obs.reset()
+    yield
+    _flags.set_flags({"obs_timeline": False})
+    obs.reset()
+
+
+class TwoLayer(nn.Layer):
+    def __init__(self, din=8, dh=16, dout=4):
+        super().__init__()
+        self.fc1 = nn.Linear(din, dh)
+        self.fc2 = nn.Linear(dh, dout)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _batches(n, b=4, din=8, dout=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(b, din).astype("float32"),
+             rng.rand(b, dout).astype("float32")) for _ in range(n)]
+
+
+def _make_step(seed=0, lr=0.01):
+    paddle.seed(seed)
+    net = TwoLayer()
+    opt = paddle.optimizer.Adam(parameters=net.parameters(), learning_rate=lr)
+    return TrainStep(net, _mse, opt, n_model_inputs=1)
+
+
+def _final_params(step):
+    return {n: np.asarray(t._value)
+            for n, t in zip(step._pnames, step._ptensors)}
+
+
+# ---------------------------------------------------------------------------
+# async device prefetch
+# ---------------------------------------------------------------------------
+
+class TestPrefetch:
+    def test_epoch_bit_identical_to_sync_feed(self):
+        """Same batches through the same TrainStep, sync vs prefetch-fed:
+        final params must be bit-identical (the feeder only MOVES data)."""
+        batches = _batches(12)
+
+        def train(feed):
+            step = _make_step()
+            for x, y in feed:
+                step(paddle.to_tensor(x) if isinstance(x, np.ndarray) else x,
+                     paddle.to_tensor(y) if isinstance(y, np.ndarray) else y)
+            return _final_params(step)
+
+        w_sync = train(batches)
+        w_pf = train(DevicePrefetcher(batches, depth=3))
+        assert sorted(w_sync) == sorted(w_pf)
+        for n in w_sync:
+            np.testing.assert_array_equal(w_sync[n], w_pf[n])
+
+    def test_reiterable_multiple_epochs(self):
+        batches = _batches(5)
+        pf = DevicePrefetcher(batches, depth=2)
+        for _ in range(3):  # one feeder session per epoch
+            seen = [np.asarray(x._value)[0, 0] for x, _ in pf]
+            assert len(seen) == 5
+        assert pf.stats()["consumed"] == 5
+        pf.close()
+
+    def test_order_preserved_and_values_exact(self):
+        batches = [(np.full((2, 3), i, "float32"),) for i in range(20)]
+        pf = DevicePrefetcher(batches, depth=4)
+        vals = [float(np.asarray(b[0]._value)[0, 0]) for b in pf]
+        assert vals == [float(i) for i in range(20)]
+
+    def test_source_exception_propagates(self):
+        def gen():
+            yield (np.zeros((2, 2), "float32"),)
+            raise RuntimeError("boom in source")
+
+        pf = DevicePrefetcher(gen(), depth=2)
+        it = iter(pf)
+        next(it)
+        with pytest.raises(RuntimeError, match="boom in source"):
+            next(it)
+
+    def test_close_drops_in_flight(self, with_monitor):
+        batches = _batches(50)
+        pf = DevicePrefetcher(batches, depth=4)
+        it = iter(pf)
+        next(it)
+        time.sleep(0.2)  # let the feeder fill the queue
+        assert pf.stats()["in_flight"] > 0
+        pf.close()
+        assert pf.stats()["in_flight"] == 0
+        assert monitor.counter("io.prefetch.dropped").get() > 0
+
+    def test_maybe_wrap_flag_gate(self):
+        src = _batches(2)
+        assert maybe_wrap(src) is src
+        paddle.set_flags({"FLAGS_prefetch": True,
+                          "FLAGS_prefetch_depth": 3})
+        try:
+            w = maybe_wrap(src)
+            assert isinstance(w, DevicePrefetcher)
+            assert w.depth == 3
+        finally:
+            paddle.set_flags({"FLAGS_prefetch": False,
+                              "FLAGS_prefetch_depth": 2})
+        assert maybe_wrap(src) is src
+
+    def test_disabled_path_is_attribute_check(self):
+        """PR-1-style overhead guard: with FLAGS_prefetch off, maybe_wrap
+        must stay a single module-attribute check — no allocation, no
+        thread, no flag-registry lookup."""
+        src = []
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            maybe_wrap(src)
+        t_gate = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            pass
+        t_base = time.perf_counter() - t0
+        assert t_gate < t_base + 0.05
+
+    def test_fit_prefetch_matches_sync(self):
+        """hapi.Model.fit(prefetch=True) trains identically to the sync
+        path over 2 epochs."""
+        from paddle_tpu.hapi.model import Model
+        data = [(x[0], y[0]) for x, y in _batches(8, b=1)]
+
+        def fit_once(prefetch):
+            paddle.seed(0)
+            net = TwoLayer()
+            model = Model(net)
+            opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                        learning_rate=0.01)
+            model.prepare(optimizer=opt, loss=_mse)
+            model.fit(data, batch_size=4, epochs=2, shuffle=False, verbose=0,
+                      prefetch=prefetch)
+            return _final_params(model._train_step)
+
+        w_off = fit_once(False)
+        w_on = fit_once(True)
+        for n in w_off:
+            np.testing.assert_array_equal(w_off[n], w_on[n])
+
+
+class TestPrefetchGuardResume:
+    def _fit_once(self, ckpt_dir, preempt_at=None, epochs=2):
+        """fit with guard + prefetch; optionally SIGTERM at the Nth
+        guarded step — mid-prefetch, with staged batches in flight."""
+        from paddle_tpu.hapi.model import Model
+        paddle.seed(0)
+        net = TwoLayer()
+        model = Model(net)
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=0.01)
+        model.prepare(optimizer=opt, loss=_mse)
+        data = [(x[0], y[0]) for x, y in _batches(12, b=1)]
+        guard = TrainGuard(model._train_step, ckpt_dir=ckpt_dir,
+                           config=GuardConfig(snapshot_interval=0))
+        if preempt_at is not None:
+            calls = {"n": 0}
+            orig = guard.step
+
+            def counting_step(*b):
+                calls["n"] += 1
+                if calls["n"] == preempt_at:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                return orig(*b)
+
+            guard.step = counting_step
+        try:
+            guard.install_signal_handlers()
+            guard.resume()
+            model.fit(data, batch_size=4, epochs=epochs, shuffle=False,
+                      verbose=0, guard=guard, prefetch=True)
+        finally:
+            guard.close()
+        return model._train_step.state_dict()
+
+    def test_sigterm_mid_prefetch_resume_bit_identical(self, tmp_path):
+        """The preemption lands while the feeder has batches staged on
+        device beyond the cursor. Those in-flight batches must be DROPPED
+        (cursor counts consumed only) and re-produced by the resumed run:
+        final params bit-identical to the uninterrupted prefetch run."""
+        final_a = self._fit_once(None)
+        with pytest.raises(PreemptedError):
+            self._fit_once(str(tmp_path / "g"), preempt_at=4)
+        final_b = self._fit_once(str(tmp_path / "g"))
+        for n in final_a["params"]:
+            assert np.array_equal(final_a["params"][n],
+                                  final_b["params"][n]), f"param {n} differs"
+        assert np.array_equal(final_a["rng_key"], final_b["rng_key"])
+        assert final_a["step_count"] == final_b["step_count"]
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer update
+# ---------------------------------------------------------------------------
+
+class TestFusedOptimizer:
+    def test_single_dispatch_and_donated_buffers(self, with_monitor):
+        """The eager step is ONE dispatched executable: zero run_op
+        dispatches during step(), one fused dispatch counted — and the old
+        param/slot/t buffers are donated (deleted), i.e. reused in place
+        instead of re-allocated per step."""
+        paddle.seed(0)
+        net = TwoLayer()
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=0.01)
+        x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+        for i in range(3):
+            loss = _mse(net(x), y)
+            loss.backward()
+            old_w = net.fc1.weight._value
+            old_slot = None
+            if i > 0:
+                old_slot = opt._accumulators[id(net.fc1.weight)]["moment1"]
+                old_t = opt._t_arr
+            before_ops = monitor.counter("dispatch.op_count").get()
+            before_fused = monitor.counter("optimizer.fused_dispatches").get()
+            opt.step()
+            assert monitor.counter("dispatch.op_count").get() == before_ops, \
+                "optimizer.step dispatched per-op work"
+            assert monitor.counter("optimizer.fused_dispatches").get() == \
+                before_fused + 1
+            assert old_w.is_deleted(), "param buffer not donated"
+            if old_slot is not None:
+                assert old_slot.is_deleted(), "slot buffer not donated"
+                assert old_t.is_deleted(), "t carry not donated"
+            opt.clear_grad()
+        assert len(opt._fused_cache) == 1  # one executable, reused
+
+    def test_fused_matches_unfused_reference_adam_clip_scaler(self):
+        """Per-param reference math (unscale -> global-norm clip -> Adam)
+        in numpy vs the fused executable, including the found_inf=False
+        path through the scaler gate."""
+        rng = np.random.RandomState(3)
+        p0s = [rng.randn(5, 3).astype("float32"),
+               rng.randn(7).astype("float32")]
+        g0s = [rng.randn(5, 3).astype("float32") * 4.0,
+               rng.randn(7).astype("float32") * 4.0]
+        scale, lr, clipn = 8.0, 0.05, 1.0
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        params = [paddle.Parameter(p.copy()) for p in p0s]
+        for p, g in zip(params, g0s):
+            p.grad = paddle.to_tensor(g * scale)._value  # scaled grads
+        opt = paddle.optimizer.Adam(
+            learning_rate=lr, parameters=params,
+            grad_clip=nn.ClipGradByGlobalNorm(clipn))
+        scaler = paddle.amp.GradScaler(init_loss_scaling=scale)
+        scaler.step(opt)
+        scaler.update()
+
+        # ---- unfused reference ----
+        gs = [g.copy() for g in g0s]  # unscaled
+        gn = np.sqrt(sum(float((g.astype("float64") ** 2).sum())
+                         for g in gs))
+        factor = clipn / max(gn, clipn)
+        gs = [g * factor for g in gs]
+        for p0, g, p in zip(p0s, gs, params):
+            m = (1 - b1) * g
+            v = (1 - b2) * g * g
+            mhat = m / (1 - b1)
+            vhat = v / (1 - b2)
+            ref = p0 - lr * mhat / (np.sqrt(vhat) + eps)
+            np.testing.assert_allclose(p.numpy(), ref, rtol=2e-5, atol=1e-6)
+        assert opt._step_count == 1
+
+    def test_scaler_gate_skips_without_touching_state(self, with_monitor):
+        """found_inf gates params, slots AND the t carry inside the
+        program; the host learns about it only at update() — and the skip
+        is counted."""
+        p = paddle.Parameter(np.ones(3, "float32"))
+        opt = paddle.optimizer.Adam(learning_rate=0.5, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       decr_every_n_nan_or_inf=1)
+        # good step first: slots exist
+        p.grad = paddle.to_tensor(np.ones(3, "float32"))._value
+        scaler.step(opt)
+        scaler.update()
+        w_after_good = p.numpy().copy()
+        m_after_good = np.asarray(opt._accumulators[id(p)]["moment1"])
+        assert opt._step_count == 1
+        # bad step: inf grad
+        p.grad = paddle.to_tensor(np.array([np.inf, 1.0, 1.0], "float32"))._value
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(p.numpy(), w_after_good)
+        np.testing.assert_array_equal(
+            np.asarray(opt._accumulators[id(p)]["moment1"]), m_after_good)
+        assert opt._step_count == 1  # skipped step did not count
+        assert scaler.get_loss_scaling() == 2.0  # decr after 1 bad
+        assert monitor.counter("amp.skipped_steps").get() == 1
+        # next good step continues from the SAME t (bias correction t=2)
+        p.grad = paddle.to_tensor(np.ones(3, "float32"))._value
+        scaler.step(opt)
+        assert opt._resolve_pending() is None or True  # commit via update
+        scaler.update()
+        assert opt._step_count == 2
+
+    def test_lr_and_scale_are_cached_device_scalars(self):
+        """No fresh per-step host scalar feed: with a constant lr the SAME
+        device scalar object is reused across steps; the scale array only
+        changes when the scale value changes; t advances on device."""
+        p = paddle.Parameter(np.ones(4, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0,
+                                       incr_every_n_steps=2)
+        arrs = []
+        scale_arrs = []
+        for _ in range(4):
+            p.grad = paddle.to_tensor(np.ones(4, "float32"))._value
+            scaler.step(opt)  # auto-updates once every optimizer stepped
+            arrs.append(opt._lr_arr)
+            scale_arrs.append(scaler._scale_arr)
+        assert all(a is arrs[0] for a in arrs), "lr re-uploaded per step"
+        # scale grew once (after 2 good steps): exactly one new device array
+        assert scale_arrs[0] is scale_arrs[1]
+        assert scale_arrs[1] is not scale_arrs[2]
+        assert scale_arrs[2] is scale_arrs[3]
+        assert float(opt._t_arr) == 5.0  # carried on device: next t
+        assert opt._step_count == 4
+
+    def test_scheduler_change_refreshes_lr_scalar_once(self):
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                              gamma=0.1)
+        p = paddle.Parameter(np.ones(2, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+        seen = []
+        for i in range(4):
+            p.grad = paddle.to_tensor(np.ones(2, "float32"))._value
+            opt.step()
+            seen.append(opt._lr_arr)
+        assert seen[0] is seen[1] is seen[2] is seen[3]
+        sched.step()
+        sched.step()  # lr drops 0.1 -> 0.01
+        p.grad = paddle.to_tensor(np.ones(2, "float32"))._value
+        opt.step()
+        assert opt._lr_arr is not seen[0]
+        assert abs(float(opt._lr_arr) - 0.01) < 1e-9
+
+    def test_unscale_clip_step_legacy_path_still_exact(self):
+        """The explicit unscale_ -> clip -> step pattern keeps its legacy
+        semantics (host-synced found_inf, no double unscale)."""
+        p = paddle.Parameter(np.ones(2, "float32"))
+        p.grad = paddle.to_tensor(np.array([8.0, 8.0], "float32"))._value
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       use_dynamic_loss_scaling=False)
+        scaler.unscale_(opt)
+        np.testing.assert_allclose(np.asarray(p.grad), [2.0, 2.0])
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), [-1.0, -1.0])
+
+    def test_steady_state_zero_retraces_with_prefetch_and_fused(
+            self, with_monitor):
+        """Tier-1 acceptance (b): a prefetch-fed TrainStep epoch with the
+        fused optimizer performs exactly ONE trace and ZERO retraces, and
+        the eager fused cache holds one executable."""
+        batches = _batches(8)
+        step = _make_step()
+        for x, y in DevicePrefetcher(batches, depth=2):
+            step(x, y)
+        snap = monitor.snapshot()["counters"]
+        assert snap.get("jit.train_step.traces", 0) == 1
+        assert snap.get("jit.train_step.retraces", 0) == 0
+        assert snap.get("jit.retraces", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# bucketed backward-interleaved reduction
+# ---------------------------------------------------------------------------
+
+class TestBucketedReducer:
+    def test_bucket_layout_backward_order_and_cap(self):
+        from paddle_tpu.parallel import Reducer
+
+        class P:
+            def __init__(self, shape, dtype="float32"):
+                self.shape, self.dtype = shape, dtype
+
+        params = [P((100,)), P((100,)), P((100,)), P((100,))]
+        r = Reducer(params, bucket_bytes=2 * 100 * 4)
+        layout = r.bucket_layout()
+        # reverse (backward-production) order, two per 800-byte bucket
+        assert layout == [[3, 2], [1, 0]]
+        assert r.bucket_sizes() == [800, 800]
+
+    def test_buckets_never_mix_dtypes(self):
+        from paddle_tpu.parallel import Reducer
+
+        class P:
+            def __init__(self, shape, dtype):
+                self.shape, self.dtype = shape, dtype
+
+        params = [P((8,), "float32"), P((8,), "bfloat16"),
+                  P((8,), "bfloat16")]
+        r = Reducer(params, bucket_bytes=1 << 20)
+        assert r.bucket_layout() == [[2, 1], [0]]
+
+    def _spmd_pair(self, grad_reduction, bucket_bytes=None):
+        from paddle_tpu.parallel import SPMDTrainStep, create_mesh
+        paddle.seed(0)
+        mesh = create_mesh({"dp": 2})
+        net = TwoLayer()
+        opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                     learning_rate=0.01)
+        return SPMDTrainStep(net, _mse, opt, mesh=mesh,
+                             grad_reduction=grad_reduction,
+                             bucket_bytes=bucket_bytes)
+
+    def test_per_bucket_collectives_in_backward_order(self):
+        """Acceptance: the 2-device signature shows one psum PER BUCKET,
+        first bucket = LAST parameters (backward production order), not a
+        single end-of-step reduction."""
+        x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+        # tiny cap -> every param its own bucket (4 tensors: 2 weights+2 biases)
+        step = self._spmd_pair("bucketed", bucket_bytes=1)
+        sig = step.collective_signature(x, y)
+        psums = [c for c in sig if c.op == "psum"]
+        n_params = len(step._pnames)
+        # one per bucket + the loss pmean
+        assert len(psums) == n_params + 1, [c.op for c in sig]
+        layout = step.reducer.bucket_layout()
+        assert layout == [[i] for i in reversed(range(n_params))]
+        # first collective carries the LAST parameter's elements
+        first_psum_elems = int(np.prod(psums[0].shape)) if psums[0].shape else 1
+        expected = int(np.prod(
+            [int(s) for s in step.reducer._shapes[layout[0][0]]] or [1]))
+        assert first_psum_elems == expected
+
+        # gspmd mode: the reduction is compiler-inserted — no explicit
+        # collectives in the static signature
+        step_g = self._spmd_pair("gspmd")
+        assert step_g.collective_signature(x, y) == []
+
+    def test_bucketed_matches_single_device_math(self):
+        xs = np.random.RandomState(7).rand(4, 8).astype("float32")
+        ys = np.random.RandomState(8).rand(4, 4).astype("float32")
+        paddle.seed(0)
+        net1 = TwoLayer()
+        opt1 = paddle.optimizer.AdamW(parameters=net1.parameters(),
+                                      learning_rate=0.01)
+        step1 = TrainStep(net1, _mse, opt1, n_model_inputs=1)
+        step2 = self._spmd_pair("bucketed", bucket_bytes=64)
+        for _ in range(3):
+            l1 = float(step1(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+            l2 = float(step2(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+            assert abs(l1 - l2) < 1e-5
+        w1 = _final_params(step1)
+        from paddle_tpu.jit.functional import split_state
+        trainable, _ = split_state(step2.model)
+        for n in w1:
+            np.testing.assert_allclose(
+                w1[n], np.asarray(trainable[n]._value), rtol=1e-5, atol=1e-6)
+
+    def test_bucketed_rejects_hybrid_layouts(self):
+        from paddle_tpu.parallel import SPMDTrainStep, create_mesh
+        x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+        paddle.seed(0)
+        mesh = create_mesh({"dp": 2, "mp": 2})
+        net = TwoLayer()
+        opt = paddle.optimizer.AdamW(parameters=net.parameters())
+        step = SPMDTrainStep(net, _mse, opt, mesh=mesh,
+                             grad_reduction="bucketed")
+        with pytest.raises(ValueError, match="pure-DP"):
+            step(x, y)
+        with pytest.raises(ValueError, match="gspmd.*bucketed|bucketed"):
+            SPMDTrainStep(net, _mse, opt, mesh=mesh,
+                          grad_reduction="wrong")
+
+    def test_spmd_t_carry_and_lr_cache(self):
+        """SPMD per-step scalars: lr device scalar reused, t carried by
+        the program (and refreshed after an external step_count write)."""
+        x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+        step = self._spmd_pair("gspmd")
+        step(x, y)
+        lr1 = step._lr_arr
+        step(x, y)
+        assert step._lr_arr is lr1
+        assert float(step._t_arr) == 3.0
+        assert step.optimizer._step_count == 2
+        # external rewind (guard rollback): carry refreshes from host
+        sd = step.state_dict()
+        step(x, y)
+        step.set_state_dict(sd)
+        step(x, y)
+        assert float(step._t_arr) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# obs booking: hidden prefetch time never lands in a step window
+# ---------------------------------------------------------------------------
+
+class TestObsBooking:
+    def test_prefetch_h2d_booked_between_not_in_step(self, with_timeline):
+        batches = _batches(6)
+        step = _make_step()
+        for x, y in DevicePrefetcher(batches, depth=2):
+            step(x, y)
+        recs = obs.timeline().records()
+        assert recs
+        for r in recs:
+            assert "prefetch_h2d" not in r["phases"], \
+                "hidden feeder time charged against a step window"
+        total_hidden = sum(r.get("between", {}).get("prefetch_h2d", 0.0)
+                           for r in recs)
+        pending = obs.timeline()._pending.get("prefetch_h2d", 0.0)
+        assert total_hidden + pending > 0.0, \
+            "feeder h2d not booked anywhere"
+        # in-step h2d collapses: prefetched Tensors need no conversion
+        steady = [r for r in recs if "trace_compile" not in r["phases"]
+                  and "build" not in r["phases"]]
+        for r in steady:
+            assert r["phases"].get("h2d", 0.0) < 0.005
+
+    def test_add_async_phase_respects_open_record(self, with_timeline):
+        tl = obs.timeline()
+        with tl.step_record():
+            tl.add_async_phase("prefetch_h2d", 0.5)
+            tl.add_phase("h2d", 0.125)
+        rec = tl.last()
+        assert "prefetch_h2d" not in rec["phases"]
+        assert rec["phases"]["h2d"] == 0.125
+        with tl.step_record():
+            pass
+        assert tl.last()["between"].get("prefetch_h2d") == 0.5
+
+
+# ---------------------------------------------------------------------------
+# fused-update lint rule
+# ---------------------------------------------------------------------------
+
+class TestFusedUpdateLint:
+    def test_flags_eager_per_param_loop(self):
+        from paddle_tpu import analysis
+        src = (
+            "class Opt:\n"
+            "    def step(self):\n"
+            "        for p, g in zip(self.params, self.grads):\n"
+            "            p.value = p.value - self.lr * g\n")
+        fs = analysis.lint_source(src, all_functions=True)
+        assert [f.rule for f in fs] == ["fused-update"]
+
+    def test_flags_per_param_apply_calls(self):
+        from paddle_tpu import analysis
+        src = (
+            "def update_all(params, grads):\n"
+            "    for p, g in zip(params, grads):\n"
+            "        p.value = jnp.subtract(p.value, g)\n")
+        fs = analysis.lint_source(src, all_functions=True)
+        assert any(f.rule == "fused-update" for f in fs)
+
+    def test_commit_loop_and_traced_loops_exempt(self):
+        from paddle_tpu import analysis
+        src = (
+            "class Opt:\n"
+            "    def step(self):\n"
+            "        new_vals = fn(self.params, self.grads)\n"
+            "        for p, v in zip(self.params, new_vals):\n"
+            "            p.value = v\n")
+        fs = analysis.lint_source(src, all_functions=True)
+        assert not [f for f in fs if f.rule == "fused-update"]
+        # trace-destined regions unroll: exempt even with array math
+        src2 = (
+            "def forward(self, params, grads):\n"
+            "    for p, g in zip(params, grads):\n"
+            "        out = jnp.add(p, g)\n"
+            "    return out\n")
+        fs2 = analysis.lint_source(src2, all_functions=True)
+        assert not [f for f in fs2 if f.rule == "fused-update"]
+
+    def test_suppression_works(self):
+        from paddle_tpu import analysis
+        src = (
+            "class Opt:\n"
+            "    def step(self):\n"
+            "        for p, g in zip(self.params, self.grads):  "
+            "# tpu-lint: disable=fused-update\n"
+            "            p.value = p.value - self.lr * g\n")
+        fs = analysis.lint_source(src, all_functions=True)
+        assert fs == []
+
+    def test_new_hotpath_modules_self_lint_clean(self):
+        """Satellite: io/prefetch.py and parallel/reducer.py stay clean
+        under the full --all rule set (same gate as models/nn/ops)."""
+        from paddle_tpu import analysis
+        pkg = os.path.dirname(os.path.dirname(
+            os.path.abspath(analysis.__file__)))  # .../paddle_tpu
+        findings, n = analysis.lint_paths(
+            [os.path.join(pkg, "io", "prefetch.py"),
+             os.path.join(pkg, "parallel", "reducer.py")],
+            all_functions=True)
+        assert n == 2
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_rule_registered(self):
+        from paddle_tpu.analysis.base import RULES
+        assert "fused-update" in RULES
+        assert RULES["fused-update"].severity == "info"
