@@ -80,6 +80,15 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     return _tt(data, dtype, place, stop_gradient)
 
 
+def sync():
+    """Explicit sync point for the lazy batching eager executor
+    (FLAGS_lazy_eager): flush the calling thread's pending segment so
+    every deferred op is dispatched and its outputs are materialized.
+    A no-op when nothing is pending (including lazy mode off)."""
+    from .ops import lazy as _lazy
+    _lazy.flush_pending()
+
+
 def disable_static(place=None):
     return None  # dynamic mode is the default and only eager mode
 

@@ -68,6 +68,12 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "executable per parameter — fuse it into one jitted tree-level "
          "update (optimizer.Optimizer._make_fused_update pattern); loops "
          "inside traced regions unroll into one executable and are exempt"),
+    Rule("lazy-sync", Severity.INFO,
+         "advisory: a host-sync call (.numpy()/.item()/.tolist()/float()/"
+         "int()/bool()) inside a loop body — under FLAGS_lazy_eager every "
+         "iteration flushes the pending lazy segment, re-serializing "
+         "dispatch the executor was batching; hoist the sync out of the "
+         "hot loop (or accumulate on device and sync once after it)"),
     # -- graph rules (analysis/graph.py, jaxpr/Program level) --
     Rule("dead-op", Severity.WARNING,
          "op whose results are never used by any program output — wasted "

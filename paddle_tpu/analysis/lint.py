@@ -213,20 +213,33 @@ class _RegionLinter(ast.NodeVisitor):
         self.taint = _Taint(tainted)
         self.full = full            # taint-based rules enabled
         self.findings: List[Finding] = []
+        self._loop_depth = 0        # For/While bodies (lazy-sync advisory)
 
     def _add(self, rule: str, node, message: str):
         self.findings.append(Finding(
             rule, message, path=self.path, line=getattr(node, "lineno", 0),
             col=getattr(node, "col_offset", 0), func=self.func))
 
+    def _add_sync(self, node, message: str):
+        """host-sync finding + lazy-sync advisory when it sits in a loop
+        body: under FLAGS_lazy_eager (ops/lazy.py) each such call flushes
+        the pending segment, so a per-iteration sync re-serializes the
+        dispatch stream the lazy executor was batching."""
+        self._add("host-sync", node, message)
+        if self._loop_depth:
+            self._add("lazy-sync", node,
+                      "sync point inside a loop body flushes the lazy "
+                      "segment every iteration (FLAGS_lazy_eager) — hoist "
+                      "it out of the hot loop")
+
     # -- calls: host syncs, RNG, print --
     def visit_Call(self, node):
         chain = _dotted(node.func)
         if chain and chain[-1] in _HOST_SYNC_METHODS \
                 and isinstance(node.func, ast.Attribute):
-            self._add("host-sync", node,
-                      f".{chain[-1]}() forces a device->host sync in a "
-                      "traced region")
+            self._add_sync(node,
+                           f".{chain[-1]}() forces a device->host sync in "
+                           "a traced region")
         elif _is_stdlib_random(chain):
             self._add("stdlib-random", node,
                       f"{'.'.join(chain)}() is host RNG: its value is "
@@ -239,16 +252,16 @@ class _RegionLinter(ast.NodeVisitor):
                 and chain[0] in _HOST_SYNC_BUILTINS and node.args:
             at, _ = self.taint.of(node.args[0])
             if at:
-                self._add("host-sync", node,
-                          f"{chain[0]}(tensor) concretizes a traced value "
-                          "(device->host sync)")
+                self._add_sync(node,
+                               f"{chain[0]}(tensor) concretizes a traced "
+                               "value (device->host sync)")
         elif self.full and len(chain) == 2 and chain[0] in ("np", "numpy") \
                 and chain[1] in ("asarray", "array") and node.args:
             at, _ = self.taint.of(node.args[0])
             if at:
-                self._add("host-sync", node,
-                          f"{'.'.join(chain)}(tensor) pulls a traced value "
-                          "to the host")
+                self._add_sync(node,
+                               f"{'.'.join(chain)}(tensor) pulls a traced "
+                               "value to the host")
         self.generic_visit(node)
 
     # -- control flow on tensors / shapes --
@@ -278,7 +291,14 @@ class _RegionLinter(ast.NodeVisitor):
                       "eager step/update function — each iteration "
                       "dispatches its own executable; fuse into one jitted "
                       "tree-level update (donated, single dispatch)")
-        self.generic_visit(node)
+        # the iterable is evaluated once, at loop entry — only the body
+        # (and else-clause) re-runs per iteration
+        self.visit(node.target)
+        self.visit(node.iter)
+        self._loop_depth += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self._loop_depth -= 1
 
     @staticmethod
     def _iterates_params(iter_node) -> bool:
@@ -312,7 +332,10 @@ class _RegionLinter(ast.NodeVisitor):
 
     def visit_While(self, node):
         self._check_test(node, node.test, "while")
+        # the test re-evaluates every iteration: count it as loop body
+        self._loop_depth += 1
         self.generic_visit(node)
+        self._loop_depth -= 1
 
     def visit_Assert(self, node):
         self._check_test(node, node.test, "assert")

@@ -37,6 +37,17 @@ class _State(threading.local):
 
 _STATE = _State()
 
+# set by paddle_tpu.ops.lazy at import: backward()/paddle.grad are sync
+# points for the lazy batching eager executor — the pending segment must
+# flush (materializing outputs and patching _PendingVJP -> _JitVJP on the
+# tape) before the walk starts
+_LAZY = None
+
+
+def _lazy_flush():
+    if _LAZY is not None and _LAZY._ACTIVE:
+        _LAZY.flush_pending()
+
 
 class Node:
     """One traced op: inputs, outputs, and the VJP closure linking them.
@@ -144,9 +155,16 @@ def _freeze(v):
     if isinstance(v, functools.partial):
         return ("p", _freeze(v.func), _freeze(v.args), _freeze(v.keywords))
     if callable(v):
-        qn = getattr(v, "__qualname__", "<locals>")
-        if "<locals>" not in qn and getattr(v, "__module__", None):
+        qn = getattr(v, "__qualname__", None)
+        if qn is not None and "<locals>" not in qn \
+                and getattr(v, "__module__", None):
             return ("f", v.__module__, qn)  # stable module-level callable
+        if qn is None and type(v).__name__ == "ufunc" \
+                and getattr(v, "__name__", None):
+            # jnp.add/multiply/... are jax.numpy.ufunc instances: no
+            # __qualname__, but singleton, stateless and named — a stable
+            # token (this makes binary_op(jnp.<ufunc>) dispatch-cacheable)
+            return ("uf", getattr(type(v), "__module__", "jnp"), v.__name__)
     raise _Uncacheable
 
 
@@ -487,6 +505,7 @@ def backward(root, grad=None, retain_graph: bool = False):
 
 
 def _backward_impl(root, grad=None, retain_graph: bool = False):
+    _lazy_flush()
     if root._node is None:
         if not root.stop_gradient:
             g = jnp.ones_like(root._value) if grad is None else grad
@@ -578,6 +597,7 @@ def grad_fn(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph
     itself is RECORDED on the tape (each node's VJP replayed through its
     saved primal fn via jax.vjp — rematerialized), so the returned grads are
     differentiable again (double/higher-order grad)."""
+    _lazy_flush()
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     ordered = _collect([o._node for o in outs])

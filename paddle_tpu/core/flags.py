@@ -75,6 +75,17 @@ define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (unused on TPU; XLA own
 define_flag("allocator_strategy", "auto_growth", "host allocator strategy name")
 define_flag("tpu_matmul_precision", "default", "default|high|highest - lax precision for matmul/conv")
 define_flag("tpu_eager_jit", True, "jit-cache eager primitive ops instead of op-by-op dispatch")
+define_flag("lazy_eager", False,
+            "lazy batching eager executor (ops/lazy.py): run_op defers ops "
+            "into a per-thread segment and flushes them as ONE jitted "
+            "executable at sync points (.numpy()/.item()/float()/bool()/"
+            "print, tensor control flow, backward(), paddle.sync()) — "
+            "O(1) dispatches per steady-state eager step instead of O(ops); "
+            "off = the dispatch fast path pays one module-attribute check")
+define_flag("lazy_max_segment_ops", 2048,
+            "lazy eager: flush automatically once a segment accumulates "
+            "this many deferred ops (bounds trace size and host memory for "
+            "sync-free loops)")
 define_flag("enable_unused_var_check", False, "unused-var detection parity flag")
 define_flag("monitor", False,
             "enable the paddle_tpu.monitor stats registry + trace spans "

@@ -25,6 +25,11 @@ from .place import get_place, CPUPlace
 
 _ops = None  # set by paddle_tpu.ops at import time (monkey_patch_varbase parity)
 
+# payload types accepted verbatim (no jnp.asarray); ops.lazy extends this
+# with its pending _LazyValue at import — the FLAGS_lazy_eager deferred
+# payload rides the same isinstance check the eager path already pays
+_VALUE_TYPES = (jax.Array, jax.core.Tracer)
+
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_node", "name", "persistable",
@@ -33,7 +38,7 @@ class Tensor:
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
             value = value._value
-        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+        elif not isinstance(value, _VALUE_TYPES):
             value = jnp.asarray(value)
         self._value = value
         self.stop_gradient = stop_gradient
@@ -128,7 +133,9 @@ class Tensor:
     clear_gradient = clear_grad
 
     def zero_(self):
-        self._value = jnp.zeros_like(self._value)
+        # jnp.asarray resolves a pending lazy payload (FLAGS_lazy_eager)
+        # before zeros_like reads its dtype; concrete arrays pass through
+        self._value = jnp.zeros_like(jnp.asarray(self._value))
         return self
 
     def register_hook(self, hook):

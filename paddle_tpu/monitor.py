@@ -21,6 +21,13 @@ registry of counters, gauges and histograms that every layer reports into:
     `guard.desync_checks`/`guard.desync_errors` counters — every recovery
     the supervisor performs is visible next to the fault that provoked it;
     `amp.skipped_steps`/`amp.scale_updates` from the GradScaler
+  - lazy eager executor (`ops/lazy.py`, behind `FLAGS_lazy_eager`):
+    `lazy.ops_deferred` (ops captured into the per-thread segment) /
+    `lazy.flushes` (segments materialized) / `lazy.dispatches` (jitted
+    replay calls — the number that replaces per-op dispatch count) /
+    `lazy.ops_flushed` / `lazy.cache_hits` (segment executable reused) /
+    `lazy.fallback_ops` (ops that bypassed deferral); segment compiles
+    land in the retrace plane as `jit.lazy_segment.traces`/`.retraces`
   - static analysis (`analysis/` tpu-lint, behind `FLAGS_lint`):
     `lint.findings` (trace hazards found at trace time) / `lint.files`
     (distinct source files linted) — a nonzero findings counter in a

@@ -233,7 +233,7 @@ class Layer:
             if k not in own:
                 unexpected.append(k)
                 continue
-            arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)  # tpu-lint: disable=host-sync (host-side state load)
+            arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)  # tpu-lint: disable=host-sync,lazy-sync (host-side state load, not a hot loop)
             tgt = own[k]
             if tuple(arr.shape) != tuple(tgt.shape):
                 raise ValueError(f"shape mismatch for {k}: {arr.shape} vs {tuple(tgt.shape)}")
@@ -284,6 +284,12 @@ class Layer:
         if self.__dict__.get("_autojit_off") or kwargs:
             return None
         if not _flags.flag("eager_auto_jit"):
+            return None
+        from ...ops import lazy as _lazy
+        if _lazy._ACTIVE:
+            # the lazy batching executor already collapses the step into
+            # O(1) dispatches; capturing on top would fight its segment
+            # accounting (and bake pending payloads into a static program)
             return None
         if _LAYER_CALL_DEPTH.depth or not inputs \
                 or not all(isinstance(a, _T) for a in inputs):

@@ -32,6 +32,11 @@ Phase vocabulary used by the instrumented call sites:
   trace_compile   first dispatch of a novel batch signature (jax trace +
                   XLA compile + run)
   device_compute  steady-state dispatch, fenced by block_until_ready
+                  (lazy eager segment flushes — `ops/lazy.py` under
+                  FLAGS_lazy_eager — book here too: a novel segment
+                  signature lands in trace_compile, a cached replay in
+                  device_compute, so deferred work is attributed at the
+                  flush instead of smeared over the deferring ops)
   collective      eager collective API calls (parallel/collective.py)
   optimizer       eager Optimizer.step (jitted paths fuse it into
                   device_compute)

@@ -21,6 +21,7 @@ from .. import monitor as _monitor
 from ..core import autograd
 from ..core import flags as _flags
 from ..core.tensor import Tensor
+from . import lazy as _lazy
 
 __all__ = ["run_op", "unary_op", "binary_op", "to_arr", "ensure_tensor", "inplace_from"]
 
@@ -62,8 +63,15 @@ def run_op(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):
 
     Instrumentation: with neither a profiler hook nor FLAGS_monitor active,
     the fast path below is two attribute checks and a tail call — no timer,
-    no try frame, no hook installation.
+    no try frame, no hook installation. FLAGS_lazy_eager adds exactly one
+    more module-attribute check; when active, the op is DEFERRED into the
+    per-thread segment (ops/lazy.py) unless it must fall back to immediate
+    dispatch (tracer inputs, unkeyable closure, untraceable shapes).
     """
+    if _lazy._ACTIVE:
+        r = _lazy.defer_op(fn, tensors, name)
+        if r is not _lazy._FALLBACK:
+            return r
     if _PROFILE_HOOK is None and not _monitor._ENABLED:
         return _run_op_impl(fn, tensors, name)
     _t0 = _time.time()
@@ -95,6 +103,10 @@ def _run_op_impl(fn: Callable, tensors: Sequence[Tensor], name: str = "op"):
 
 def nondiff_op(fn: Callable, tensors: Sequence[Tensor]):
     """Run with no tape recording (integer/boolean outputs)."""
+    if _lazy._ACTIVE:
+        r = _lazy.defer_nondiff(fn, tensors)
+        if r is not _lazy._FALLBACK:
+            return r
     arrs = tuple(t._value for t in tensors)
     outs = fn(*arrs)
     if isinstance(outs, tuple):
@@ -145,6 +157,10 @@ def inplace_from(x: Tensor, result: Tensor) -> Tensor:
             old._node.outputs = [old if o is x else o for o in old._node.outputs]
         node.inputs = [old if t is x else t for t in node.inputs]
     x._value = result._value
+    if type(x._value) is _lazy._LazyValue:
+        # deferred result (FLAGS_lazy_eager): register the alias so the
+        # flush rebinds x to the concrete array too
+        x._value._ts.append(x)
     if node is not None:
         node.outputs = [x if o is result else o for o in node.outputs]
         x._node = node

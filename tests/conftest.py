@@ -97,3 +97,29 @@ def _no_fault_leak():
     assert active_after == active_before, (
         f"fault specs leaked out of the test: {active_after} "
         f"(was {active_before})")
+
+
+@pytest.fixture(autouse=True)
+def _no_lazy_leak():
+    """A pending lazy segment (FLAGS_lazy_eager, ops/lazy.py) leaking out
+    of a test would materialize inside some unrelated later test — or
+    worse, leave the flag on so every later test runs deferred. Assert the
+    calling thread's segment is drained and the flag is back to its
+    pre-test state after EVERY test (and drain/restore, so one offender
+    cannot cascade)."""
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.ops import lazy as _lazy
+    flag_before = _flags.flag("lazy_eager")
+    yield
+    flag_after = _flags.flag("lazy_eager")
+    pending = _lazy.pending_ops()
+    if pending:
+        _lazy.flush_pending()
+    if flag_after != flag_before:
+        _flags.set_flags({"lazy_eager": flag_before})
+    assert flag_after == flag_before, (
+        f"FLAGS_lazy_eager leaked out of the test: {flag_after!r} "
+        f"(was {flag_before!r})")
+    assert pending == 0, (
+        f"{pending} deferred op(s) leaked out of the test "
+        "(paddle.sync() / flush_pending() not reached?)")

@@ -159,6 +159,42 @@ def forward(self, x):
 """
         assert lint_source(src, "f.py") == []
 
+    def test_lazy_sync_advisory_in_loop(self):
+        """lazy-sync (ISSUE 9): a host sync inside a loop body gets the
+        extra INFO advisory — each iteration would flush the lazy segment."""
+        src = """
+def forward(self, x):
+    total = 0.0
+    for i in range(10):
+        total += x.item()
+    return total
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["host-sync", "lazy-sync"]
+
+    def test_lazy_sync_not_fired_outside_loop(self):
+        src = """
+def forward(self, x):
+    return x.numpy()
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["host-sync"]
+
+    def test_lazy_sync_loop_header_exempt_while_test_counted(self):
+        """The For iterable is evaluated once (no advisory); a While test
+        re-runs every iteration (advisory)."""
+        src = """
+def forward(self, x):
+    for i in range(int(x.item())):
+        pass
+    while x.item() > 0:
+        x = x - 1
+    return x
+"""
+        fs = lint_source(src, "f.py")
+        by_rule = {}
+        for f in fs:
+            by_rule.setdefault(f.rule, []).append(f.line)
+        assert by_rule["lazy-sync"] == [5]
+
     def test_default_mode_scans_only_trace_destined(self):
         src = """
 def helper(x):
