@@ -83,6 +83,36 @@ def _timeline_breakdown(step, batch_tensors, n_steps):
     return phases_ms, round(wall_ms, 3), round(coverage, 3), cost
 
 
+def _memory_breakdown(step, batch_tensors):
+    """HBM attribution for the workload (obs/memory.py): run one tagged
+    step with FLAGS_mem_census on, then report peak live bytes, the
+    census' per-tag shares, and the step executable's compiler-reported
+    argument/output/temp breakdown."""
+    import paddle_tpu as paddle
+    from paddle_tpu.obs import memory as _memory
+
+    paddle.set_flags({"FLAGS_mem_census": True})
+    try:
+        _sync(step(*batch_tensors)._value)   # one step with tagging live
+        rec = _memory.census(publish=False, store=False)
+        total = int(rec.get("total_bytes", 0))
+        shares = {name: round(b["bytes"] / total, 4)
+                  for name, b in sorted(rec.get("tags", {}).items())} \
+            if total else {}
+        try:
+            report = step.memory_report(*batch_tensors)
+        except Exception:
+            report = {}
+        peaks = _memory.phase_peaks()
+        return {"live_bytes": total,
+                "peak_bytes": max([total] + list(peaks.values())),
+                "tag_shares": shares,
+                "executables": {"train_step": report}}
+    finally:
+        paddle.set_flags({"FLAGS_mem_census": False})
+        _memory.reset()
+
+
 def _overlap_ab(step, batch_np, n_steps, depth=2):
     """Prefetch on/off A/B on the per-step path: same host batches, same
     step executable — measure samples/s and the per-phase time both ways.
@@ -212,6 +242,10 @@ def bench_ernie_train(backend):
     overlap = _overlap_ab(step, (ids_np, ids_np, nsp_np),
                           20 if backend == "tpu" else 3)
 
+    # HBM attribution: who owns the live bytes (params/slots/activations/
+    # ...), plus XLA's argument/output/temp breakdown for the step
+    memory = _memory_breakdown(step, (ids0, ids0, nsp0))
+
     # train matmul FLOPs/sample ~= 6*N_matmul*S + 3*L*4*S^2*H (PaLM-style)
     # + the weight-tied MLM head (6*S*H*V: its [V,H] weight is the embedding
     # table, excluded from n_matmul, but its 3 matmuls are ~25% of the work)
@@ -234,6 +268,7 @@ def bench_ernie_train(backend):
             "timeline_ms": tl_ms, "timeline_wall_ms": tl_wall_ms,
             "timeline_phase_coverage": tl_cov,
             "overlap": overlap,
+            "memory": memory,
             "batch": batch, "seqlen": seqlen,
             "attention": "XLA fused (measured r5: forcing the Pallas flash "
                          "kernel into this s128 training path loses 14% — "
@@ -787,6 +822,8 @@ def main():
     ernie = _run_workload("ernie_train", bench_ernie_train, backend, extra)
     if isinstance(ernie, dict) and "overlap" in ernie:
         extra["overlap"] = ernie.pop("overlap")
+    if isinstance(ernie, dict) and "memory" in ernie:
+        extra["memory"] = ernie.pop("memory")
     flash = _run_workload("flash_attention", bench_flash_attention, backend,
                           extra)
     for key, fn in (("resnet50_infer", bench_resnet50_infer),

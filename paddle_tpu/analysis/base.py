@@ -74,6 +74,12 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "iteration flushes the pending lazy segment, re-serializing "
          "dispatch the executor was batching; hoist the sync out of the "
          "hot loop (or accumulate on device and sync once after it)"),
+    Rule("buffer-retain", Severity.INFO,
+         "advisory: a self./cls. attribute assigned from a per-step tensor "
+         "inside a loop body — the held reference outlives the step, "
+         "defeats buffer donation, and pins device memory until the next "
+         "overwrite (the creeping 'other' bytes a mem census shows); keep "
+         "a host scalar (float(loss)) or np.asarray copy instead"),
     # -- graph rules (analysis/graph.py, jaxpr/Program level) --
     Rule("dead-op", Severity.WARNING,
          "op whose results are never used by any program output — wasted "

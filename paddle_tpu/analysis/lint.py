@@ -214,6 +214,10 @@ class _RegionLinter(ast.NodeVisitor):
         self.full = full            # taint-based rules enabled
         self.findings: List[Finding] = []
         self._loop_depth = 0        # For/While bodies (lazy-sync advisory)
+        # names carrying per-iteration values (loop targets + names
+        # assigned from them / from array-call results inside the body) —
+        # the buffer-retain advisory's lightweight --all-mode taint
+        self._loop_names: Set[str] = set()
 
     def _add(self, rule: str, node, message: str):
         self.findings.append(Finding(
@@ -295,10 +299,14 @@ class _RegionLinter(ast.NodeVisitor):
         # (and else-clause) re-runs per iteration
         self.visit(node.target)
         self.visit(node.iter)
+        added = {n.id for n in ast.walk(node.target)
+                 if isinstance(n, ast.Name)} - self._loop_names
+        self._loop_names |= added
         self._loop_depth += 1
         for stmt in node.body + node.orelse:
             self.visit(stmt)
         self._loop_depth -= 1
+        self._loop_names -= added
 
     @staticmethod
     def _iterates_params(iter_node) -> bool:
@@ -339,6 +347,74 @@ class _RegionLinter(ast.NodeVisitor):
 
     def visit_Assert(self, node):
         self._check_test(node, node.test, "assert")
+        self.generic_visit(node)
+
+    # -- buffer-retain advisory (self-attribute writes in loop bodies) --
+    @staticmethod
+    def _is_host_copy(value) -> bool:
+        """float(x) / x.item() / np.asarray(x)-style conversions — the
+        recommended buffer-retain FIX — produce host values, not buffers."""
+        if not isinstance(value, ast.Call):
+            return False
+        chain = _dotted(value.func)
+        if len(chain) == 1 and chain[0] in _HOST_SYNC_BUILTINS:
+            return True
+        if chain and chain[-1] in _HOST_SYNC_METHODS:
+            return True
+        return len(chain) == 2 and chain[0] in ("np", "numpy") \
+            and chain[1] in ("asarray", "array")
+
+    def _value_steplike(self, value) -> bool:
+        """--all-mode stand-in for taint: does the expression touch a
+        per-iteration value (a tracked loop name) or produce device work
+        (a call rooted in jnp/jax/lax/paddle/run_op)?"""
+        if self._is_host_copy(value):
+            return False
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                chain = _dotted(sub.func)
+                if chain and chain[0] in _ARRAY_CALL_ROOTS:
+                    return True
+            elif isinstance(sub, ast.Name) and sub.id in self._loop_names:
+                return True
+        return False
+
+    def _maybe_buffer_retain(self, node, targets, value):
+        if not self._loop_depth:
+            return
+        if self.full:
+            steplike, _ = self.taint.of(value)
+        else:
+            steplike = self._value_steplike(value)
+        if not steplike:
+            return
+        if not self.full:
+            # propagate through plain-name rebinds so `loss = step(b);
+            # self.last = loss` is caught, not just the direct form
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self._loop_names.add(t.id)
+        for t in targets:
+            if not isinstance(t, ast.Attribute):
+                continue
+            root = t
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ("self", "cls"):
+                self._add("buffer-retain", node,
+                          f"`{ast.unparse(t) if hasattr(ast, 'unparse') else t.attr}` "
+                          "assigned from a per-step tensor inside a loop — "
+                          "the held reference outlives the iteration, "
+                          "defeating buffer donation and pinning device "
+                          "memory (keep float(...)/np.asarray copies "
+                          "instead)")
+
+    def visit_Assign(self, node):
+        self._maybe_buffer_retain(node, node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._maybe_buffer_retain(node, [node.target], node.value)
         self.generic_visit(node)
 
 

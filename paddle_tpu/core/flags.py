@@ -227,3 +227,26 @@ define_flag("obs_dump_min_interval_s", 30.0,
             "obs: min seconds between AUTOMATIC dumps for the same reason "
             "(overload storms must not flood the disk); explicit "
             "dump(path=...) calls are never rate-limited")
+
+# ---- memory attribution plane (paddle_tpu.obs.memory) ----------------------
+define_flag("mem_census", False,
+            "HBM memory attribution (obs/memory.py): tag device buffers at "
+            "their creation seams (params/slots/activations/prefetch "
+            "staging/serving buckets/lazy segments) and let census() bucket "
+            "live bytes per tag per device, publishing mem.<tag>.bytes "
+            "gauges; off = every tag seam pays one module-attribute check")
+define_flag("mem_census_ring", 16,
+            "mem census: snapshots kept in the census ring (the flight "
+            "recorder embeds this ring in its dump)")
+define_flag("mem_top_k", 8,
+            "mem census: top-K largest live buffers (with tag + origin) "
+            "reported by top_buffers() and the OOM forensics dump")
+define_flag("mem_leak_window", 8,
+            "mem leak watch: a tag whose census bytes grow strictly for "
+            "this many consecutive censuses is flagged as a leak suspect "
+            "(warning + mem.leak_suspects counter); 0 disables the check")
+define_flag("lazy_cache_entries", 256,
+            "lazy eager: max cached segment replay executables "
+            "(ops/lazy.py _SEG_CACHE); least-recently-used entries are "
+            "evicted beyond the cap (lazy.cache_evictions counter) instead "
+            "of the cache growing without bound under shape churn")

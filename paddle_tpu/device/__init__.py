@@ -33,13 +33,25 @@ _reset_called: Dict[int, bool] = {}  # device id -> reset happened
 def _live_bytes(device_id: int) -> int:
     """Bytes actually resident on `device_id`: sums the per-device SHARD
     sizes, so sharded arrays count 1/n per device and replicated arrays
-    count their full size on every device."""
+    count their full size on every device. Shard sizes are derived from
+    each array's sharding — touching `a.addressable_shards` would
+    MATERIALIZE one child ArrayImpl per shard into `jax.live_arrays()`
+    and double every later walk (obs.memory dedups by buffer the same
+    way)."""
+    from ..obs import memory as _mem
     total = 0
+    seen = set()
     for a in jax.live_arrays():
         try:
-            for sh in a.addressable_shards:
-                if sh.device.id == device_id:
-                    total += sh.data.nbytes
+            if a.is_deleted():
+                continue
+            key = _mem._buffer_key(a)
+            if key in seen:
+                continue
+            seen.add(key)
+            nb, devs = _mem._per_device_bytes(a)
+            if device_id in devs:
+                total += nb
         except Exception:  # deleted/donated buffers race the walk
             continue
     return total

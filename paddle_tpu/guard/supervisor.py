@@ -42,6 +42,7 @@ import numpy as np
 from .. import faults as _faults
 from .. import monitor as _monitor
 from .. import obs as _obs
+from ..obs import memory as _mem
 from ..core import flags as _flags
 from ..core import random as _rnd
 from .checkpoint import has_guard_state, load_guard_state, save_guard_state
@@ -310,6 +311,11 @@ class TrainGuard:
             if self.scaler is not None:
                 snap["scaler"] = self.scaler.state_dict()
             self._snapshot = snap
+        if _mem._ENABLED:
+            # snapshot boundaries are the census cadence of a guarded run:
+            # the host copy just doubled transient footprint, and the ring
+            # of these records is what the leak watch differences
+            _mem.census()
         if _monitor._ENABLED:
             _monitor.count("guard.snapshots")
 

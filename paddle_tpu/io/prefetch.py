@@ -40,6 +40,7 @@ import jax
 
 from .. import monitor as _monitor
 from .. import obs as _obs
+from ..obs import memory as _mem
 from ..core import flags as _flags
 from ..core.tensor import Tensor
 
@@ -131,6 +132,9 @@ class _Session:
                 _t0 = _time.time()
                 staged = _device_put_batch(batch, self._shardings)
                 _t1 = _time.time()
+                if _mem._ENABLED:
+                    _mem.tag("prefetch_staging", staged,
+                             origin="DevicePrefetcher")
                 if _obs._TL_ENABLED:
                     # hidden time: ran under the previous step, so it books
                     # through add_async_phase (between bucket), never inside

@@ -20,8 +20,10 @@ import jax
 import jax.numpy as jnp
 
 from .. import analysis as _analysis
+from .. import faults as _faults
 from .. import monitor as _monitor
 from .. import obs as _obs
+from ..obs import memory as _mem
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from .functional import functional_call, split_state
@@ -154,6 +156,20 @@ class TrainStep:
                                   jnp.float32)
         self._lr_val = None
         self._lr_arr = None
+        if _mem._ENABLED:
+            self._tag_state()
+
+    def _tag_state(self):
+        """(Re-)tag the loop state for the live-buffer census. Called after
+        build AND after every commit: the jit call donates the old param /
+        slot / step-state buffers, so their tags die with them and the
+        replacement arrays must be claimed again."""
+        _mem.tag("params", [t._value for t in self._ptensors],
+                 origin="TrainStep")
+        _mem.tag("opt_slots", self._slots, origin="TrainStep")
+        _mem.tag("step_state", [self._key, self._t_arr], origin="TrainStep")
+        _mem.tag("model_buffers", [t._value for t in self._btensors],
+                 origin="TrainStep")
 
     def _prepare(self, batch):
         """Shared prep for __call__/run: param/buffer arrays, model-input vs
@@ -169,6 +185,8 @@ class TrainStep:
         with _obs.phase("h2d"):
             arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                     for b in batch]
+        if _mem._ENABLED:
+            _mem.tag("activations", arrs, origin="TrainStep.batch")
         n_mi = self._n_model_inputs
         if n_mi is None:
             n_mi = len(arrs) if len(arrs) <= 1 else len(arrs) - 1
@@ -202,9 +220,26 @@ class TrainStep:
                 _t0 = _time.time()
             _tl = _obs._TL_ENABLED
             with _obs.phase("trace_compile" if novel else "device_compute"):
-                new_params, self._slots, loss, self._key, self._t_arr, bad = \
-                    self._jitted(params, self._slots, buffers, self._key,
-                                 self._lr_arr, self._t_arr, inputs, labels)
+                try:
+                    if _faults._ENABLED:
+                        # OOM forensics drill site: the injected fault's
+                        # message matches memory._OOM_MARKERS, so the except
+                        # path below exercises the real RESOURCE_EXHAUSTED
+                        # dump machinery without needing to exhaust HBM
+                        _faults.check("mem.alloc")
+                    new_params, self._slots, loss, self._key, self._t_arr, \
+                        bad = self._jitted(params, self._slots, buffers,
+                                           self._key, self._lr_arr,
+                                           self._t_arr, inputs, labels)
+                except Exception as e:
+                    _mem.maybe_dump_oom(
+                        e, executable="TrainStep",
+                        report=lambda: _obs.executable_memory(
+                            self._jitted.lower(
+                                params, self._slots, buffers, self._key,
+                                self._lr_arr, self._t_arr, inputs,
+                                labels).compile()))
+                    raise
                 if _tl:
                     # fence: on an async backend the dispatch above returns
                     # before the chip finishes; without this the device time
@@ -217,6 +252,8 @@ class TrainStep:
             for tns, v in zip(self._ptensors, new_params):
                 tns._value = v
             self.optimizer._step_count += 1
+            if _mem._ENABLED:
+                self._tag_state()
             if _mon:
                 _monitor.count("jit.train_step.steps")
                 _monitor.observe("jit.train_step.dur", _time.time() - _t0)
@@ -235,6 +272,19 @@ class TrainStep:
                                      self._lr_arr, self._t_arr, inputs,
                                      labels)
         return _obs.executable_cost(lowered.compile())
+
+    def memory_report(self, *batch):
+        """XLA's own memory breakdown for THIS step executable at `batch`'s
+        signature: {"argument_bytes", "output_bytes", "temp_bytes",
+        "alias_bytes", "generated_code_bytes", "peak_bytes"} via AOT
+        lower().compile().memory_analysis() (obs/memory.py). temp_bytes is
+        the number OOM forensics cares about — the scratch HBM the step
+        needs ON TOP of the live buffers the census can see."""
+        params, buffers, inputs, labels, _ = self._prepare(batch)
+        lowered = self._jitted.lower(params, self._slots, buffers, self._key,
+                                     self._lr_arr, self._t_arr, inputs,
+                                     labels)
+        return _obs.executable_memory(lowered.compile())
 
     # ---- full loop-state capture (guard plane: preemption-safe resume) ----
     def named_param_arrays(self):
@@ -277,6 +327,8 @@ class TrainStep:
         self._t_arr = jnp.asarray(sd["t"], jnp.float32)
         self.optimizer._step_count = int(sd["step_count"])
         self._lr_val = None  # force the lr-array cache to refresh
+        if _mem._ENABLED:
+            self._tag_state()
 
     def run(self, *batch):
         """Device-side multi-step loop: every tensor in `batch` is stacked
@@ -294,6 +346,8 @@ class TrainStep:
         for tns, v in zip(self._ptensors, new_params):
             tns._value = v
         self.optimizer._step_count += n_steps
+        if _mem._ENABLED:
+            self._tag_state()
         if _monitor._ENABLED:
             _monitor.count("jit.train_step.steps", n_steps)
         raise_nonfinite(bads, self._pnames, "jitted train step")

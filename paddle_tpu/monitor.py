@@ -562,8 +562,48 @@ def _render_flight_dump(doc: Dict[str, Any]) -> str:
     if counters:
         lines.append(f"monitor counters: {len(counters)} "
                      f"(use `show` on a snapshot export for the full table)")
+    # schema /2 memory section (a /1 dump simply has none of these keys)
+    mem_lines = _render_dump_memory(doc)
+    if mem_lines:
+        lines.extend(mem_lines)
     lines.append("-" * 78)
     return "\n".join(lines)
+
+
+def _render_dump_memory(doc: Dict[str, Any]) -> List[str]:
+    """Render the schema-/2 memory section of a flight dump: last census,
+    phase peaks, and (OOM dumps) top buffers + per-executable temp bytes.
+    Returns [] for a /1 dump — `show` stays version-agnostic."""
+    from .obs import memory as _memory
+    lines: List[str] = []
+    memsec = doc.get("memory") or {}
+    oom = (doc.get("extra") or {}).get("memory") or {}
+    census = oom.get("census_at_dump") or \
+        (memsec.get("census") or [None])[-1]
+    if census:
+        tags = census.get("tags", {})
+        shares = ", ".join(
+            f"{n}={_memory._fmt_bytes(tags[n]['bytes'])}"
+            for n in sorted(tags, key=lambda n: -tags[n]["bytes"])[:6])
+        lines.append(
+            f"memory census ({len(memsec.get('census') or [])} in ring): "
+            f"total {_memory._fmt_bytes(census.get('total_bytes', 0))}"
+            + (f" [{shares}]" if shares else ""))
+    peaks = oom.get("phase_peaks") or memsec.get("phase_peaks") or {}
+    if peaks:
+        lines.append("phase HBM peaks: " + ", ".join(
+            f"{k}={_memory._fmt_bytes(v)}"
+            for k, v in sorted(peaks.items(), key=lambda kv: -kv[1])))
+    for row in (oom.get("top_buffers") or [])[:8]:
+        origin = f" ({row['origin']})" if row.get("origin") else ""
+        lines.append(f"  top buffer {_memory._fmt_bytes(row['bytes'])}  "
+                     f"{row.get('dtype')}{row.get('shape')}  "
+                     f"tag={row.get('tag')}{origin}")
+    for name, rep in (oom.get("executables") or {}).items():
+        if isinstance(rep, dict) and rep:
+            body = ", ".join(f"{k}={v}" for k, v in sorted(rep.items()))
+            lines.append(f"  executable {name}: {body}")
+    return lines
 
 
 def _diff_snapshots(a: Dict[str, Any], b: Dict[str, Any]) -> str:
@@ -622,6 +662,10 @@ def _main(argv=None) -> int:
     p_trace.add_argument("dump")
     p_trace.add_argument("-o", "--out", default=None,
                          help="output path (default: <dump>.trace.json)")
+    p_mem = sub.add_parser(
+        "mem", help="render a flight-recorder dump's memory census "
+                    "(no path: take a live census of this process)")
+    p_mem.add_argument("path", nargs="?", default=None)
     args = p.parse_args(argv)
     if args.cmd == "show":
         doc = _load_artifact(args.path)
@@ -648,6 +692,33 @@ def _main(argv=None) -> int:
         with open(out, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
         print(out)
+        return 0
+    if args.cmd == "mem":
+        from .obs import memory as _memory
+        if args.path is None:
+            print(_memory.render_census(
+                _memory.census(publish=False, store=False),
+                top=_memory.top_buffers()))
+            return 0
+        doc = _load_artifact(args.path)
+        if not _is_flight_dump(doc):
+            print(f"error: {args.path} is not a flight-recorder dump "
+                  f"(schema: {doc.get('schema')!r})")
+            return 2
+        oom = (doc.get("extra") or {}).get("memory") or {}
+        memsec = doc.get("memory") or {}
+        census = oom.get("census_at_dump") or \
+            (memsec.get("census") or [None])[-1]
+        if not census:
+            print(f"no memory census in dump "
+                  f"(schema: {doc.get('schema')!r} — /1 dumps predate the "
+                  "memory section, or FLAGS_mem_census was off)")
+            return 0
+        print(_memory.render_census(census, top=oom.get("top_buffers")))
+        for name, rep in (oom.get("executables") or {}).items():
+            if isinstance(rep, dict) and rep:
+                body = ", ".join(f"{k}={v}" for k, v in sorted(rep.items()))
+                print(f"executable {name}: {body}")
         return 0
     return 2
 

@@ -30,7 +30,10 @@ from __future__ import annotations
 from typing import Any, Dict, Optional, Type
 
 from ..core import flags as _flags
+from . import memory  # noqa: F401  (the HBM attribution plane)
 from .cost import attributed_mfu, executable_cost, roofline_gap  # noqa: F401
+from .memory import (census, executable_memory, maybe_dump_oom,  # noqa: F401
+                     top_buffers)
 from .merge import (gather_timelines, merge_timelines,  # noqa: F401
                     slim_records, straggler_report)
 from .recorder import (DUMP_SCHEMA, FlightRecorder,  # noqa: F401
@@ -46,6 +49,8 @@ __all__ = [
     "trigger_reason", "gather_timelines", "merge_timelines",
     "straggler_report", "slim_records", "executable_cost",
     "attributed_mfu", "roofline_gap", "dump_to_chrome_events",
+    "memory", "census", "top_buffers", "executable_memory",
+    "maybe_dump_oom",
 ]
 
 # ---- gates + singletons ----------------------------------------------------
@@ -73,12 +78,16 @@ def _rewire() -> None:
     if _TIMELINE is not None:
         _TIMELINE.on_close = _RECORDER.on_step_end if (fr_on and _RECORDER) \
             else None
+        # peak-HBM per phase: sample total live bytes at phase boundaries
+        # when the memory plane is also on (obs/memory.on_phase)
+        _TIMELINE.on_phase = memory.on_phase if (tl_on and memory._ENABLED) \
+            else None
     _TL_ENABLED = tl_on
     _FR_ENABLED = fr_on
     _ENABLED = tl_on or fr_on
 
 
-for _name in ("obs_timeline", "obs_flight_recorder"):
+for _name in ("obs_timeline", "obs_flight_recorder", "mem_census"):
     _flags.watch_flag(_name, lambda _v: _rewire())
 _rewire()
 

@@ -1,0 +1,507 @@
+"""HBM memory attribution plane — the *space* analog of the step timeline.
+
+Reference parity: the allocator stats/introspection role of
+`paddle/fluid/memory/` (AllocatorFacade + stats.h StatRegistry, PAPER.md §1
+row 2). `paddle.device.memory_stats` answers "how many bytes are live";
+nothing answered "whose bytes are they" — when a run OOMs or HBM creeps up
+across steps, no plane said whether params, optimizer slots, activations,
+prefetch staging, the serving bucket pool, or the lazy segment cache owns
+the growth. This module does, three ways:
+
+  1. **Tagged live-buffer census** — `tag(name, values, origin=...)`
+     registers device buffers in a weakref side-table at their creation
+     seams (`jit/train_step.py`, `parallel/spmd.py`, `optimizer/`,
+     `io/prefetch.py`, `serving/engine.py`, `ops/lazy.py`); `census()`
+     walks `jax.live_arrays()` and buckets bytes per tag per device
+     (untagged = "other"), publishing `mem.<tag>.bytes` gauges and feeding
+     a bounded ring (`FLAGS_mem_census_ring`). Tags survive donation
+     because the *call sites* re-tag the replacement buffers right after
+     committing them — the donated-away buffer leaves its tag with its
+     corpse (the weakref callback reaps it), the replacement inherits it.
+  2. **Per-executable breakdown** — `executable_memory(compiled)`
+     normalizes `compiled.memory_analysis()` (argument/output/temp/
+     generated-code/alias bytes) for every cached executable; surfaced as
+     `TrainStep.memory_report()`, `SPMDTrainStep.memory_report()`,
+     `Optimizer.memory_report()`, `ops.lazy.segment_memory()`. Peak HBM is
+     sampled at timeline phase boundaries (`StepTimeline.on_phase`) into
+     its own ring, so a dump can say *which phase* the high-water mark
+     lives in.
+  3. **OOM forensics + leak watch** — `maybe_dump_oom(exc, ...)` turns an
+     XLA `RESOURCE_EXHAUSTED` (or a fault injected at the `mem.alloc`
+     site) into ONE rate-limited flight-recorder dump embedding the census
+     ring, the top-K buffers by size with tag + origin, and the owning
+     executable's temp bytes; the leak watch flags any tag whose census
+     bytes grow strictly for `FLAGS_mem_leak_window` consecutive censuses
+     (`mem.leak_suspects` counter + one warning per tag).
+
+Hot-path contract (monitor/faults/obs regime): every tag seam checks ONE
+module attribute (`_mem._ENABLED`) and calls nothing else on the disabled
+path — the tier-1 overhead guard enforces it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..core import flags as _flags
+
+__all__ = [
+    "tag", "tag_of", "census", "census_ring", "top_buffers",
+    "executable_memory", "phase_peaks", "phase_peak_ring",
+    "is_oom", "maybe_dump_oom", "render_census", "reset",
+]
+
+# Hot-path gate: tag seams read this module attribute; one attribute load
+# is the entire disabled-path cost (PR 1 monitor._ENABLED regime).
+_ENABLED: bool = False
+
+# id(buffer) -> (tag, origin, weakref). Keyed by id so registration never
+# hashes (or pins) the array; the weakref's callback reaps the entry when
+# the buffer is collected, so a donated-away buffer's tag dies with it and
+# id reuse cannot mis-attribute a new buffer.
+_TAGS: Dict[int, tuple] = {}
+
+_LOCK = threading.RLock()
+_CENSUS_RING: deque = deque(maxlen=16)
+_PHASE_RING: deque = deque(maxlen=64)      # {"phase","ts","bytes"} samples
+_PHASE_PEAKS: Dict[str, int] = {}          # phase -> max sampled bytes
+_LEAK_HISTORY: Dict[str, deque] = {}       # tag -> trailing census bytes
+_LEAK_WARNED: set = set()
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "fault injected at mem.alloc")
+
+
+def _rewire(_v=None) -> None:
+    global _ENABLED, _CENSUS_RING
+    _ENABLED = bool(_flags.flag("mem_census"))
+    ring = max(1, int(_flags.flag("mem_census_ring")))
+    if ring != _CENSUS_RING.maxlen:
+        with _LOCK:
+            _CENSUS_RING = deque(list(_CENSUS_RING)[-ring:], maxlen=ring)
+
+
+for _name in ("mem_census", "mem_census_ring"):
+    _flags.watch_flag(_name, _rewire)
+_rewire()
+
+
+# ---- tagging ----------------------------------------------------------------
+
+def _is_device_array(x) -> bool:
+    """Concrete jax device array? Type check ONLY — never probe
+    `addressable_shards` here: that property MATERIALIZES one child
+    ArrayImpl per shard, each of which lands in `jax.live_arrays()` and
+    double-counts every buffer the census touches."""
+    import jax
+    return isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer)
+
+
+def _unwrap(leaf):
+    """Tensor/_LazyValue -> device array (or the leaf itself). A device
+    array is returned as-is — jax.Array also exposes a `_value` property
+    (its cached NUMPY value), so unconditional unwrapping would silently
+    swap the device buffer for a host copy."""
+    if _is_device_array(leaf):
+        return leaf
+    v = getattr(leaf, "_value", None)
+    if v is not None:
+        leaf = v
+        if _is_device_array(leaf):
+            return leaf
+    a = getattr(leaf, "_arr", None)
+    if a is not None:
+        leaf = a
+    return leaf
+
+
+def _iter_arrays(values):
+    import jax
+    for leaf in jax.tree_util.tree_leaves(values):
+        arr = _unwrap(leaf)
+        if not _is_device_array(arr):
+            continue
+        # Probe nbytes inside try/except: jax.Array ABC properties raise
+        # NotImplementedError on extended dtypes (typed PRNG key arrays),
+        # which hasattr does NOT swallow.
+        try:
+            if arr.nbytes >= 0:
+                yield arr
+        except Exception:
+            # typed PRNG key arrays: the live buffer census sees their
+            # underlying uint32 array, so tag that instead
+            base = getattr(arr, "_base_array", None)
+            try:
+                if base is not None and base.nbytes >= 0:
+                    yield base
+            except Exception:
+                continue
+
+
+def _per_device_bytes(a):
+    """(bytes_per_device, [device ids]) derived from the SHARDING, not
+    from `a.addressable_shards` — see `_is_device_array`. Sharded arrays
+    count 1/n per device, replicated arrays their full size on every
+    device."""
+    import numpy as np
+    sharding = a.sharding
+    devs = sorted(d.id for d in sharding.addressable_devices)
+    shard_shape = sharding.shard_shape(a.shape)
+    nb = int(np.prod(shard_shape, dtype=np.int64)) * int(a.dtype.itemsize)
+    return nb, devs
+
+
+def _buffer_key(a):
+    """Dedup key: two ArrayImpls can alias ONE device buffer (a shard
+    child materialized by some earlier `addressable_shards` walk aliases
+    its parent) — count the underlying buffer once."""
+    try:
+        return a.unsafe_buffer_pointer()
+    except Exception:
+        return id(a)
+
+
+def _reaper(key: int):
+    def _cb(_ref):
+        _TAGS.pop(key, None)
+    return _cb
+
+
+def tag(name: str, values: Any, origin: Optional[str] = None) -> int:
+    """Tag every device array in `values` (any pytree of arrays / Tensors)
+    as belonging to plane `name`. Returns the number of buffers tagged.
+    Call sites re-tag replacement buffers after a donated dispatch commits
+    — that is how tags survive donation."""
+    if not _ENABLED:
+        return 0
+    n = 0
+    name = str(name)
+    for arr in _iter_arrays(values):
+        key = id(arr)
+        try:
+            ref = weakref.ref(arr, _reaper(key))
+        except TypeError:
+            continue
+        _TAGS[key] = (name, origin, ref)
+        n += 1
+    return n
+
+
+def tag_of(arr) -> Optional[tuple]:
+    """(tag, origin) for a tagged buffer, else None. Verifies the weakref
+    still points at `arr` so a recycled id never mis-attributes."""
+    entry = _TAGS.get(id(_unwrap(arr)))
+    if entry is None:
+        return None
+    if entry[2]() is not _unwrap(arr):
+        return None
+    return entry[0], entry[1]
+
+
+# ---- census -----------------------------------------------------------------
+
+def census(publish: bool = True, store: bool = True) -> Dict[str, Any]:
+    """Walk `jax.live_arrays()` and bucket live bytes per tag per device.
+    Untagged buffers land in "other". Publishes `mem.<tag>.bytes` gauges
+    (FLAGS_monitor), appends to the census ring, and feeds the leak watch
+    unless told otherwise."""
+    import jax
+    tags: Dict[str, Dict[str, Any]] = {}
+    total = 0
+    # one row per underlying BUFFER: an aliasing ArrayImpl pair (parent +
+    # materialized shard child) must count once, under its tag if either
+    # alias carries one
+    rows: Dict[Any, tuple] = {}
+    for a in jax.live_arrays():
+        try:
+            if a.is_deleted():
+                continue
+            entry = _TAGS.get(id(a))
+            name = entry[0] if entry is not None and entry[2]() is a \
+                else None
+            nb, devs = _per_device_bytes(a)
+            key = _buffer_key(a)
+            if key not in rows or name is not None:
+                rows[key] = (name, nb, devs)
+        except Exception:   # deleted/donated buffers race the walk
+            continue
+    for name, nb, devs in rows.values():
+        bucket = tags.setdefault(name or "other",
+                                 {"bytes": 0, "count": 0, "devices": {}})
+        for did_ in devs:
+            did = str(did_)
+            bucket["bytes"] += nb
+            bucket["devices"][did] = bucket["devices"].get(did, 0) + nb
+            total += nb
+        bucket["count"] += 1
+    rec = {"ts": time.time(), "total_bytes": total, "tags": tags}
+    if publish:
+        from .. import monitor as _monitor
+        if _monitor._ENABLED:
+            for name, bucket in tags.items():
+                _monitor.gauge_set(f"mem.{name}.bytes", bucket["bytes"])
+            _monitor.gauge_set("mem.total.bytes", total)
+    if store:
+        with _LOCK:
+            _CENSUS_RING.append(rec)
+        _leak_check({n: b["bytes"] for n, b in tags.items()})
+    return rec
+
+
+def census_ring() -> List[Dict[str, Any]]:
+    with _LOCK:
+        return list(_CENSUS_RING)
+
+
+def top_buffers(k: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The K largest live buffers, each with its tag + origin — the 'who
+    owns the bytes' table of an OOM dump."""
+    import jax
+    if k is None:
+        k = int(_flags.flag("mem_top_k"))
+    rows: Dict[Any, dict] = {}
+    for a in jax.live_arrays():
+        try:
+            if a.is_deleted():
+                continue
+            entry = _TAGS.get(id(a))
+            tagged = entry is not None and entry[2]() is a
+            key = _buffer_key(a)
+            if key in rows and not tagged:   # keep the tagged alias
+                continue
+            rows[key] = {
+                "bytes": int(a.nbytes),
+                "shape": list(a.shape),
+                "dtype": str(a.dtype),
+                "tag": entry[0] if tagged else "other",
+                "origin": entry[1] if tagged else None,
+            }
+        except Exception:
+            continue
+    out = sorted(rows.values(), key=lambda r: -r["bytes"])
+    return out[:max(0, int(k))]
+
+
+# ---- leak watch -------------------------------------------------------------
+
+def _leak_check(per_tag: Dict[str, int]) -> None:
+    window = int(_flags.flag("mem_leak_window"))
+    if window <= 0:
+        return
+    from .. import monitor as _monitor
+    with _LOCK:
+        for name, nbytes in per_tag.items():
+            hist = _LEAK_HISTORY.get(name)
+            if hist is None or hist.maxlen != window + 1:
+                hist = _LEAK_HISTORY[name] = deque(
+                    list(hist or ()), maxlen=window + 1)
+            hist.append(int(nbytes))
+            if len(hist) < hist.maxlen:
+                continue
+            samples = list(hist)
+            if all(a < b for a, b in zip(samples, samples[1:])):
+                if _monitor._ENABLED:
+                    _monitor.count("mem.leak_suspects")
+                if name not in _LEAK_WARNED:
+                    _LEAK_WARNED.add(name)
+                    warnings.warn(
+                        f"mem leak watch: tag '{name}' grew on {window} "
+                        f"consecutive censuses ({samples[0]} -> "
+                        f"{samples[-1]} bytes) — a held reference is "
+                        "pinning HBM (FLAGS_mem_leak_window)",
+                        ResourceWarning, stacklevel=2)
+
+
+# ---- per-executable breakdown ----------------------------------------------
+
+_MEM_ATTRS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+
+def executable_memory(compiled) -> Dict[str, int]:
+    """Normalized {argument_bytes, output_bytes, temp_bytes, alias_bytes,
+    generated_code_bytes, peak_bytes} from an AOT-compiled executable's
+    memory_analysis(). jax returns a CompiledMemoryStats object (attribute
+    access) or, on some versions/backends, a dict or a one-element list;
+    absent/failed analysis -> {}. `peak_bytes` approximates the
+    executable's HBM high-water mark: arguments (minus donated aliases)
+    + outputs + temps + program text."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if isinstance(ma, (list, tuple)):
+        ma = ma[0] if ma else None
+    if ma is None:
+        return {}
+    out: Dict[str, int] = {}
+    for attr, norm in _MEM_ATTRS:
+        v = ma.get(attr) if isinstance(ma, dict) else getattr(ma, attr, None)
+        if isinstance(v, (int, float)):
+            out[norm] = int(v)
+    if out:
+        out["peak_bytes"] = (out.get("argument_bytes", 0)
+                             - out.get("alias_bytes", 0)
+                             + out.get("output_bytes", 0)
+                             + out.get("temp_bytes", 0)
+                             + out.get("generated_code_bytes", 0))
+    return out
+
+
+# ---- peak-HBM per timeline phase -------------------------------------------
+
+def _live_total() -> int:
+    import jax
+    total = 0
+    seen = set()
+    for a in jax.live_arrays():
+        try:
+            if a.is_deleted():
+                continue
+            key = _buffer_key(a)
+            if key in seen:
+                continue
+            seen.add(key)
+            total += int(a.nbytes)
+        except Exception:
+            continue
+    return total
+
+
+def on_phase(name: str, t0: float, t1: float) -> None:
+    """StepTimeline phase-boundary hook (wired by obs._rewire when both the
+    timeline and FLAGS_mem_census are on): sample total live bytes at each
+    phase exit so the peak can be attributed to a phase."""
+    if not _ENABLED:
+        return
+    nbytes = _live_total()
+    with _LOCK:
+        _PHASE_RING.append({"phase": name, "ts": t1, "bytes": nbytes})
+        if nbytes > _PHASE_PEAKS.get(name, -1):
+            _PHASE_PEAKS[name] = nbytes
+
+
+def phase_peaks() -> Dict[str, int]:
+    """phase -> max live bytes sampled at that phase's boundaries."""
+    with _LOCK:
+        return dict(_PHASE_PEAKS)
+
+
+def phase_peak_ring() -> List[Dict[str, Any]]:
+    with _LOCK:
+        return list(_PHASE_RING)
+
+
+# ---- OOM forensics ----------------------------------------------------------
+
+def is_oom(exc: BaseException) -> bool:
+    """XLA RESOURCE_EXHAUSTED (any backend's phrasing) or the fault-
+    injected `mem.alloc` stand-in used to rehearse the path off-device."""
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def forensics(executables: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The memory section of an OOM dump: a fresh census (the last act
+    before the artifact is written), the ring of prior censuses, the top-K
+    buffers with tag + origin, per-phase peaks, and the per-executable
+    breakdown the call site supplied."""
+    try:
+        current = census(publish=False, store=False)
+    except Exception:
+        current = {}
+    return {
+        "census": census_ring(),
+        "census_at_dump": current,
+        "top_buffers": top_buffers(),
+        "phase_peaks": phase_peaks(),
+        "executables": executables or {},
+    }
+
+
+def maybe_dump_oom(exc: BaseException, executable: Optional[str] = None,
+                   report=None) -> Optional[str]:
+    """Dispatch-site except-path: when `exc` is an OOM and the flight
+    recorder is armed, write ONE rate-limited dump (reason "oom") whose
+    extra.memory names the top buffers (tag + origin) and the owning
+    executable's temp bytes. Stamps `exc.dump_path` like
+    obs.dump_on_error. Returns the dump path or None."""
+    if not is_oom(exc):
+        return None
+    from . import _FR_ENABLED, _RECORDER
+    fr = _RECORDER
+    if fr is None or not _FR_ENABLED:
+        return None
+    execs: Dict[str, Any] = {}
+    if executable is not None and report is not None:
+        try:
+            execs[executable] = report() if callable(report) else dict(report)
+        except Exception:
+            execs[executable] = {}
+    path = fr.dump(reason="oom", extra={"memory": forensics(execs)})
+    if path:
+        exc.dump_path = path  # type: ignore[attr-defined]
+        if exc.args and isinstance(exc.args[0], str):
+            exc.args = (exc.args[0] + f" [flight recorder: {path}]",) \
+                + exc.args[1:]
+    return path
+
+
+# ---- rendering (monitor CLI `mem` subcommand) ------------------------------
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def render_census(rec: Dict[str, Any],
+                  top: Optional[List[Dict[str, Any]]] = None) -> str:
+    """Pretty-print one census record (+ optional top-buffer table)."""
+    lines = ["-" * 72,
+             f"memory census — total {_fmt_bytes(rec.get('total_bytes', 0))}",
+             "-" * 72,
+             f"{'Tag':<22}{'Bytes':>12}{'Share':>8}{'Buffers':>9}  Devices"]
+    total = max(1, int(rec.get("total_bytes", 0)))
+    tags = rec.get("tags", {})
+    for name in sorted(tags, key=lambda n: -tags[n]["bytes"]):
+        b = tags[name]
+        devs = ",".join(sorted(b.get("devices", {}), key=int))
+        lines.append(f"{name[:21]:<22}{_fmt_bytes(b['bytes']):>12}"
+                     f"{b['bytes'] / total:>8.1%}{b.get('count', 0):>9}"
+                     f"  [{devs}]")
+    if top:
+        lines.append("-" * 72)
+        lines.append("top buffers:")
+        for row in top:
+            origin = f"  ({row['origin']})" if row.get("origin") else ""
+            lines.append(f"  {_fmt_bytes(row['bytes']):>10}  "
+                         f"{row['dtype']}{row['shape']}  "
+                         f"tag={row['tag']}{origin}")
+    lines.append("-" * 72)
+    return "\n".join(lines)
+
+
+# ---- test hygiene -----------------------------------------------------------
+
+def reset() -> None:
+    """Drop the side tables (tests): tags, rings, leak history."""
+    with _LOCK:
+        _TAGS.clear()
+        _CENSUS_RING.clear()
+        _PHASE_RING.clear()
+        _PHASE_PEAKS.clear()
+        _LEAK_HISTORY.clear()
+        _LEAK_WARNED.clear()

@@ -33,7 +33,10 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["FlightRecorder", "DUMP_SCHEMA", "dump_to_chrome_events"]
 
-DUMP_SCHEMA = "paddle_tpu.flight_recorder/1"
+# /2 adds the "memory" section: the mem-census ring + per-phase HBM peaks
+# (obs/memory.py). `monitor show` renders both versions — a v1 dump is
+# simply one without the section.
+DUMP_SCHEMA = "paddle_tpu.flight_recorder/2"
 
 _COLLECTIVE_RING = 256
 _EVENT_RING = 128
@@ -149,6 +152,9 @@ class FlightRecorder:
                         "gauges": snap["gauges"],
                         "events": snap["events"][-32:]},
         }
+        from . import memory as _memory
+        out["memory"] = {"census": _memory.census_ring(),
+                         "phase_peaks": _memory.phase_peaks()}
         if extra:
             out["extra"] = extra
         return out

@@ -128,6 +128,10 @@ class StepTimeline:
         self._marker: Optional[tuple] = None      # (name, ts) from mark()
         # recorder hook: called with the closed record (obs wires this)
         self.on_close: Optional[Callable[[Dict[str, Any]], None]] = None
+        # phase-boundary hook: called as (name, t0, t1) at every phase
+        # exit (obs wires the memory plane's peak-HBM sampler here when
+        # both FLAGS_obs_timeline and FLAGS_mem_census are on)
+        self.on_phase: Optional[Callable[[str, float, float], None]] = None
 
     # ---- step record lifecycle ----
     def step_record(self) -> _StepCtx:
@@ -181,6 +185,9 @@ class StepTimeline:
         with self._lock:
             self._open_spans.pop(token, None)
         self.add_phase(name, t1 - t0, t0, t1)
+        hook = self.on_phase
+        if hook is not None:
+            hook(name, t0, t1)
 
     def add_phase(self, name: str, dur: float,
                   t0: Optional[float] = None,

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import sys
 import threading
+from collections import OrderedDict
 from typing import Any, List, Optional
 
 import jax
@@ -48,6 +49,7 @@ import numpy as np
 
 from .. import monitor as _monitor
 from .. import obs as _obs
+from ..obs import memory as _mem
 from ..core import autograd
 from ..core import flags as _flags
 from ..core import tensor as _tensor_mod
@@ -153,9 +155,36 @@ class _Record:
 
 
 # ---- segment signature cache (train_step._seen_sigs regime) ---------------
-_SEG_CACHE: dict = {}
+# LRU-ordered: a flush hit moves the signature to the MRU end, overflow
+# evicts from the LRU end one entry at a time (the old wholesale .clear()
+# threw away every hot replay whenever one workload overflowed the cap).
+_SEG_CACHE: "OrderedDict" = OrderedDict()
 _SEG_SEEN: set = set()
-_SEG_CACHE_CAP = 256
+_SEG_CACHE_CAP: int = int(_flags.flag("lazy_cache_entries"))
+cache_evictions: int = 0   # process-lifetime total (tests/introspection)
+
+
+def _on_cache_entries(value) -> None:
+    global _SEG_CACHE_CAP
+    _SEG_CACHE_CAP = max(1, int(value))
+    _evict_segments()
+
+
+def _evict_segments() -> None:
+    """Trim the replay cache to the cap from the LRU end, counting
+    `lazy.cache_evictions`."""
+    global cache_evictions
+    n = 0
+    while len(_SEG_CACHE) > _SEG_CACHE_CAP:
+        _SEG_CACHE.popitem(last=False)
+        n += 1
+    if n:
+        cache_evictions += n
+        if _monitor._ENABLED:
+            _monitor.count("lazy.cache_evictions", n)
+
+
+_flags.watch_flag("lazy_cache_entries", _on_cache_entries)
 # (fn-id component, input aval sig) -> output ShapeDtypeStructs
 _SHAPE_CACHE: dict = {}
 _SHAPE_CACHE_CAP = 8192
@@ -219,6 +248,24 @@ def sync() -> None:
     """Explicit sync point (`paddle.sync()`): flush the pending lazy
     segment so every deferred op is executed and materialized."""
     flush_pending()
+
+
+def segment_memory() -> List[dict]:
+    """Compiler-reported memory breakdown for every cached segment replay
+    executable (obs.executable_memory), MRU last. Each signature carries
+    its leaf avals, so the replays AOT-lower without live inputs."""
+    from .. import obs as _obs_pkg
+    out = []
+    for sig, replay in list(_SEG_CACHE.items()):
+        structs = [jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt),
+                                        weak_type=wt)
+                   for shape, dt, wt in sig[1]]
+        try:
+            rep = _obs_pkg.executable_memory(replay.lower(structs).compile())
+        except Exception:
+            continue
+        out.append({"ops": len(sig[0]), "leaves": len(structs), **rep})
+    return out
 
 
 def _aval_of(v):
@@ -373,11 +420,17 @@ class LazySegment:
             if novel:
                 _SEG_SEEN.add(sig)
             if replay is None:
-                if len(_SEG_CACHE) >= _SEG_CACHE_CAP:
-                    _SEG_CACHE.clear()
                 replay = _SEG_CACHE[sig] = _build_replay(records)
+                if len(_SEG_CACHE) > _SEG_CACHE_CAP:
+                    _evict_segments()
+            else:
+                _SEG_CACHE.move_to_end(sig)
             with _obs.phase("trace_compile" if novel else "device_compute"):
                 out_groups, vjp_raws = replay(leaves)
+            if _mem._ENABLED:
+                _mem.tag("lazy_segment",
+                         [arr for outs in out_groups for arr in outs],
+                         origin=f"LazySegment.flush ops={len(records)}")
             # deliver: materialize payloads, rebind tensors, patch VJPs
             for rec, outs, raw in zip(records, out_groups, vjp_raws):
                 for lv, arr in zip(rec.lvs, outs):

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Parameter, Tensor
 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from ..obs import memory as _mem
 
 
 class Optimizer:
@@ -50,6 +51,7 @@ class Optimizer:
         # mirror `_t_host` detects external _step_count writes — rollback,
         # set_state_dict — and refreshes the carry)
         self._fused_cache = {}
+        self._fused_avals = {}  # cache key -> arg avals (memory_report)
         self._lr_arr = None
         self._lr_host = None
         self._t_arr = None
@@ -228,22 +230,45 @@ class Optimizer:
                                         scaled=inv_scale is not None),
                 donate_argnums=(0, 2, 4))
             self._fused_cache[key] = fn
+            # arg avals so memory_report() can AOT-lower this executable
+            # later without needing live grads
+            self._fused_avals[key] = (
+                [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
+                 for p in params],
+                [jax.ShapeDtypeStruct(g.shape, g.dtype) for g in grads],
+                [{k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for k, v in s.items()} for s in slots],
+                inv_scale is not None)
 
         from .. import monitor as _monitor
+        from .. import faults as _faults
         if _monitor._ENABLED:
             _monitor.count("optimizer.fused_dispatches")
-        if inv_scale is None:
-            new_vals, new_slots, new_t = fn([p._value for p in params],
-                                            grads, slots, lr_s, t_s)
-            found = None
-        else:
-            new_vals, new_slots, new_t, found = fn(
-                [p._value for p in params], grads, slots, lr_s, t_s,
-                inv_scale)
+        try:
+            if _faults._ENABLED:
+                _faults.check("mem.alloc")
+            if inv_scale is None:
+                new_vals, new_slots, new_t = fn([p._value for p in params],
+                                                grads, slots, lr_s, t_s)
+                found = None
+            else:
+                new_vals, new_slots, new_t, found = fn(
+                    [p._value for p in params], grads, slots, lr_s, t_s,
+                    inv_scale)
+        except Exception as e:
+            _mem.maybe_dump_oom(e, executable="fused_optimizer_update",
+                                report=lambda: self.memory_report())
+            raise
         for p, v, s in zip(params, new_vals, new_slots):
             p._value = v
             self._accumulators[id(p)] = s
         self._t_arr = new_t
+        if _mem._ENABLED:
+            # the fused call donated the old param/slot/t buffers; claim the
+            # replacements for the live-buffer census
+            _mem.tag("params", new_vals, origin="Optimizer.step")
+            _mem.tag("opt_slots", new_slots, origin="Optimizer.step")
+            _mem.tag("step_state", [new_t], origin="Optimizer.step")
         if inv_scale is None:
             self._step_count += 1
             self._t_host = self._t_host + 1.0
@@ -297,6 +322,33 @@ class Optimizer:
             return outs, outslots
 
         return update
+
+    def memory_report(self):
+        """Compiler-reported memory breakdown for every cached fused-update
+        executable (obs.executable_memory): {"fused_update": {...},
+        "fused_update_scaled": {...}}. AOT-lowers from the arg avals
+        recorded at build time, so it needs no live grads; an un-stepped
+        optimizer returns {}."""
+        from .. import obs as _obs
+        out: Dict[str, Dict[str, int]] = {}
+        scalar = jax.ShapeDtypeStruct((), jnp.float32)
+        for i, (key, fn) in enumerate(self._fused_cache.items()):
+            avals = self._fused_avals.get(key)
+            if avals is None:
+                continue
+            p_avals, g_avals, s_avals, scaled = avals
+            args = (p_avals, g_avals, s_avals, scalar, scalar)
+            if scaled:
+                args = args + (scalar,)
+            try:
+                rep = _obs.executable_memory(fn.lower(*args).compile())
+            except Exception:
+                continue
+            name = "fused_update_scaled" if scaled else "fused_update"
+            if name in out:
+                name = f"{name}#{i}"
+            out[name] = rep
+        return out
 
     def clear_grad(self, set_to_zero=True):
         for p in (self._parameter_list or []):

@@ -24,7 +24,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults as _faults
 from .. import obs as _obs
+from ..obs import memory as _mem
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from ..jit.functional import functional_call, split_state
@@ -264,6 +266,20 @@ class SPMDTrainStep:
         if pending is not None:  # set_state_dict before the first step
             self._pending_state = None
             self._apply_state(pending)
+        if _mem._ENABLED:
+            self._tag_state()
+
+    def _tag_state(self):
+        """(Re-)tag the mesh-resident loop state for the live-buffer census
+        (donation kills the old buffers' tags — see TrainStep._tag_state)."""
+        trainable, frozen = split_state(self.model)
+        _mem.tag("params", [trainable[n]._value for n in self._pnames],
+                 origin="SPMDTrainStep")
+        _mem.tag("opt_slots", self._slots, origin="SPMDTrainStep")
+        _mem.tag("model_buffers", [frozen[n]._value for n in self._bnames],
+                 origin="SPMDTrainStep")
+        if self._t_arr is not None:
+            _mem.tag("step_state", [self._t_arr], origin="SPMDTrainStep")
 
     def collective_signature(self, *batch):
         """The step's static collective sequence (tpu-lint collective-order
@@ -389,14 +405,26 @@ class SPMDTrainStep:
             key = rnd.default_generator().next_key()
             lr = self._lr_scalar()
             t = self._t_scalar()
+            if _mem._ENABLED:
+                _mem.tag("activations", arrs, origin="SPMDTrainStep.batch")
             # GSPMD folds the collectives INTO the executable, so the
             # timeline cannot fence them apart from compute here — the
             # device_compute phase is the whole sharded step; explicit
             # eager collectives (parallel/collective.py) get their own
             # `collective` phase.
             with _obs.phase("trace_compile" if first else "device_compute"):
-                new_params, self._slots, loss, new_t, bad = self._jitted(
-                    params, self._slots, buffers, key, lr, t, arrs)
+                try:
+                    if _faults._ENABLED:
+                        _faults.check("mem.alloc")
+                    new_params, self._slots, loss, new_t, bad = self._jitted(
+                        params, self._slots, buffers, key, lr, t, arrs)
+                except Exception as e:
+                    _mem.maybe_dump_oom(
+                        e, executable="SPMDTrainStep",
+                        report=lambda: _obs.executable_memory(
+                            self._jitted.lower(params, self._slots, buffers,
+                                               key, lr, t, arrs).compile()))
+                    raise
                 if _obs._TL_ENABLED:
                     jax.block_until_ready(loss)
             # commit before the debug raise — old buffers were donated
@@ -405,6 +433,8 @@ class SPMDTrainStep:
             self._t_arr = new_t
             self._t_host = self._t_host + 1.0
             self.optimizer._step_count += 1
+            if _mem._ENABLED:
+                self._tag_state()
             from ..jit.train_step import raise_nonfinite
             raise_nonfinite(bad, self._pnames, "jitted SPMD train step")
             return Tensor(loss)
@@ -426,3 +456,21 @@ class SPMDTrainStep:
         lowered = self._jitted.lower(params, self._slots, buffers, key, lr,
                                      t, arrs)
         return _obs.executable_cost(lowered.compile())
+
+    def memory_report(self, *batch):
+        """Compiler-reported memory breakdown for the sharded step
+        executable (see jit.TrainStep.memory_report). Per-device numbers:
+        XLA reports one shard of the SPMD program."""
+        arrs = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in batch]
+        if self._jitted is None:
+            self._build(arrs)
+        trainable, frozen = split_state(self.model)
+        params = [trainable[n]._value for n in self._pnames]
+        buffers = [frozen[n]._value for n in self._bnames]
+        key = rnd.default_generator().next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        t = jnp.asarray(self.optimizer._step_count + 1, jnp.float32)
+        lowered = self._jitted.lower(params, self._slots, buffers, key, lr,
+                                     t, arrs)
+        return _obs.executable_memory(lowered.compile())

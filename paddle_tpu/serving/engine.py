@@ -36,6 +36,7 @@ import numpy as np
 from .. import faults as _faults
 from .. import monitor as _monitor
 from .. import obs as _obs
+from ..obs import memory as _mem
 from ..core import flags as _flags
 from .bucket import BucketSet, ShapeBucket, default_batch_sizes, signature_of
 
@@ -202,6 +203,12 @@ class ServingEngine:
         def call(arrays: List[np.ndarray]) -> List[np.ndarray]:
             out = predictor(*arrays)
             outs = out if isinstance(out, (list, tuple)) else [out]
+            if _mem._ENABLED:
+                # outs are (wrapped) device arrays until np.asarray below;
+                # the predictor keeps the last set alive in its results
+                # cache — the census should attribute them, not call them
+                # "other"
+                _mem.tag("serving_bucket", outs, origin="ServingEngine")
             return [np.asarray(o) for o in outs]
 
         return call
@@ -499,12 +506,29 @@ class ServingEngine:
             _monitor.observe("serving.e2e_latency", t_done - req.enqueue_t)
 
     # ---- health / stats ----
+    def bucket_pool_bytes(self) -> int:
+        """Bytes the warm bucket pool pins on device: one padded input set
+        per (batch size, item signature) ever dispatched — each signature
+        keeps a compiled executable whose argument buffers steady-state
+        serving re-feeds. Gauged as `serving.bucket_pool.bytes`; the mem
+        census' `serving_bucket` tag covers the live output side."""
+        total = 0
+        for sig in list(self._dispatched_sigs):
+            bs = int(sig[0])
+            for shape, dt in sig[1:]:
+                elems = int(np.prod(shape)) if shape else 1
+                total += bs * elems * np.dtype(dt).itemsize
+        return total
+
     def stats(self) -> Dict[str, Any]:
         """Health snapshot for probes and the wire health endpoint."""
         with self._cv:
             counts = dict(self._counts)
             pending = self._pending
             inflight = self._inflight
+        pool_bytes = self.bucket_pool_bytes()
+        if _monitor._ENABLED:
+            _monitor.gauge_set("serving.bucket_pool.bytes", pool_bytes)
         return {
             "running": self.running,
             "queue_depth": pending,
@@ -514,5 +538,6 @@ class ServingEngine:
             "batch_timeout_ms": self.config.batch_timeout_ms,
             "workers": len(self._workers),
             "buckets": [b.describe() for b in self.buckets.buckets()],
+            "bucket_pool_bytes": pool_bytes,
             "counters": counts,
         }

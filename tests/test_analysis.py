@@ -246,6 +246,59 @@ def forward(self, x):
         src_all = src.replace("disable=host-sync", "disable=all")
         assert lint_source(src_all, "f.py") == []
 
+    # -- buffer-retain advisory (ISSUE 10: HBM memory attribution) --
+
+    def test_buffer_retain_eager_loop(self):
+        """`self.last_loss = loss` in an --all-mode epoch loop pins the
+        step's device buffer across iterations (defeats donation) —
+        including through a plain-name rebind."""
+        src = """
+def run_epoch(self, loader):
+    for batch in loader:
+        loss = self.step(batch)
+        self.last_loss = loss
+"""
+        assert rules_of(lint_source(src, "f.py",
+                                    all_functions=True)) == ["buffer-retain"]
+
+    def test_buffer_retain_traced_forward(self):
+        src = """
+def forward(self, x):
+    for blk in range(3):
+        x = x * 2
+        self.h = x
+    return x
+"""
+        assert rules_of(lint_source(src, "f.py")) == ["buffer-retain"]
+
+    def test_buffer_retain_host_copies_exempt(self):
+        """float(...)/np.asarray(...) copies are the recommended FIX —
+        they hold host values, not device buffers."""
+        src = """
+def run_epoch(self, loader):
+    for batch in loader:
+        loss = self.step(batch)
+        self.last = float(loss)
+        self.curve = np.asarray(loss)
+"""
+        assert lint_source(src, "f.py", all_functions=True) == []
+
+    def test_buffer_retain_outside_loop_exempt(self):
+        src = """
+def setup(self, x):
+    self.template = paddle.zeros([4, 4])
+"""
+        assert lint_source(src, "f.py", all_functions=True) == []
+
+    def test_buffer_retain_suppression(self):
+        src = """
+def run_epoch(self, loader):
+    for batch in loader:
+        loss = self.step(batch)
+        self.last_loss = loss  # tpu-lint: disable=buffer-retain
+"""
+        assert lint_source(src, "f.py", all_functions=True) == []
+
 
 # ---------------------------------------------------------------------------
 # level 2: graph analysis
@@ -743,9 +796,14 @@ class TestSelfLint:
              # hot-path overlap plane (ISSUE 7): the prefetch feeder and
              # the bucketed reducer ride the same gate
              os.path.join(PKG, "io", "prefetch.py"),
-             os.path.join(PKG, "parallel", "reducer.py")],
+             os.path.join(PKG, "parallel", "reducer.py"),
+             # memory attribution plane (ISSUE 10): census seams must not
+             # themselves retain per-step buffers or sync in hot loops
+             os.path.join(PKG, "serving", "engine.py"),
+             os.path.join(PKG, "guard", "supervisor.py"),
+             os.path.join(PKG, "device", "__init__.py")],
             all_functions=True)
-        assert n_files > 22
+        assert n_files > 25
         assert findings == [], "\n".join(f.format() for f in findings)
 
     def test_shipped_model_programs_are_graph_clean(self):

@@ -1,0 +1,616 @@
+"""HBM memory attribution plane (paddle_tpu.obs.memory).
+
+Acceptance properties (ISSUE 10): a jitted-LeNet census attributes >=90%
+of live bytes to non-"other" tags and matches paddle.device's
+allocated.current; a forced RESOURCE_EXHAUSTED (fault injected at
+`mem.alloc`) produces EXACTLY ONE flight-recorder dump whose JSON names
+the top buffer's tag and the owning executable's temp bytes; tags
+survive buffer donation via commit-site re-tagging; every jitted
+executable's donated inputs are actually deleted (donation audit, named
+per executable); the lazy segment cache is LRU-bounded with an eviction
+counter; schema /2 dumps carry the census ring while /1 artifacts still
+render; the disabled path passes the PR-1-style overhead guard.
+"""
+import gc
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import faults, monitor, obs
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.obs import memory
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+# ---- fixtures / helpers -----------------------------------------------------
+
+@pytest.fixture
+def with_mem(tmp_path):
+    """Census on + armed flight recorder, dumps into tmp. The default
+    30s per-reason rate limit stays ON — the "exactly one dump" drill
+    depends on it."""
+    dump_dir = str(tmp_path / "dumps")
+    _flags.set_flags({"mem_census": True, "obs_flight_recorder": True,
+                      "obs_dump_dir": dump_dir})
+    obs.reset()
+    memory.reset()
+    yield dump_dir
+    _flags.set_flags({"mem_census": False, "obs_flight_recorder": False,
+                      "obs_dump_dir": "flight_recorder"})
+    obs.reset()
+    memory.reset()
+
+
+@pytest.fixture(autouse=True)
+def _no_mem_leak():
+    """mem_census leaking out of a test would re-enable every tag seam for
+    the rest of the session — assert it is back off (and restore)."""
+    yield
+    leaked = bool(_flags.flag("mem_census"))
+    if leaked:
+        _flags.set_flags({"mem_census": False})
+        memory.reset()
+    assert not leaked, "FLAGS_mem_census leaked out of the test"
+
+
+@pytest.fixture
+def with_monitor():
+    _flags.set_flags({"monitor": True})
+    monitor.reset()
+    yield
+    monitor.reset()
+    _flags.set_flags({"monitor": False})
+
+
+def _make_lenet_step(seed=0, bs=64):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    net = paddle.models.LeNet(num_classes=10)
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-3)
+    step = TrainStep(net, nn.CrossEntropyLoss(), opt, n_model_inputs=1)
+    x = paddle.to_tensor(np.random.rand(bs, 1, 28, 28).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 10, (bs,)).astype("int64"))
+    return step, x, y
+
+
+def _make_linear_step(seed=0):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+    step = TrainStep(net, nn.MSELoss(), opt, n_model_inputs=1)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.rand(8, 4).astype("float32"))
+    y = paddle.to_tensor(rng.rand(8, 1).astype("float32"))
+    return step, x, y
+
+
+def _is_deleted(a) -> bool:
+    if isinstance(a, np.ndarray):
+        return False    # host array — donation cannot touch it
+    try:
+        return bool(a.is_deleted())
+    except Exception:   # typed PRNG key arrays delegate to the base buffer
+        return bool(a._base_array.is_deleted())
+
+
+def _latest_dump(err):
+    path = getattr(err, "dump_path", None)
+    assert path and os.path.exists(path), \
+        f"no flight-recorder dump on {type(err).__name__}: {err}"
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---- tagged live-buffer census ----------------------------------------------
+
+class TestCensus:
+    def test_jitted_lenet_census_is_90pct_attributed(self, with_mem):
+        """THE acceptance invariant: after steady-state jitted training,
+        live HBM is ATTRIBUTED — at most 10% may fall in 'other', and the
+        census total agrees with the device allocator view."""
+        step, x, y = _make_lenet_step()
+        for _ in range(3):
+            step(x, y)
+        gc.collect()
+        rec = memory.census(publish=False, store=False)
+        total = rec["total_bytes"]
+        assert total > 0
+        other = rec["tags"].get("other", {}).get("bytes", 0)
+        assert other / total <= 0.10, rec["tags"]
+        for want in ("params", "opt_slots", "activations", "step_state"):
+            assert want in rec["tags"], sorted(rec["tags"])
+        # the census and paddle.device count the same bytes
+        assert total == paddle.device.memory_stats()["allocated.current"]
+
+    def test_tags_survive_donation(self, with_mem):
+        """The jit call donates param/slot buffers every step; commit-site
+        re-tagging must keep the census attribution exact — params bytes
+        == the live param arrays, not zero and not stale corpses."""
+        step, x, y = _make_lenet_step()
+        for _ in range(4):
+            step(x, y)
+        gc.collect()
+        rec = memory.census(publish=False, store=False)
+        live_param_bytes = sum(int(t._value.nbytes) for t in step._ptensors)
+        assert rec["tags"]["params"]["bytes"] == live_param_bytes
+        slot_bytes = sum(int(v.nbytes) for s in step._slots
+                         for v in s.values())
+        assert rec["tags"]["opt_slots"]["bytes"] == slot_bytes
+
+    def test_top_buffers_are_tagged_and_unique(self, with_mem):
+        step, x, y = _make_lenet_step()
+        step(x, y)
+        gc.collect()
+        rows = memory.top_buffers(k=8)
+        assert rows and rows[0]["tag"] != "other"
+        assert rows[0]["bytes"] >= rows[-1]["bytes"]
+        # origin names the creation seam
+        assert any(r["origin"] for r in rows)
+
+    def test_census_ring_is_bounded(self, with_mem):
+        _flags.set_flags({"mem_census_ring": 4})
+        try:
+            for _ in range(9):
+                memory.census(publish=False)
+            assert len(memory.census_ring()) == 4
+        finally:
+            _flags.set_flags({"mem_census_ring": 16})
+
+    def test_census_publishes_gauges(self, with_mem, with_monitor):
+        step, x, y = _make_linear_step()
+        step(x, y)
+        memory.census()
+        gauges = monitor.snapshot()["gauges"]
+        assert "mem.total.bytes" in gauges
+        assert any(k.startswith("mem.params") for k in gauges), gauges
+
+    def test_render_census_smoke(self, with_mem):
+        step, x, y = _make_linear_step()
+        step(x, y)
+        text = memory.render_census(memory.census(publish=False, store=False),
+                                    top=memory.top_buffers())
+        assert "memory census" in text and "params" in text
+
+    def test_mem_cli_live_census(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.monitor", "mem"],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        assert "memory census" in out.stdout
+
+
+# ---- per-executable memory breakdown ----------------------------------------
+
+class TestExecutableMemory:
+    KEYS = {"argument_bytes", "output_bytes", "temp_bytes", "alias_bytes",
+            "generated_code_bytes", "peak_bytes"}
+
+    def test_train_step_memory_report(self):
+        step, x, y = _make_lenet_step()
+        step(x, y)
+        rep = step.memory_report(x, y)
+        assert self.KEYS <= set(rep)
+        assert rep["argument_bytes"] > 0
+        assert rep["temp_bytes"] > 0        # conv scratch is never zero
+        assert rep["peak_bytes"] >= rep["temp_bytes"]
+
+    def test_spmd_memory_report(self):
+        from paddle_tpu.parallel import (HybridCommunicateGroup,
+                                         SPMDTrainStep)
+        paddle.seed(7)
+        np.random.seed(7)
+        hcg = HybridCommunicateGroup(hybrid_configs={"dp_degree": 8})
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=1e-2)
+        step = SPMDTrainStep(net, nn.CrossEntropyLoss(), opt,
+                             mesh=hcg.get_mesh(), donate=False)
+        x = paddle.to_tensor(np.random.rand(16, 16).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+        step(x, y)
+        rep = step.memory_report(x, y)
+        assert self.KEYS <= set(rep)
+        assert rep["argument_bytes"] > 0
+
+    def test_fused_optimizer_memory_report(self):
+        paddle.seed(0)
+        lin = nn.Linear(6, 3)
+        opt = paddle.optimizer.Adam(parameters=lin.parameters(),
+                                    learning_rate=1e-2)
+        x = paddle.to_tensor(np.random.rand(4, 6).astype("float32"))
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        rep = opt.memory_report()
+        assert "fused_update" in rep, sorted(rep)
+        assert self.KEYS <= set(rep["fused_update"])
+        assert rep["fused_update"]["argument_bytes"] > 0
+
+    def test_lazy_segment_memory(self):
+        from paddle_tpu.ops import lazy
+        _flags.set_flags({"lazy_eager": True})
+        try:
+            t = paddle.to_tensor(np.ones((2, 5), np.float32))
+            u = (t + 1.0) * 2.0
+            _ = u.numpy()       # flush
+            segs = lazy.segment_memory()
+            assert segs
+            assert {"ops", "leaves"} <= set(segs[0])
+            assert self.KEYS <= set(segs[0])
+        finally:
+            _flags.set_flags({"lazy_eager": False})
+
+    def test_phase_peaks_with_timeline(self, with_mem):
+        _flags.set_flags({"obs_timeline": True})
+        obs.reset()
+        try:
+            step, x, y = _make_linear_step()
+            for _ in range(3):
+                step(x, y)
+            peaks = memory.phase_peaks()
+            assert peaks and all(v > 0 for v in peaks.values())
+            assert "device_compute" in peaks or "trace_compile" in peaks
+        finally:
+            _flags.set_flags({"obs_timeline": False})
+            obs.reset()
+
+
+# ---- OOM forensics ----------------------------------------------------------
+
+class TestOOMForensics:
+    def test_forced_oom_cuts_exactly_one_dump(self, with_mem):
+        """THE drill: a clean step, then `mem.alloc` armed — three failing
+        dispatches must produce ONE rate-limited dump whose JSON names the
+        top buffer's tag AND the owning executable's temp bytes."""
+        step, x, y = _make_lenet_step()
+        step(x, y)
+        memory.census()     # ring has at least one record pre-OOM
+        errs = []
+        with faults.inject("mem.alloc:error"):
+            for _ in range(3):
+                try:
+                    step(x, y)
+                except faults.InjectedFault as e:
+                    errs.append(e)
+        assert len(errs) == 3
+        dumps = [f for f in os.listdir(with_mem) if f.endswith(".json")]
+        assert len(dumps) == 1, dumps        # rate limit: ONE artifact
+        assert "[flight recorder:" in str(errs[0])
+        assert getattr(errs[1], "dump_path", None) is None  # rate-limited
+        doc = _latest_dump(errs[0])
+        assert doc["schema"] == "paddle_tpu.flight_recorder/2"
+        assert doc["reason"] == "oom"
+        mem = doc["extra"]["memory"]
+        top = mem["top_buffers"]
+        assert top and top[0]["tag"] != "other"
+        assert isinstance(mem["executables"]["TrainStep"]["temp_bytes"], int)
+        assert mem["executables"]["TrainStep"]["temp_bytes"] > 0
+        assert mem["census"]                 # the pre-OOM ring rode along
+        assert mem["census_at_dump"]["total_bytes"] > 0
+
+    def test_rate_limit_zero_allows_next_dump(self, with_mem):
+        _flags.set_flags({"obs_dump_min_interval_s": 0.0})
+        try:
+            step, x, y = _make_linear_step()
+            step(x, y)
+            errs = []
+            with faults.inject("mem.alloc:error"):
+                for _ in range(2):
+                    try:
+                        step(x, y)
+                    except faults.InjectedFault as e:
+                        errs.append(e)
+            paths = {getattr(e, "dump_path", None) for e in errs}
+            assert None not in paths and len(paths) == 2
+        finally:
+            _flags.set_flags({"obs_dump_min_interval_s": 30.0})
+
+    def test_fused_optimizer_oom_names_its_executable(self, with_mem):
+        paddle.seed(0)
+        lin = nn.Linear(6, 3)
+        opt = paddle.optimizer.Adam(parameters=lin.parameters(),
+                                    learning_rate=1e-2)
+        x = paddle.to_tensor(np.random.rand(4, 6).astype("float32"))
+        lin(x).sum().backward()
+        opt.step()          # build the fused executable cleanly first
+        lin(x).sum().backward()
+        with faults.inject("mem.alloc:error"):
+            with pytest.raises(faults.InjectedFault) as ei:
+                opt.step()
+        doc = _latest_dump(ei.value)
+        execs = doc["extra"]["memory"]["executables"]
+        assert "fused_optimizer_update" in execs
+        assert "fused_update" in execs["fused_optimizer_update"]
+
+    def test_is_oom_matchers(self):
+        assert memory.is_oom(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 bytes"))
+        assert memory.is_oom(faults.InjectedFault(
+            "fault injected at mem.alloc"))
+        assert not memory.is_oom(ValueError("shape mismatch"))
+
+    def test_non_oom_error_does_not_dump(self, with_mem):
+        assert memory.maybe_dump_oom(ValueError("not an oom")) is None
+        assert not os.path.isdir(with_mem) or \
+            [f for f in os.listdir(with_mem) if f.endswith(".json")] == []
+
+    def test_leak_watch_warns_on_monotonic_growth(self, with_mem,
+                                                  with_monitor):
+        _flags.set_flags({"mem_leak_window": 3})
+        hoard = []
+        try:
+            with pytest.warns(ResourceWarning, match="leak watch"):
+                for i in range(6):
+                    import jax
+                    a = jax.device_put(
+                        np.ones((256 * (i + 1),), np.float32))
+                    hoard.append(a)
+                    memory.tag("retained", [a], origin="test-hoard")
+                    memory.census(publish=False)
+            assert monitor.snapshot()["counters"]["mem.leak_suspects"] >= 1
+        finally:
+            _flags.set_flags({"mem_leak_window": 8})
+            hoard.clear()
+
+
+# ---- dump schema v2 + v1 back-compat ----------------------------------------
+
+class TestDumpSchema:
+    def test_v2_dump_always_carries_memory_section(self, with_mem, tmp_path):
+        path = obs.dump(str(tmp_path / "manual.json"), reason="manual")
+        doc = json.load(open(path))
+        assert doc["schema"] == "paddle_tpu.flight_recorder/2"
+        assert "census" in doc["memory"] and "phase_peaks" in doc["memory"]
+
+    def test_v1_fixture_still_renders(self):
+        """Back-compat gate: a checked-in /1 artifact (no memory section)
+        must render through `monitor show` machinery without crashing."""
+        from paddle_tpu.monitor import _is_flight_dump, _render_flight_dump
+        doc = json.load(open(os.path.join(FIXTURES, "flightrec_v1.json")))
+        assert doc["schema"] == "paddle_tpu.flight_recorder/1"
+        assert _is_flight_dump(doc)
+        text = _render_flight_dump(doc)
+        assert "flight recorder dump" in text
+        assert "stall" in text
+
+    def test_v1_fixture_through_mem_cli(self):
+        from paddle_tpu.monitor import _main
+        path = os.path.join(FIXTURES, "flightrec_v1.json")
+        assert _main(["mem", path]) == 0       # says "no memory census"
+        assert _main(["show", path]) == 0
+
+    def test_v2_oom_dump_through_mem_cli(self, with_mem, capsys):
+        from paddle_tpu.monitor import _main
+        step, x, y = _make_linear_step()
+        step(x, y)
+        memory.census()
+        with faults.inject("mem.alloc:error"):
+            with pytest.raises(faults.InjectedFault) as ei:
+                step(x, y)
+        assert _main(["mem", ei.value.dump_path]) == 0
+        out = capsys.readouterr().out
+        assert "memory census" in out and "executable TrainStep" in out
+
+
+# ---- donation audit (all jitted executables) --------------------------------
+
+def _donation_train_step():
+    step, x, y = _make_linear_step()
+    step(x, y)
+    donated = {"params": [t._value for t in step._ptensors],
+               "opt_slots": [v for s in step._slots for v in s.values()],
+               "rng_key": [step._key], "t": [step._t_arr]}
+    kept = {"batch": [x._value, y._value]}
+    step(x, y)
+    return donated, kept
+
+
+def _donation_spmd_step():
+    from paddle_tpu.parallel import HybridCommunicateGroup, SPMDTrainStep
+    from paddle_tpu.jit.functional import split_state
+    paddle.seed(3)
+    np.random.seed(3)
+    hcg = HybridCommunicateGroup(hybrid_configs={"dp_degree": 8})
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+    step = SPMDTrainStep(net, nn.CrossEntropyLoss(), opt,
+                         mesh=hcg.get_mesh())
+    x = paddle.to_tensor(np.random.rand(16, 16).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+    step(x, y)
+    trainable, _ = split_state(net)
+    donated = {"params": [trainable[n]._value for n in step._pnames],
+               "opt_slots": [v for s in step._slots for v in s.values()],
+               "t": [step._t_arr]}
+    kept = {"batch": [x._value]}
+    step(x, y)
+    return donated, kept
+
+
+def _donation_fused_optimizer():
+    paddle.seed(0)
+    lin = nn.Linear(6, 3)
+    opt = paddle.optimizer.Adam(parameters=lin.parameters(),
+                                learning_rate=1e-2)
+    x = paddle.to_tensor(np.random.rand(4, 6).astype("float32"))
+    lin(x).sum().backward()
+    opt.step()
+    lin(x).sum().backward()
+    params = [p for p in opt._parameter_list
+              if not p.stop_gradient and p.grad is not None]
+    donated = {"params": [p._value for p in params],
+               "opt_slots": [v for p in params
+                             for v in opt._accumulators[id(p)].values()],
+               "t": [opt._t_arr]}
+    kept = {"grads": [p.grad._value for p in params]}   # NOT donated
+    opt.step()
+    return donated, kept
+
+
+def _donation_lazy_segment():
+    from paddle_tpu.ops import lazy
+    _flags.set_flags({"lazy_eager": True})
+    try:
+        t = paddle.to_tensor(np.ones((3, 4), np.float32))
+        src = t._value
+        u = (t + 1.0) * 2.0
+        _ = u.numpy()   # flush: replay must NOT donate its leaves
+        return {}, {"leaves": [src]}
+    finally:
+        _flags.set_flags({"lazy_eager": False})
+
+
+_DONATION_CASES = {
+    "TrainStep": _donation_train_step,
+    "SPMDTrainStep": _donation_spmd_step,
+    "fused_optimizer_update": _donation_fused_optimizer,
+    "lazy_segment_replay": _donation_lazy_segment,
+}
+
+
+class TestDonationAudit:
+    @pytest.mark.parametrize("executable", sorted(_DONATION_CASES))
+    def test_donated_inputs_are_deleted(self, executable):
+        """Every jitted executable's donated inputs must actually be dead
+        after dispatch (a silently-failed donation doubles steady-state
+        HBM), and its explicitly-kept inputs must stay alive. Failures
+        name the executable."""
+        donated, kept = _DONATION_CASES[executable]()
+        for group, arrs in donated.items():
+            assert arrs, f"{executable}: empty donated group {group!r}"
+            for i, a in enumerate(arrs):
+                assert _is_deleted(a), \
+                    (f"{executable}: donated input {group}[{i}] survived "
+                     f"dispatch — donation is not taking effect")
+        for group, arrs in kept.items():
+            for i, a in enumerate(arrs):
+                assert not _is_deleted(a), \
+                    (f"{executable}: non-donated input {group}[{i}] was "
+                     f"deleted — over-aggressive donation")
+
+
+# ---- lazy segment-cache LRU (satellite) -------------------------------------
+
+class TestLazyCacheLRU:
+    def test_cache_is_lru_bounded_with_eviction_counter(self, with_monitor):
+        from paddle_tpu.ops import lazy
+        _flags.set_flags({"lazy_eager": True, "lazy_cache_entries": 4})
+        ev0 = lazy.cache_evictions
+        try:
+            for i in range(10):
+                t = paddle.to_tensor(np.ones((2, 3 + i), np.float32))
+                _ = ((t + 1.0) * 2.0).numpy()
+            assert len(lazy._SEG_CACHE) <= 4
+            assert lazy.cache_evictions - ev0 >= 6
+            snap = monitor.snapshot()["counters"]
+            assert snap.get("lazy.cache_evictions", 0) >= 6
+        finally:
+            _flags.set_flags({"lazy_eager": False,
+                              "lazy_cache_entries": 256})
+
+    def test_recently_used_signature_survives_churn(self):
+        from paddle_tpu.ops import lazy
+        _flags.set_flags({"lazy_eager": True, "lazy_cache_entries": 3})
+        try:
+            hot = paddle.to_tensor(np.ones((2, 64), np.float32))
+            _ = ((hot + 1.0) * 2.0).numpy()
+            hot_sigs = set(lazy._SEG_CACHE)
+            for i in range(2):   # churn up to capacity, touching hot between
+                t = paddle.to_tensor(np.ones((2, 3 + i), np.float32))
+                _ = ((t + 1.0) * 2.0).numpy()
+                _ = ((hot + 1.0) * 2.0).numpy()    # refresh hot's recency
+            assert hot_sigs & set(lazy._SEG_CACHE), \
+                "LRU evicted the most recently used segment"
+        finally:
+            _flags.set_flags({"lazy_eager": False,
+                              "lazy_cache_entries": 256})
+
+    def test_shrinking_the_flag_evicts_immediately(self):
+        from paddle_tpu.ops import lazy
+        _flags.set_flags({"lazy_eager": True, "lazy_cache_entries": 8})
+        lazy._SEG_CACHE.clear()     # entries persist across tests
+        try:
+            for i in range(5):
+                t = paddle.to_tensor(np.ones((2, 40 + i), np.float32))
+                _ = ((t + 1.0) * 2.0).numpy()
+            assert len(lazy._SEG_CACHE) == 5
+            _flags.set_flags({"lazy_cache_entries": 2})
+            assert len(lazy._SEG_CACHE) <= 2
+        finally:
+            _flags.set_flags({"lazy_eager": False,
+                              "lazy_cache_entries": 256})
+
+
+# ---- serving bucket-pool gauge (satellite) ----------------------------------
+
+class TestServingBucketPool:
+    def test_stats_reports_bucket_pool_bytes(self, with_monitor):
+        from paddle_tpu.serving import EngineConfig, ServingEngine
+        eng = ServingEngine(lambda x: x,
+                            EngineConfig(max_batch_size=4,
+                                         batch_timeout_ms=1.0,
+                                         warmup_on_start=False))
+        fut = eng.submit([np.ones((1, 8), np.float32)])
+        eng.start()
+        fut.result(timeout=30)
+        eng.stop()
+        stats = eng.stats()
+        assert stats["bucket_pool_bytes"] > 0
+        gauges = monitor.snapshot()["gauges"]
+        assert gauges.get("serving.bucket_pool.bytes") == \
+            stats["bucket_pool_bytes"]
+
+
+# ---- overhead guard ---------------------------------------------------------
+
+class TestOverheadGuard:
+    def test_disabled_path_is_one_attribute_check(self):
+        """PR-1-style guard: with FLAGS_mem_census off, tag() returns
+        before touching the pytree and the registry stays empty — the hot
+        path pays one module-attribute load per seam."""
+        assert not _flags.flag("mem_census")
+        assert memory._ENABLED is False
+        big = [object()] * 64
+        assert memory.tag("params", big) == 0
+        assert memory._TAGS == {}
+
+        def loop_gated():
+            t0 = time.perf_counter()
+            for _ in range(100_000):
+                if memory._ENABLED:
+                    memory.tag("params", big)
+            return time.perf_counter() - t0
+
+        noop = (lambda: None)
+
+        def loop_base():
+            t0 = time.perf_counter()
+            for _ in range(100_000):
+                noop()
+            return time.perf_counter() - t0
+
+        loop_gated(), loop_base()   # warm both
+        t_gate = min(loop_gated() for _ in range(3))
+        t_base = min(loop_base() for _ in range(3))
+        assert t_gate < 3.0 * t_base + 0.05, (t_gate, t_base)
+
+    def test_disabled_step_registers_no_tags(self):
+        step, x, y = _make_linear_step()
+        for _ in range(2):
+            step(x, y)
+        assert memory._TAGS == {}
