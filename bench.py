@@ -484,6 +484,64 @@ def _lenet_lazy_ab(backend):
     return out
 
 
+def bench_warm_start(backend):
+    """Persistent compile-cache A/B: the SAME workload process spawned
+    twice against one `FLAGS_compile_cache_dir` — arm one starts with the
+    directory empty (every signature lowers, traces, compiles, and is
+    AOT-serialized to disk), arm two starts warm (every signature
+    deserializes a prior process's executable: zero trace_compile). Per
+    arm: time-to-first-train-step, time-to-first-inference (serving
+    bucket warm-up through the cache), and the compile/hit/miss/store
+    counters; plus the cold/warm speedups and a bit-identity check on the
+    train + serve output digests. Workload: tests/warm_start_runner.py
+    (LeNet TrainStep x2 + to_static predictor bucket warm-up).
+    Knob: BENCH_WARMSTART=ab|off (default ab)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    if os.environ.get("BENCH_WARMSTART", "ab").lower() == "off":
+        return {"skipped": "BENCH_WARMSTART=off"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "tests", "warm_start_runner.py")
+    cache_dir = tempfile.mkdtemp(prefix="bench_warmstart_")
+    out = {}
+    try:
+        for arm in ("cold", "warm"):
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [sys.executable, runner, cache_dir],
+                capture_output=True, text=True, timeout=600,
+                env={**os.environ, "JAX_PLATFORMS":
+                     "cpu" if backend != "tpu" else "tpu"})
+            wall_s = time.perf_counter() - t0
+            if proc.returncode != 0 or not proc.stdout.strip():
+                return {"error": f"{arm}: rc={proc.returncode}",
+                        "stderr_tail": proc.stderr[-400:]}
+            r = json.loads(proc.stdout.strip().splitlines()[-1])
+            cc = r["compile_cache"]
+            out[arm] = {
+                "t_first_train_s": round(r["t_first_train_s"], 3),
+                "t_first_infer_s": round(r["t_first_infer_s"], 3),
+                "process_wall_s": round(wall_s, 3),
+                "trace_compile": r["trace_compile"],
+                "cache_hits": cc["hits"],
+                "cache_misses": cc["misses"],
+                "cache_stores": cc["stores"],
+                "cache_fallbacks": cc["fallbacks"],
+                "_digests": (r["train_digest"], r["serve_digest"]),
+            }
+        cold, warm = out["cold"], out["warm"]
+        out["bit_identical"] = cold.pop("_digests") == warm.pop("_digests")
+        out["speedup_first_train"] = round(
+            cold["t_first_train_s"] / max(warm["t_first_train_s"], 1e-9), 3)
+        out["speedup_first_infer"] = round(
+            cold["t_first_infer_s"] / max(warm["t_first_infer_s"], 1e-9), 3)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return out
+
+
 def bench_flash_attention(backend):
     """Long-seq attention fwd+bwd: Pallas flash kernel vs fused-XLA path."""
     import jax
@@ -834,7 +892,8 @@ def main():
                     ("yoloe_infer", bench_yoloe_infer),
                     ("ocr_rec_infer", bench_ocr_rec_infer),
                     ("ernie10b_layer", bench_ernie10b_layer),
-                    ("allreduce_smoke", bench_allreduce)):
+                    ("allreduce_smoke", bench_allreduce),
+                    ("warm_start", bench_warm_start)):
         extra[key] = _run_workload(key, fn, backend, extra)
 
     lenet = extra.get("lenet_dispatch")

@@ -245,8 +245,21 @@ define_flag("mem_leak_window", 8,
             "mem leak watch: a tag whose census bytes grow strictly for "
             "this many consecutive censuses is flagged as a leak suspect "
             "(warning + mem.leak_suspects counter); 0 disables the check")
+# ---- executable plane (core/executable.py + core/compile_cache.py) --------
+define_flag("compile_cache_dir", "",
+            "persistent on-disk executable cache (core/compile_cache.py): "
+            "novel programs built through the Executable substrate are "
+            "AOT-serialized (jax.export) under a key of (canonical StableHLO "
+            "hash, topology fingerprint, jax version, relevant flags); a "
+            "second process running the same workload deserializes instead "
+            "of compiling (fleet warm start). Empty = off: every build site "
+            "pays one module-attribute check")
+define_flag("compile_cache_mb", 1024,
+            "compile cache: on-disk size cap in MB; least-recently-used "
+            "entries beyond it are evicted at store/gc time "
+            "(compile_cache.evictions counter)")
 define_flag("lazy_cache_entries", 256,
             "lazy eager: max cached segment replay executables "
-            "(ops/lazy.py _SEG_CACHE); least-recently-used entries are "
+            "(the ops/lazy.py executable ledger); least-recently-used entries are "
             "evicted beyond the cap (lazy.cache_evictions counter) instead "
             "of the cache growing without bound under shape churn")

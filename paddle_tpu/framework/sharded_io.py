@@ -39,14 +39,25 @@ def _crc(data: bytes) -> int:
     return zlib.crc32(data) & 0xFFFFFFFF
 
 
-def atomic_write(path: str, data: bytes) -> None:
+def atomic_write(path: str, data: bytes, unique_tmp: bool = False) -> None:
     """tmp + fsync + rename: the final name either holds the complete
     bytes or does not exist — a crash mid-write can never leave a
     half-written file under the committed name. Shared by the sharded
     checkpoint writer, `framework.io.save` (so `hapi.ModelCheckpoint`
-    can never leave a torn `.pdparams` behind a SIGKILL), and the guard
-    plane's loop-state checkpoints (`paddle_tpu.guard.checkpoint`)."""
-    tmp = path + ".tmp"
+    can never leave a torn `.pdparams` behind a SIGKILL), the guard
+    plane's loop-state checkpoints (`paddle_tpu.guard.checkpoint`), and
+    the persistent compile cache (`core/compile_cache.py`).
+
+    unique_tmp=True gives each writer its own tmp name (pid + thread id)
+    so CONCURRENT lock-free writers to the same committed name cannot
+    interleave inside one tmp file — whoever renames last wins, and both
+    candidate files were complete (the compile-cache write-race
+    contract)."""
+    if unique_tmp:
+        import threading
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    else:
+        tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()

@@ -170,7 +170,11 @@ class PredictorServer:
             conn.close()
 
     def stats(self):
-        """Engine health snapshot (what the 'PDHQ' wire probe returns)."""
+        """Engine health snapshot (what the 'PDHQ' wire probe returns):
+        queue/bucket/deadline counters plus `warm_start_ms` and the
+        `compile_cache` hit/miss stats, so a fleet dashboard can tell a
+        replica that warm-started from the persistent executable cache
+        from one that paid its own compiles."""
         return self.engine.stats()
 
     def stop(self, drain: bool = True):

@@ -20,6 +20,8 @@ import jax
 
 from .. import analysis as _analysis
 from .. import monitor as _monitor
+from ..core import compile_cache as _cc
+from ..core import executable as _exe
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from ..ops._dispatch import run_op
@@ -37,11 +39,13 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._jit_cache = {}
-        # signatures already traced (monitor retrace accounting): only a
-        # NOVEL signature is a recompile — alternating between two known
-        # shapes (e.g. the serving engine cycling batch buckets) replays
-        # jax.jit's cache and must not count as retraces
-        self._seen_sigs = set()
+        # executable substrate: only a NOVEL signature is a recompile —
+        # alternating between two known shapes (e.g. the serving engine
+        # cycling batch buckets) replays jax.jit's cache and must not
+        # count as retraces. The ledger also caches persistent-cache
+        # deserialized executables per signature, and its `current_sig`
+        # is the signature the published Program was built for.
+        self._ledger = _exe.ExecutableLedger("to_static")
         try:
             functools.update_wrapper(self, function)
         except Exception:
@@ -83,15 +87,29 @@ class StaticFunction:
             self._jit_cache[key] = pure
         return pure
 
-    def _get_jitted(self, training, pnames, bnames, static_kwargs):
+    def _get_jitted(self, training, pnames, bnames, static_kwargs,
+                    raw_key=False):
         key = ("jit", training, tuple(pnames), tuple(bnames),
-               tuple(sorted(static_kwargs.items())))
+               tuple(sorted(static_kwargs.items())), raw_key)
         jitted = self._jit_cache.get(key)
         if jitted is None:
             if _monitor._ENABLED:
                 _monitor.count("jit.to_static.cache_miss")
-            jitted = jax.jit(
-                self._get_pure(training, pnames, bnames, static_kwargs))
+            pure = self._get_pure(training, pnames, bnames, static_kwargs)
+            if raw_key:
+                # persistent-cache mode: jax.export cannot serialize
+                # typed PRNG key avals, so the exported program takes RAW
+                # key data and wraps at the boundary (same adapter as
+                # TrainStep._build)
+                base = pure
+
+                def pure(param_arrays, buffer_arrays, key_data,
+                         input_arrays):
+                    return base(param_arrays, buffer_arrays,
+                                jax.random.wrap_key_data(key_data),
+                                input_arrays)
+
+            jitted = jax.jit(pure)
             self._jit_cache[key] = jitted
         return jitted
 
@@ -150,31 +168,29 @@ class StaticFunction:
         n_p = len(ptensors)
         diff_inputs = ptensors + input_tensors
         arrays = [t._value for t in diff_inputs]
+        # persistent-cache mode rides the raw-key-data program variant
+        raw = _cc.enabled()
+        karg = jax.random.key_data(key) if raw else key
 
         # publish this capture as the default program (ProgramDesc role):
         # introspection/pruning lower lazily from the same traced callable.
         # Rebuilt only when the input signature changes (zero steady-state
         # cost on the hot path).
         sig = tuple((t._value.shape, str(t._value.dtype)) for t in diff_inputs)
-        if getattr(self, "_prog_sig", None) != sig:
+        # a NOVEL signature on a to_static capture = retrace: the whole
+        # program recompiles for the new shapes/dtypes. A previously-seen
+        # signature hits jax.jit's executable cache and is free — only
+        # the Program rebuild below runs.
+        novel = self._ledger.note(sig, detail=[f"{s}:{d}" for s, d in sig])
+        if self._ledger.current_sig != sig:
             if _analysis._ENABLED:
                 # trace-time tpu-lint: novel-signature block only, so the
                 # steady-state call path never reaches this check
                 _analysis.lint_traced(self._function, "to_static")
-            if sig not in self._seen_sigs:
-                # a NOVEL signature on a to_static capture = retrace: the
-                # whole program recompiles for the new shapes/dtypes. A
-                # previously-seen signature hits jax.jit's executable
-                # cache and is free — only the Program rebuild below runs.
-                if _monitor._ENABLED:
-                    _monitor.record_retrace(
-                        "to_static",
-                        [f"{s}:{d}" for s, d in sig],
-                        first=not self._seen_sigs)
-                self._seen_sigs.add(sig)
-            jitted = self._get_jitted(training, pnames, bnames, static_kwargs)
+            jitted = self._get_jitted(training, pnames, bnames,
+                                      static_kwargs, raw)
 
-            def fn(*arrs, _jit=jitted, _b=list(barrs), _k=key, _np=n_p):
+            def fn(*arrs, _jit=jitted, _b=list(barrs), _k=karg, _np=n_p):
                 return _jit(list(arrs[:_np]), _b, _k, list(arrs[_np:]))
 
             from ..static.program import Program, _set_default_program
@@ -182,7 +198,7 @@ class StaticFunction:
                      for t in diff_inputs]
             self._last_program = Program(fn, specs, name=getattr(
                 self._function, "__name__", "main"))
-            self._prog_sig = sig
+            self._ledger.current_sig = sig
             _set_default_program(self._last_program)
 
         import time as _time
@@ -191,12 +207,34 @@ class StaticFunction:
                   and any(not t.stop_gradient for t in diff_inputs)
                   and not any(isinstance(a, jax.core.Tracer) for a in arrays))
         if not record:
-            jitted = self._get_jitted(training, pnames, bnames, static_kwargs)
-            out = jitted(arrays[:n_p], barrs, key, arrays[n_p:])
+            jitted = self._get_jitted(training, pnames, bnames,
+                                      static_kwargs, raw)
+            csig = (sig, training, tuple(sorted(static_kwargs.items())), raw)
+            with _exe.booking("to_static") as bk:
+                call = self._ledger.get(csig)
+                if call is None:
+                    call = jitted
+                    if raw:
+                        call, source = _exe.acquire(
+                            "to_static", jitted,
+                            (arrays[:n_p], barrs, karg, arrays[n_p:]),
+                            label=getattr(self._function, "__name__",
+                                          "to_static"))
+                        self._ledger.put(csig, call)
+                        if novel and source == "fresh":
+                            bk.compiled()
+                    elif novel:
+                        bk.compiled()
+                elif novel:
+                    bk.compiled()
+                out = call(arrays[:n_p], barrs, karg, arrays[n_p:])
         else:
-            fwd_vjp = self._get_fwd_vjp(training, pnames, bnames,
-                                        static_kwargs, n_p)
-            out, raw_vjp = fwd_vjp(arrays, barrs, key)
+            with _exe.booking("to_static") as bk:
+                if novel:
+                    bk.compiled()
+                fwd_vjp = self._get_fwd_vjp(training, pnames, bnames,
+                                            static_kwargs, n_p)
+                out, raw_vjp = fwd_vjp(arrays, barrs, key)
         # arbitrary output pytrees (e.g. RNN layers return (out, (h, c))):
         # the tape stores flat leaf tensors; the vjp wrapper unflattens the
         # flat cotangents back to the traced structure

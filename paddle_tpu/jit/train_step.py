@@ -20,10 +20,11 @@ import jax
 import jax.numpy as jnp
 
 from .. import analysis as _analysis
-from .. import faults as _faults
 from .. import monitor as _monitor
 from .. import obs as _obs
 from ..obs import memory as _mem
+from ..core import compile_cache as _cc
+from ..core import executable as _exe
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from .functional import functional_call, split_state
@@ -65,9 +66,10 @@ class TrainStep:
         self._bnames = None
         # step(x..., y...): first n go to model.forward, the rest to loss_fn
         self._n_model_inputs = n_model_inputs
-        # batch signatures already traced (monitor retrace accounting): a
-        # novel (shape, dtype) signature means jax.jit recompiles the step
-        self._seen_sigs = set()
+        # executable substrate: signature ledger (novelty + retrace
+        # accounting) and per-signature cached callables (persistent-cache
+        # deserialized executables) — one implementation for all regimes
+        self._ledger = _exe.ExecutableLedger("train_step")
 
     def _build(self):
         from ..core import flags as _flags
@@ -148,10 +150,36 @@ class TrainStep:
                 body, (params, slots, rng_key, t), (list(inputs), list(labels)))
             return params, slots, losses, key, t, bads
 
+        # Persistent-cache mode: jax.export cannot serialize typed PRNG
+        # key avals, so when the compile cache is on the step program
+        # takes/returns RAW key data (uint32) and wraps/unwraps at the
+        # program boundary — numerics identical, program exportable.
+        self._raw_key = _cc.enabled()
+        if self._raw_key:
+            base_pure, base_scan = pure, pure_scan
+
+            def pure(params, slots, buffers, key_data, lr, t, inputs, labels):
+                new_params, new_slots, loss, carry, t1, bad = base_pure(
+                    params, slots, buffers,
+                    jax.random.wrap_key_data(key_data), lr, t, inputs, labels)
+                return (new_params, new_slots, loss,
+                        jax.random.key_data(carry), t1, bad)
+
+            def pure_scan(params, slots, buffers, key_data, lr, t,
+                          inputs, labels):
+                new_params, new_slots, losses, carry, t1, bads = base_scan(
+                    params, slots, buffers,
+                    jax.random.wrap_key_data(key_data), lr, t, inputs, labels)
+                return (new_params, new_slots, losses,
+                        jax.random.key_data(carry), t1, bads)
+
         donate = (0, 1, 3, 5) if self._donate else ()
+        self._donate_argnums = donate
         self._jitted = jax.jit(pure, donate_argnums=donate)
         self._jitted_scan = jax.jit(pure_scan, donate_argnums=donate)
         self._key = rnd.default_generator().next_key()
+        if self._raw_key:
+            self._key = jax.random.key_data(self._key)
         self._t_arr = jnp.asarray(float(self.optimizer._step_count + 1),
                                   jnp.float32)
         self._lr_val = None
@@ -194,52 +222,63 @@ class TrainStep:
         if lr_val != self._lr_val:
             self._lr_val = lr_val
             self._lr_arr = jnp.asarray(lr_val, jnp.float32)
-        novel = False
-        if _monitor._ENABLED or _obs._TL_ENABLED:
+        novel, sig = False, None
+        if _monitor._ENABLED or _obs._TL_ENABLED or _cc.enabled():
             # retrace accounting: the jitted step recompiles for every novel
             # batch signature — the dominant TPU perf hazard. The signature
             # that caused each retrace is logged for diagnosis (and the
-            # timeline books the compile under trace_compile).
+            # timeline books the compile under trace_compile). The ledger
+            # also keys the persistent-cache callables per signature.
             sig = _monitor.arg_signature(arrs)
-            if sig not in self._seen_sigs:
-                novel = True
-                if _monitor._ENABLED:
-                    _monitor.record_retrace("train_step", sig,
-                                            first=not self._seen_sigs)
-                self._seen_sigs.add(sig)
-        return params, buffers, arrs[:n_mi], arrs[n_mi:], novel
+            novel = self._ledger.note(sig)
+        return params, buffers, arrs[:n_mi], arrs[n_mi:], novel, sig
 
     def __call__(self, *batch):
         """batch: input tensors consumed by model.forward; loss_fn receives the
         model output(s) — close labels into loss_fn or pass them as model inputs.
         """
         with _obs.step_record():
-            params, buffers, inputs, labels, novel = self._prepare(batch)
+            params, buffers, inputs, labels, novel, sig = self._prepare(batch)
             _mon = _monitor._ENABLED
             if _mon:
                 _t0 = _time.time()
             _tl = _obs._TL_ENABLED
-            with _obs.phase("trace_compile" if novel else "device_compute"):
-                try:
-                    if _faults._ENABLED:
-                        # OOM forensics drill site: the injected fault's
-                        # message matches memory._OOM_MARKERS, so the except
-                        # path below exercises the real RESOURCE_EXHAUSTED
-                        # dump machinery without needing to exhaust HBM
-                        _faults.check("mem.alloc")
-                    new_params, self._slots, loss, self._key, self._t_arr, \
-                        bad = self._jitted(params, self._slots, buffers,
-                                           self._key, self._lr_arr,
-                                           self._t_arr, inputs, labels)
-                except Exception as e:
-                    _mem.maybe_dump_oom(
-                        e, executable="TrainStep",
+            with _exe.booking("train_step") as bk:
+                call = self._jitted
+                if sig is not None:
+                    cached = self._ledger.get(sig)
+                    if cached is not None:
+                        call = cached
+                    elif novel:
+                        if _cc.enabled():
+                            # persistent-cache build step: a prior
+                            # process's serialized executable (zero
+                            # compiles here), or export+persist ours
+                            args = (params, self._slots, buffers,
+                                    self._key, self._lr_arr, self._t_arr,
+                                    inputs, labels)
+                            call, source = _exe.acquire(
+                                "train_step", self._jitted, args,
+                                donate=self._donate_argnums,
+                                label="TrainStep")
+                            self._ledger.put(sig, call)
+                            if source == "fresh":
+                                bk.compiled()
+                        else:
+                            bk.compiled()
+                # OOM forensics drill site (`mem.alloc`) + the
+                # RESOURCE_EXHAUSTED dump on the way out of a failure
+                with _exe.dispatch_guard(
+                        "TrainStep",
                         report=lambda: _obs.executable_memory(
                             self._jitted.lower(
                                 params, self._slots, buffers, self._key,
                                 self._lr_arr, self._t_arr, inputs,
-                                labels).compile()))
-                    raise
+                                labels).compile())):
+                    new_params, self._slots, loss, self._key, self._t_arr, \
+                        bad = call(params, self._slots, buffers,
+                                   self._key, self._lr_arr,
+                                   self._t_arr, inputs, labels)
                 if _tl:
                     # fence: on an async backend the dispatch above returns
                     # before the chip finishes; without this the device time
@@ -267,7 +306,7 @@ class TrainStep:
         the same cache as __call__ for an already-dispatched signature.
         bench.py uses it to report *attributed* MFU — the compiler-counted
         FLOPs over measured step time — next to the formula-derived one."""
-        params, buffers, inputs, labels, _ = self._prepare(batch)
+        params, buffers, inputs, labels, _, _sig = self._prepare(batch)
         lowered = self._jitted.lower(params, self._slots, buffers, self._key,
                                      self._lr_arr, self._t_arr, inputs,
                                      labels)
@@ -280,7 +319,7 @@ class TrainStep:
         lower().compile().memory_analysis() (obs/memory.py). temp_bytes is
         the number OOM forensics cares about — the scratch HBM the step
         needs ON TOP of the live buffers the census can see."""
-        params, buffers, inputs, labels, _ = self._prepare(batch)
+        params, buffers, inputs, labels, _, _sig = self._prepare(batch)
         lowered = self._jitted.lower(params, self._slots, buffers, self._key,
                                      self._lr_arr, self._t_arr, inputs,
                                      labels)
@@ -309,7 +348,8 @@ class TrainStep:
                        for n, t in zip(self._pnames, self._ptensors)},
             "slots": [{k: np_.asarray(v) for k, v in s.items()}
                       for s in self._slots],
-            "rng_key": np_.asarray(jax.random.key_data(self._key)),
+            "rng_key": np_.asarray(self._key if self._raw_key
+                                   else jax.random.key_data(self._key)),
             "t": np_.asarray(self._t_arr),
             "step_count": int(self.optimizer._step_count),
         }
@@ -323,7 +363,9 @@ class TrainStep:
                 t._value = jnp.asarray(params[n])
         self._slots = [{k: jnp.asarray(v) for k, v in s.items()}
                        for s in sd["slots"]]
-        self._key = jax.random.wrap_key_data(jnp.asarray(sd["rng_key"]))
+        key_arr = jnp.asarray(sd["rng_key"])
+        self._key = key_arr if self._raw_key \
+            else jax.random.wrap_key_data(key_arr)
         self._t_arr = jnp.asarray(sd["t"], jnp.float32)
         self.optimizer._step_count = int(sd["step_count"])
         self._lr_val = None  # force the lr-array cache to refresh
@@ -337,7 +379,7 @@ class TrainStep:
         history as a Tensor. One host dispatch + one sync per span instead
         of per step — the eager/tunnel dispatch tax disappears.
         """
-        params, buffers, inputs, labels, _novel = self._prepare(batch)
+        params, buffers, inputs, labels, _novel, _sig = self._prepare(batch)
         n_steps = int(inputs[0].shape[0]) if inputs else int(labels[0].shape[0])
         new_params, self._slots, losses, self._key, self._t_arr, bads = \
             self._jitted_scan(params, self._slots, buffers, self._key,

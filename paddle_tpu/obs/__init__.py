@@ -152,6 +152,15 @@ def step_record():
     return tl.step_record()
 
 
+def in_phase() -> bool:
+    """True when the calling thread is already inside an open `phase()`
+    context. `core/executable.py` uses this to book a dispatch exactly
+    once: an inner site that finds itself nested skips opening a second
+    phase (the enclosing one already owns the wall time)."""
+    from .timeline import thread_phase_depth
+    return thread_phase_depth() > 0
+
+
 def add_phase(name: str, dur: float, t0=None, t1=None) -> None:
     tl = _TIMELINE
     if tl is not None and _TL_ENABLED:

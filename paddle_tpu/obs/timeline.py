@@ -60,6 +60,18 @@ PHASES = ("data_wait", "h2d", "prefetch_h2d", "build", "trace_compile",
 
 _MAX_SPANS_PER_STEP = 128
 
+# Per-thread count of open `with phase(...)` contexts. The executable
+# substrate (core/executable.py) reads this to suppress a nested booking:
+# when a dispatch site already sits inside an enclosing phase (a lazy
+# flush inside a TrainStep's device_compute, say), opening a second
+# phase would book the same wall time twice and break the
+# phase-sum≈wall invariant.
+_PHASE_TLS = threading.local()
+
+
+def thread_phase_depth() -> int:
+    return getattr(_PHASE_TLS, "depth", 0)
+
 
 class _NullCtx:
     """Shared no-op context: disabled phase()/step_record() allocate nothing."""
@@ -176,12 +188,14 @@ class StepTimeline:
         return _Phase(self, name)
 
     def _enter_phase(self, name: str, t0: float) -> int:
+        _PHASE_TLS.depth = getattr(_PHASE_TLS, "depth", 0) + 1
         with self._lock:
             self._next_token += 1
             self._open_spans[self._next_token] = (name, t0)
             return self._next_token
 
     def _exit_phase(self, token: int, name: str, t0: float, t1: float) -> None:
+        _PHASE_TLS.depth = max(0, getattr(_PHASE_TLS, "depth", 1) - 1)
         with self._lock:
             self._open_spans.pop(token, None)
         self.add_phase(name, t1 - t0, t0, t1)

@@ -31,8 +31,8 @@ lets the immediate path run the op, preserving eager semantics bit-for-bit.
 Accounting (FLAGS_monitor): ``lazy.ops_deferred``, ``lazy.flushes``,
 ``lazy.dispatches``, ``lazy.ops_flushed``, ``lazy.cache_hits``,
 ``lazy.fallback_ops``, plus ``jit.lazy_segment.traces``/``.retraces``
-via ``monitor.record_retrace`` (same regime as
-``jit/train_step.py:_seen_sigs``). Observability: each flush is booked on
+via ``monitor.record_retrace`` (the shared ``core/executable.py``
+ledger regime). Observability: each flush is booked on
 the step timeline as one ``trace_compile`` (novel signature) or
 ``device_compute`` (cache hit) phase — not smeared per-op.
 """
@@ -51,6 +51,8 @@ from .. import monitor as _monitor
 from .. import obs as _obs
 from ..obs import memory as _mem
 from ..core import autograd
+from ..core import compile_cache as _cc
+from ..core import executable as _exe
 from ..core import flags as _flags
 from ..core import tensor as _tensor_mod
 from ..core.tensor import Tensor
@@ -154,34 +156,27 @@ class _Record:
         self.nan_check = nan_check  # FLAGS_check_nan_inf was on at defer
 
 
-# ---- segment signature cache (train_step._seen_sigs regime) ---------------
+# ---- segment signature cache (executable-substrate ledger) ----------------
 # LRU-ordered: a flush hit moves the signature to the MRU end, overflow
 # evicts from the LRU end one entry at a time (the old wholesale .clear()
 # threw away every hot replay whenever one workload overflowed the cap).
-_SEG_CACHE: "OrderedDict" = OrderedDict()
-_SEG_SEEN: set = set()
-_SEG_CACHE_CAP: int = int(_flags.flag("lazy_cache_entries"))
-cache_evictions: int = 0   # process-lifetime total (tests/introspection)
+# Replaces the private _SEG_CACHE/_SEG_SEEN pair with the shared
+# core/executable.py ledger; the monitor eviction counter keeps its name.
+
+
+def _count_eviction(_sig, _replay) -> None:
+    if _monitor._ENABLED:
+        _monitor.count("lazy.cache_evictions")
+
+
+_LEDGER = _exe.ExecutableLedger(
+    "lazy_segment",
+    cap=max(1, int(_flags.flag("lazy_cache_entries"))),
+    on_evict=_count_eviction)
 
 
 def _on_cache_entries(value) -> None:
-    global _SEG_CACHE_CAP
-    _SEG_CACHE_CAP = max(1, int(value))
-    _evict_segments()
-
-
-def _evict_segments() -> None:
-    """Trim the replay cache to the cap from the LRU end, counting
-    `lazy.cache_evictions`."""
-    global cache_evictions
-    n = 0
-    while len(_SEG_CACHE) > _SEG_CACHE_CAP:
-        _SEG_CACHE.popitem(last=False)
-        n += 1
-    if n:
-        cache_evictions += n
-        if _monitor._ENABLED:
-            _monitor.count("lazy.cache_evictions", n)
+    _LEDGER.set_cap(max(1, int(value)))
 
 
 _flags.watch_flag("lazy_cache_entries", _on_cache_entries)
@@ -256,7 +251,7 @@ def segment_memory() -> List[dict]:
     its leaf avals, so the replays AOT-lower without live inputs."""
     from .. import obs as _obs_pkg
     out = []
-    for sig, replay in list(_SEG_CACHE.items()):
+    for sig, replay in _LEDGER.items():
         structs = [jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt),
                                         weak_type=wt)
                    for shape, dt, wt in sig[1]]
@@ -321,7 +316,7 @@ class LazySegment:
     identity); each record's inputs are bindings into the leaf list or
     into an earlier record's outputs, so the whole segment replays as a
     pure function of the leaves — compiled once per (op-sequence, leaf
-    signature) and re-dispatched from `_SEG_CACHE` thereafter.
+    signature) and re-dispatched from the module segment ledger thereafter.
     """
 
     __slots__ = ("records", "leaves", "leaf_ids", "_flushing")
@@ -403,29 +398,38 @@ class LazySegment:
                    tuple((tuple(a.shape), str(a.dtype),
                           bool(getattr(a, "weak_type", False)))
                          for a in leaves))
-            replay = _SEG_CACHE.get(sig)
-            novel = sig not in _SEG_SEEN
+            replay = _LEDGER.get(sig)
+            novel = not _LEDGER.seen(sig)
             if _monitor._ENABLED:
                 _monitor.count("lazy.flushes")
                 _monitor.count("lazy.dispatches")
                 _monitor.count("lazy.ops_flushed", len(records))
-                if novel:
-                    _monitor.record_retrace(
-                        "lazy_segment",
-                        (f"ops={len(records)}",) + _monitor.arg_signature(
-                            leaves),
-                        first=not _SEG_SEEN)
-                else:
+                if not novel:
                     _monitor.count("lazy.cache_hits")
             if novel:
-                _SEG_SEEN.add(sig)
-            if replay is None:
-                replay = _SEG_CACHE[sig] = _build_replay(records)
-                if len(_SEG_CACHE) > _SEG_CACHE_CAP:
-                    _evict_segments()
-            else:
-                _SEG_CACHE.move_to_end(sig)
-            with _obs.phase("trace_compile" if novel else "device_compute"):
+                _LEDGER.note(sig, detail=(
+                    (f"ops={len(records)}",)
+                    + _monitor.arg_signature(leaves))
+                    if _monitor._ENABLED else None)
+            with _exe.booking("lazy_segment") as bk:
+                if replay is None:
+                    replay = _build_replay(records)
+                    source = "fresh"
+                    if _cc.enabled() and all(
+                            r.kind in ("primal", "nondiff")
+                            for r in records):
+                        # only sync-free segments persist: a diff segment's
+                        # replay returns jax.vjp closures, which the export
+                        # path cannot serialize (they'd count export_skips
+                        # for every flush — skip upfront instead)
+                        replay, source = _exe.acquire(
+                            "lazy_segment", replay, (leaves,),
+                            label=f"ops={len(records)}")
+                    _LEDGER.put(sig, replay)
+                    if novel and source == "fresh":
+                        bk.compiled()
+                elif novel:
+                    bk.compiled()
                 out_groups, vjp_raws = replay(leaves)
             if _mem._ENABLED:
                 _mem.tag("lazy_segment",

@@ -24,9 +24,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import faults as _faults
+from .. import monitor as _monitor
 from .. import obs as _obs
 from ..obs import memory as _mem
+from ..core import compile_cache as _cc
+from ..core import executable as _exe
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from ..jit.functional import functional_call, split_state
@@ -82,6 +84,10 @@ class SPMDTrainStep:
         self._lr_host = None
         self._t_arr = None
         self._t_host = None
+        # executable substrate: batch-signature ledger (novelty + retrace
+        # accounting — previously only the FIRST build was attributed) and
+        # per-signature persistent-cache callables
+        self._ledger = _exe.ExecutableLedger("spmd_train_step")
 
     # ---- sharding policies ----
     def _data_axes(self):
@@ -244,9 +250,18 @@ class SPMDTrainStep:
                   ns(P()) if nan_check else None)
         # donate params (0), slots (1) and the t carry (5)
         donate = (0, 1, 5) if self._donate else ()
-        self._jitted = jax.jit(pure, in_shardings=in_sh, out_shardings=out_sh,
-                               donate_argnums=donate)
-        self._pure = pure   # unjitted body: collective_signature/tpu-lint
+        self._donate_argnums = donate
+        self._pure = pure   # unjitted typed-key body: collective_signature
+        # persistent-cache mode: raw-key-data program boundary (jax.export
+        # cannot serialize typed PRNG key avals — TrainStep._build regime)
+        self._raw_key = _cc.enabled()
+        jit_pure = pure
+        if self._raw_key:
+            def jit_pure(params, slots, buffers, key_data, lr, t, batch):
+                return pure(params, slots, buffers,
+                            jax.random.wrap_key_data(key_data), lr, t, batch)
+        self._jitted = jax.jit(jit_pure, in_shardings=in_sh,
+                               out_shardings=out_sh, donate_argnums=donate)
         self._pspecs = pspecs
         self._sspecs = sspecs
         from .. import analysis as _analysis
@@ -403,28 +418,50 @@ class SPMDTrainStep:
             params = [trainable[n]._value for n in self._pnames]
             buffers = [frozen[n]._value for n in self._bnames]
             key = rnd.default_generator().next_key()
+            if self._raw_key:
+                key = jax.random.key_data(key)
             lr = self._lr_scalar()
             t = self._t_scalar()
             if _mem._ENABLED:
                 _mem.tag("activations", arrs, origin="SPMDTrainStep.batch")
+            sig, novel = None, first
+            if _monitor._ENABLED or _obs._TL_ENABLED or _cc.enabled():
+                sig = _monitor.arg_signature(arrs)
+                novel = self._ledger.note(sig)
             # GSPMD folds the collectives INTO the executable, so the
             # timeline cannot fence them apart from compute here — the
             # device_compute phase is the whole sharded step; explicit
             # eager collectives (parallel/collective.py) get their own
             # `collective` phase.
-            with _obs.phase("trace_compile" if first else "device_compute"):
-                try:
-                    if _faults._ENABLED:
-                        _faults.check("mem.alloc")
-                    new_params, self._slots, loss, new_t, bad = self._jitted(
-                        params, self._slots, buffers, key, lr, t, arrs)
-                except Exception as e:
-                    _mem.maybe_dump_oom(
-                        e, executable="SPMDTrainStep",
+            with _exe.booking("spmd_train_step") as bk:
+                call = self._jitted
+                if sig is not None:
+                    cached = self._ledger.get(sig)
+                    if cached is not None:
+                        call = cached
+                    elif novel:
+                        if _cc.enabled():
+                            call, source = _exe.acquire(
+                                "spmd_train_step", self._jitted,
+                                (params, self._slots, buffers, key, lr, t,
+                                 arrs),
+                                donate=self._donate_argnums,
+                                label="SPMDTrainStep",
+                                mesh_shape=dict(self.mesh.shape))
+                            self._ledger.put(sig, call)
+                            if source == "fresh":
+                                bk.compiled()
+                        else:
+                            bk.compiled()
+                elif first:
+                    bk.compiled()
+                with _exe.dispatch_guard(
+                        "SPMDTrainStep",
                         report=lambda: _obs.executable_memory(
                             self._jitted.lower(params, self._slots, buffers,
-                                               key, lr, t, arrs).compile()))
-                    raise
+                                               key, lr, t, arrs).compile())):
+                    new_params, self._slots, loss, new_t, bad = call(
+                        params, self._slots, buffers, key, lr, t, arrs)
                 if _obs._TL_ENABLED:
                     jax.block_until_ready(loss)
             # commit before the debug raise — old buffers were donated

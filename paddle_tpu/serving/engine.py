@@ -37,6 +37,8 @@ from .. import faults as _faults
 from .. import monitor as _monitor
 from .. import obs as _obs
 from ..obs import memory as _mem
+from ..core import compile_cache as _cc
+from ..core import executable as _exe
 from ..core import flags as _flags
 from .bucket import BucketSet, ShapeBucket, default_batch_sizes, signature_of
 
@@ -185,7 +187,12 @@ class ServingEngine:
         self._stopping = False
         self._workers: List[threading.Thread] = []
         self._dispatch_lock = threading.Lock()
-        self._dispatched_sigs = set()   # (batch, item-sig) seen → compiles
+        # executable substrate: (batch, item-sig) ledger — novel → compiles.
+        # The predictor's own to_static capture owns retrace accounting and
+        # the persistent-cache hookup; the engine ledger keeps the serving-
+        # local compile/pool bookkeeping (retrace=False at note()).
+        self._ledger = _exe.ExecutableLedger("serving_bucket")
+        self._warm_start_ms: Optional[float] = None
         self._counts: Dict[str, int] = {
             "requests": 0, "completed": 0, "failed": 0, "rejected": 0,
             "expired": 0, "batches": 0, "rows": 0, "padded_rows": 0,
@@ -223,7 +230,14 @@ class ServingEngine:
 
     def warmup(self) -> int:
         """Run the predictor once per (bucket, batch size) on zeros so
-        steady-state serving never compiles. Returns runs performed."""
+        steady-state serving never compiles. Returns runs performed.
+
+        With `FLAGS_compile_cache_dir` set the predictor's capture rides
+        the persistent executable cache, so a replica whose programs a
+        prior process already compiled warms in deserialize time instead
+        of compile time — `stats()["warm_start_ms"]` plus the
+        `compile_cache` hit/miss counters tell a router which one it got."""
+        t0 = time.time()
         runs = 0
         for bucket in self.buckets.buckets():
             for bs in bucket.batch_sizes:
@@ -232,9 +246,11 @@ class ServingEngine:
                                                bucket.dtypes)]
                 self._dispatch_to_predictor(bucket, bs, arrays)
                 runs += 1
+        self._warm_start_ms = (time.time() - t0) * 1000.0
         self._bump("warmup_runs", runs)
         if _monitor._ENABLED and runs:
             _monitor.count("serving.warmup_runs", runs)
+            _monitor.gauge_set("serving.warm_start_ms", self._warm_start_ms)
         return runs
 
     # ---- lifecycle ----
@@ -452,10 +468,9 @@ class ServingEngine:
     def _dispatch_to_predictor(self, bucket: ShapeBucket, bs: int,
                                arrays: List[np.ndarray]) -> List[np.ndarray]:
         sig = (bs,) + bucket.key()
-        if sig not in self._dispatched_sigs:
+        if self._ledger.note(sig, retrace=False):
             # first time this padded signature reaches the predictor = one
             # XLA compile; in steady state this never fires (warmed up)
-            self._dispatched_sigs.add(sig)
             self._bump("compiles")
             if _monitor._ENABLED:
                 _monitor.count("serving.compiles")
@@ -468,7 +483,12 @@ class ServingEngine:
                 # (via _dispatch's error path) — the engine itself keeps
                 # serving; chaos runs verify exactly that containment
                 _faults.check("serving.dispatch")
-            with _monitor.span("serving.predict"):
+            # booking only (no compiled() here): the predictor's inner
+            # to_static capture counts the actual trace_compile; a nested
+            # booking there suppresses its phase, so the wall time books
+            # exactly once
+            with _exe.booking("serving_bucket"), \
+                    _monitor.span("serving.predict"):
                 return [np.asarray(o) for o in self._call(arrays)]
 
     def _fail_batch(self, live: List[_Request], err: BaseException) -> None:
@@ -513,7 +533,7 @@ class ServingEngine:
         serving re-feeds. Gauged as `serving.bucket_pool.bytes`; the mem
         census' `serving_bucket` tag covers the live output side."""
         total = 0
-        for sig in list(self._dispatched_sigs):
+        for sig in self._ledger.seen_sigs():
             bs = int(sig[0])
             for shape, dt in sig[1:]:
                 elems = int(np.prod(shape)) if shape else 1
@@ -540,4 +560,9 @@ class ServingEngine:
             "buckets": [b.describe() for b in self.buckets.buckets()],
             "bucket_pool_bytes": pool_bytes,
             "counters": counts,
+            # cold/warm replica discrimination for routers: how long this
+            # replica's bucket warm-up took and whether its executables
+            # came off disk (hits) or compiled fresh (misses)
+            "warm_start_ms": self._warm_start_ms,
+            "compile_cache": _cc.stats(),
         }

@@ -801,7 +801,11 @@ class TestSelfLint:
              # themselves retain per-step buffers or sync in hot loops
              os.path.join(PKG, "serving", "engine.py"),
              os.path.join(PKG, "guard", "supervisor.py"),
-             os.path.join(PKG, "device", "__init__.py")],
+             os.path.join(PKG, "device", "__init__.py"),
+             # executable substrate + persistent compile cache (ISSUE
+             # 11): every dispatch regime rides these on the hot path
+             os.path.join(PKG, "core", "executable.py"),
+             os.path.join(PKG, "core", "compile_cache.py")],
             all_functions=True)
         assert n_files > 25
         assert findings == [], "\n".join(f.format() for f in findings)

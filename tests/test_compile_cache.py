@@ -1,0 +1,307 @@
+"""Persistent compile cache (paddle_tpu.core.compile_cache).
+
+Acceptance properties (ISSUE 11): a warm second process performs ZERO
+compiles (`trace_compile == 0`, `compile_cache.hits >= 2`) and produces
+bit-identical outputs; corrupt, torn (fault site `compile_cache.write`),
+stale-jax-version, and wrong-topology entries degrade to a fresh compile
+(`fallbacks` counted, never an error) and are pruned; two concurrent
+writer processes race lock-free to a consistent directory; the disk
+footprint is an LRU capped by `FLAGS_compile_cache_mb`; donation
+guarantees hold for both the fresh-store and the disk-hit dispatch
+paths.
+"""
+import json
+import os
+import subprocess
+import sys
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import faults, monitor
+from paddle_tpu.core import compile_cache as cc
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.jit.train_step import TrainStep
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUNNER = os.path.join(HERE, "warm_start_runner.py")
+
+
+# ---- fixtures / helpers -----------------------------------------------------
+
+@pytest.fixture
+def cache_on(tmp_path):
+    d = str(tmp_path / "cc")
+    _flags.set_flags({"compile_cache_dir": d})
+    cc.reset_stats()
+    yield d
+    _flags.set_flags({"compile_cache_dir": ""})
+    cc.reset_stats()
+
+
+@pytest.fixture(autouse=True)
+def _no_cache_leak():
+    yield
+    leaked = bool(_flags.flag("compile_cache_dir"))
+    if leaked:
+        _flags.set_flags({"compile_cache_dir": ""})
+    assert not leaked, "compile_cache_dir leaked out of the test"
+
+
+def _store_one(key="k" * 40, blob=b"executable-bytes", **kw):
+    assert cc.store(key, blob, kind="test", label="t", **kw)
+    return key, blob
+
+
+def _doctor_manifest(d, key, **fields):
+    mpath = os.path.join(d, key + ".json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest.update(fields)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+
+
+def _run_runner(cache_dir, timeout=300):
+    proc = subprocess.run(
+        [sys.executable, RUNNER, str(cache_dir)],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+# ---- key anatomy ------------------------------------------------------------
+
+class TestCacheKey:
+    def test_canonicalization_ignores_loc_metadata(self, cache_on):
+        a = "module {\n  loc(\"x.py\":1)\n  %0 = foo  \n}"
+        b = "module {\n  %0 = foo\n}"
+        assert cc.cache_key(a) == cc.cache_key(b)
+
+    def test_key_varies_with_program_topology_and_extra(self, cache_on):
+        base = cc.cache_key("module A")
+        assert cc.cache_key("module B") != base
+        assert cc.cache_key("module A", mesh_shape={"dp": 8}) != base
+        assert cc.cache_key("module A", extra=("train_step",)) != base
+
+    def test_disabled_by_default(self):
+        assert not cc.enabled()
+
+
+# ---- store / lookup / fallback ----------------------------------------------
+
+class TestStoreLookup:
+    def test_roundtrip_counts_hit_and_stamps_lru(self, cache_on):
+        key, blob = _store_one()
+        assert cc.lookup(key) == blob
+        assert cc.hits == 1 and cc.stores == 1
+        rows = cc.entries(cache_on)
+        assert len(rows) == 1 and rows[0]["hits"] == 1
+
+    def test_missing_key_is_a_plain_miss_not_a_fallback(self, cache_on):
+        assert cc.lookup("f" * 40) is None
+        assert cc.fallbacks == 0
+
+    def test_corrupt_blob_falls_back_and_prunes(self, cache_on):
+        key, blob = _store_one()
+        bpath = os.path.join(cache_on, key + ".bin")
+        with open(bpath, "wb") as f:
+            f.write(blob[:-1] + b"\xff")
+        assert cc.lookup(key) is None
+        assert cc.fallbacks == 1
+        assert not os.path.exists(bpath)          # pruned
+        assert cc.lookup(key) is None             # now a plain miss
+        assert cc.fallbacks == 1
+
+    def test_stale_jax_version_falls_back(self, cache_on):
+        key, _ = _store_one()
+        _doctor_manifest(cache_on, key, jax_version="0.0.1")
+        # CRC still matches: the version gate itself must reject
+        assert cc.lookup(key) is None
+        assert cc.fallbacks == 1
+
+    def test_wrong_topology_falls_back(self, cache_on):
+        key, _ = _store_one()
+        _doctor_manifest(cache_on, key, topology="tpu-v9x8192")
+        assert cc.lookup(key) is None
+        assert cc.fallbacks == 1
+
+    def test_blob_without_manifest_falls_back(self, cache_on):
+        key, _ = _store_one()
+        os.remove(os.path.join(cache_on, key + ".json"))
+        assert cc.lookup(key) is None
+        assert cc.fallbacks == 1
+
+    def test_torn_write_fault_is_detected_on_lookup(self, cache_on):
+        """THE fault drill: a torn write at site `compile_cache.write`
+        persists mangled bytes under a manifest whose CRC covers the
+        INTENDED bytes — the next lookup must catch it, count a
+        fallback, and never raise."""
+        with faults.inject("compile_cache.write:torn"):
+            key, _ = _store_one(blob=b"x" * 1024)
+        torn = os.path.getsize(os.path.join(cache_on, key + ".bin"))
+        assert torn == 512                        # the write really tore
+        assert cc.lookup(key) is None
+        assert cc.fallbacks == 1
+        assert cc.stats()["fallbacks"] == 1
+
+
+# ---- LRU gc / verify --------------------------------------------------------
+
+class TestGcVerify:
+    def test_gc_evicts_lru_first_down_to_cap(self, cache_on):
+        for i, key in enumerate(("a" * 40, "b" * 40, "c" * 40)):
+            cc.store(key, bytes([i]) * (512 * 1024), kind="test")
+            _doctor_manifest(cache_on, key, last_used=1000.0 + i)
+        evicted = cc.gc(cache_on, cap_mb=0.6)
+        assert evicted == ["a" * 40, "b" * 40]    # LRU order
+        assert [r["key"] for r in cc.entries(cache_on)] == ["c" * 40]
+        assert cc.evictions == 2
+
+    def test_store_enforces_flag_cap(self, cache_on):
+        _flags.set_flags({"compile_cache_mb": 1})
+        try:
+            for key in ("d" * 40, "e" * 40, "f" * 40):
+                cc.store(key, b"z" * (700 * 1024), kind="test")
+            assert len(cc.entries(cache_on)) == 1
+        finally:
+            _flags.set_flags({"compile_cache_mb": 1024})
+
+    def test_verify_prunes_only_corrupt_entries(self, cache_on):
+        good, _ = _store_one(key="1" * 40)
+        bad, blob = _store_one(key="2" * 40)
+        with open(os.path.join(cache_on, bad + ".bin"), "wb") as f:
+            f.write(b"garbage")
+        ok, pruned = cc.verify(cache_on)
+        assert ok == 1 and pruned == [bad]
+        assert [r["key"] for r in cc.entries(cache_on)] == [good]
+
+
+# ---- cached-mode donation audit ---------------------------------------------
+
+def _linear_step(seed=0):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+    step = TrainStep(net, nn.MSELoss(), opt, n_model_inputs=1)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.rand(8, 4).astype("float32"))
+    y = paddle.to_tensor(rng.rand(8, 1).astype("float32"))
+    return step, x, y
+
+
+class TestCachedDonation:
+    def test_donation_holds_on_fresh_store_and_disk_hit(self, cache_on):
+        """Donation must survive BOTH cached-mode dispatch paths: the
+        cold process (fresh jit, export+store) and the warm one (the
+        deserialized export re-wrapped with the regime's declared
+        donate_argnums). A silently-failed donation doubles steady-state
+        HBM exactly where the fleet runs warm."""
+        losses = {}
+        for arm in ("fresh_store", "disk_hit"):
+            step, x, y = _linear_step()
+            step(x, y)
+            donated = [t._value for t in step._ptensors]
+            loss = step(x, y)
+            losses[arm] = float(loss)
+            for i, a in enumerate(donated):
+                assert a.is_deleted(), \
+                    f"{arm}: donated param {i} survived dispatch"
+        assert losses["fresh_store"] == losses["disk_hit"]
+        assert cc.stores >= 1 and cc.hits >= 1
+
+    def test_rng_key_stream_identical_through_cache(self, cache_on):
+        """The raw-key-data adapter (typed PRNG keys cannot export) must
+        not change the dropout/rng stream: per-step losses through the
+        disk-hit path equal the fresh path bit for bit."""
+        ref = []
+        _flags.set_flags({"compile_cache_dir": ""})
+        step, x, y = _linear_step()
+        ref = [float(step(x, y)) for _ in range(3)]
+        _flags.set_flags({"compile_cache_dir": cache_on})
+        step, x, y = _linear_step()
+        cold = [float(step(x, y)) for _ in range(3)]
+        step, x, y = _linear_step()
+        warm = [float(step(x, y)) for _ in range(3)]
+        assert cold == ref and warm == ref
+
+
+# ---- cross-process acceptance -----------------------------------------------
+
+class TestWarmProcess:
+    def test_second_process_zero_compiles_bit_identical(self, tmp_path):
+        """THE acceptance headline: process one fills the directory;
+        process two traces and compiles NOTHING (`trace_compile == 0`,
+        hits >= 2) and reproduces the train and serve outputs
+        bit-identically."""
+        d = tmp_path / "cc"
+        cold = _run_runner(d)
+        assert cold["trace_compile"] >= 2
+        assert cold["compile_cache"]["stores"] >= 2
+        assert cold["compile_cache"]["export_skips"] == 0
+        warm = _run_runner(d)
+        assert warm["trace_compile"] == 0, warm["counters"]
+        assert warm["compile_cache"]["hits"] >= 2
+        assert warm["compile_cache"]["misses"] == 0
+        assert warm["train_digest"] == cold["train_digest"]
+        assert warm["serve_digest"] == cold["serve_digest"]
+        # serving stats surface the warm-start numbers (PDHQ probe rides
+        # PredictorServer.stats() == engine.stats())
+        assert warm["warm_start_ms"] is not None
+        assert warm["stats_compile_cache"]["hits"] >= 1
+
+    def test_concurrent_writers_race_to_consistent_dir(self, tmp_path):
+        """Two cold processes race lock-free on one empty directory
+        (tmp+rename, per-writer tmp names, last-writer-wins): both must
+        finish clean, agree bit-identically, and leave a directory that
+        CRC-verifies with nothing to prune."""
+        d = str(tmp_path / "cc")
+        procs = [subprocess.Popen(
+            [sys.executable, RUNNER, d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}) for _ in range(2)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err[-2000:]
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        assert outs[0]["train_digest"] == outs[1]["train_digest"]
+        assert outs[0]["serve_digest"] == outs[1]["serve_digest"]
+        ok, bad = cc.verify(d)
+        assert bad == [] and ok >= 2
+        for row in cc.entries(d):
+            bpath = os.path.join(d, row["key"] + ".bin")
+            assert zlib.crc32(open(bpath, "rb").read()) & 0xFFFFFFFF \
+                == row["crc"]
+
+
+# ---- monitor CLI ------------------------------------------------------------
+
+class TestCacheCLI:
+    def test_cache_list_verify_gc(self, cache_on, capsys):
+        from paddle_tpu.monitor import _main
+        key, blob = _store_one(key="9" * 40, blob=b"q" * 2048)
+        assert _main(["cache", cache_on]) == 0
+        out = capsys.readouterr().out
+        assert key in out and "test" in out
+        with open(os.path.join(cache_on, key + ".bin"), "wb") as f:
+            f.write(b"garbage")
+        assert _main(["cache", cache_on, "--verify"]) == 0
+        out = capsys.readouterr().out
+        assert "1 corrupt pruned" in out
+        _store_one(key="8" * 40, blob=b"q" * 2048)
+        assert _main(["cache", cache_on, "--gc", "--cap-mb", "0"]) == 0
+        out = capsys.readouterr().out
+        assert "1 entries evicted" in out
+        assert cc.entries(cache_on) == []
+
+    def test_cache_cli_no_dir_is_an_error(self, capsys):
+        from paddle_tpu.monitor import _main
+        assert _main(["cache"]) == 2
+        assert "no cache dir" in capsys.readouterr().err

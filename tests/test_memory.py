@@ -510,13 +510,13 @@ class TestLazyCacheLRU:
     def test_cache_is_lru_bounded_with_eviction_counter(self, with_monitor):
         from paddle_tpu.ops import lazy
         _flags.set_flags({"lazy_eager": True, "lazy_cache_entries": 4})
-        ev0 = lazy.cache_evictions
+        ev0 = lazy._LEDGER.evictions
         try:
             for i in range(10):
                 t = paddle.to_tensor(np.ones((2, 3 + i), np.float32))
                 _ = ((t + 1.0) * 2.0).numpy()
-            assert len(lazy._SEG_CACHE) <= 4
-            assert lazy.cache_evictions - ev0 >= 6
+            assert len(lazy._LEDGER) <= 4
+            assert lazy._LEDGER.evictions - ev0 >= 6
             snap = monitor.snapshot()["counters"]
             assert snap.get("lazy.cache_evictions", 0) >= 6
         finally:
@@ -529,12 +529,12 @@ class TestLazyCacheLRU:
         try:
             hot = paddle.to_tensor(np.ones((2, 64), np.float32))
             _ = ((hot + 1.0) * 2.0).numpy()
-            hot_sigs = set(lazy._SEG_CACHE)
+            hot_sigs = set(lazy._LEDGER.keys())
             for i in range(2):   # churn up to capacity, touching hot between
                 t = paddle.to_tensor(np.ones((2, 3 + i), np.float32))
                 _ = ((t + 1.0) * 2.0).numpy()
                 _ = ((hot + 1.0) * 2.0).numpy()    # refresh hot's recency
-            assert hot_sigs & set(lazy._SEG_CACHE), \
+            assert hot_sigs & set(lazy._LEDGER.keys()), \
                 "LRU evicted the most recently used segment"
         finally:
             _flags.set_flags({"lazy_eager": False,
@@ -543,14 +543,14 @@ class TestLazyCacheLRU:
     def test_shrinking_the_flag_evicts_immediately(self):
         from paddle_tpu.ops import lazy
         _flags.set_flags({"lazy_eager": True, "lazy_cache_entries": 8})
-        lazy._SEG_CACHE.clear()     # entries persist across tests
+        lazy._LEDGER.clear()        # entries persist across tests
         try:
             for i in range(5):
                 t = paddle.to_tensor(np.ones((2, 40 + i), np.float32))
                 _ = ((t + 1.0) * 2.0).numpy()
-            assert len(lazy._SEG_CACHE) == 5
+            assert len(lazy._LEDGER) == 5
             _flags.set_flags({"lazy_cache_entries": 2})
-            assert len(lazy._SEG_CACHE) <= 2
+            assert len(lazy._LEDGER) <= 2
         finally:
             _flags.set_flags({"lazy_eager": False,
                               "lazy_cache_entries": 256})

@@ -144,6 +144,21 @@ class TestStepTimeline:
         assert all("device_compute" in r["phases"] for r in recs)
         assert all("h2d" in r["phases"] for r in recs)
 
+    def test_phase_sum_bounded_on_novel_signature_step(self, with_obs):
+        """Double-accounting regression (ISSUE 11): a novel-signature
+        step is where dispatches nest (the step's own booking around
+        inner captures/flushes) — before unified booking in
+        core/executable.py each level booked its own phase and the same
+        wall seconds were counted twice. Even on the trace_compile step,
+        phases must not exceed the measured wall."""
+        step, x, y = _make_lenet_step()
+        step(x, y)
+        rec = obs.timeline().records()[0]
+        assert "trace_compile" in rec["phases"]
+        assert sum(rec["phases"].values()) <= rec["wall"] * 1.02, \
+            (f"phases {rec['phases']} sum past wall {rec['wall']:.4f}s "
+             f"— a nested dispatch double-booked its wall time")
+
     def test_first_dispatch_books_trace_compile(self, with_obs):
         step, x, y = _make_linear_step()
         step(x, y)

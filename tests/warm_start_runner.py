@@ -1,0 +1,115 @@
+"""Warm-start workload runner (subprocess side of the compile-cache tests).
+
+Runs the acceptance workload for the persistent executable cache in a
+FRESH process: a LeNet train step (two fixed-signature steps) plus a
+serving-engine bucket warm-up whose predictor is a @to_static capture.
+Prints ONE json line with monitor counters, compile-cache stats, and
+bit-exact output digests so the parent can compare a cold-dir run
+against a warm-dir run (same digests, zero compiles).
+
+Usage: python tests/warm_start_runner.py <cache_dir> [extra_flag_json]
+"""
+import hashlib
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu import monitor  # noqa: E402
+from paddle_tpu.core import compile_cache as cc  # noqa: E402
+from paddle_tpu.jit import TrainStep  # noqa: E402
+from paddle_tpu.jit.to_static import to_static  # noqa: E402
+from paddle_tpu.serving import EngineConfig, ServingEngine  # noqa: E402
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=4):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = paddle.flatten(x, 1)
+        return self.fc(x)
+
+
+def digest(*arrays) -> str:
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    return h.hexdigest()[:32]
+
+
+def main() -> int:
+    import time
+    cache_dir = sys.argv[1]
+    extra = json.loads(sys.argv[2]) if len(sys.argv) > 2 else {}
+    paddle.set_flags({"FLAGS_monitor": True,
+                      "FLAGS_compile_cache_dir": cache_dir, **extra})
+    paddle.seed(0)
+
+    # ---- train arm: LeNet step, fixed signature --------------------------
+    t0 = time.time()
+    net = LeNet()
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-3)
+    lossfn = nn.CrossEntropyLoss()
+    step = TrainStep(net, lossfn, opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(8, 1, 28, 28).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype("int64"))
+    losses = [float(step(x, y)), float(step(x, y))]
+    t_first_train = time.time() - t0
+    params = [np.asarray(t._value) for t in step._ptensors]
+    train_digest = digest(np.asarray(losses, np.float64), *params)
+
+    # ---- serving arm: bucket warm-up over a to_static predictor ----------
+    @to_static
+    def predictor(a):
+        return a * 2.0 + 1.0
+
+    t1 = time.time()
+    eng = ServingEngine(predictor, EngineConfig(
+        max_batch_size=2, num_workers=1, warmup_on_start=False,
+        learn_buckets=False))
+    eng.declare_bucket([(4,)], ["float32"], [1, 2])
+    eng.warmup()
+    t_first_infer = time.time() - t1
+    serve_out = predictor(paddle.to_tensor(
+        np.arange(8, dtype=np.float32).reshape(2, 4)))
+    serve_digest = digest(serve_out.numpy())
+
+    snap = monitor.snapshot()["counters"]
+    print(json.dumps({
+        "losses": losses,
+        "train_digest": train_digest,
+        "serve_digest": serve_digest,
+        "trace_compile": int(snap.get("trace_compile", 0)),
+        "counters": {k: v for k, v in snap.items()
+                     if k.startswith(("trace_compile", "compile_cache",
+                                      "jit.train_step", "serving."))},
+        "compile_cache": cc.stats(),
+        "warm_start_ms": eng.stats()["warm_start_ms"],
+        "stats_compile_cache": eng.stats()["compile_cache"],
+        "t_first_train_s": t_first_train,
+        "t_first_infer_s": t_first_infer,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
