@@ -856,6 +856,61 @@ def _is_outage(e) -> bool:
                                                for m in _OUTAGE_MARKERS)
 
 
+def bench_serving_slo(backend):
+    """Serving observability tax A/B: per-request engine latency with the
+    request-tracing + SLO planes off vs on (FLAGS_trace, FLAGS_slo_*).
+    Both arms run with the monitor on, so the delta isolates exactly what
+    this plane adds: span bookkeeping per request plus the sketch/burn
+    accounting. Also reports the traced arm's sketch quantiles and burn
+    rate — the numbers the 'PDHQ' probe serves to the router."""
+    import paddle_tpu.monitor as monitor
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.obs import slo as _slo, trace as _trace
+    from paddle_tpu.serving import engine as _eng
+
+    n = 400 if backend == "tpu" else 200
+
+    def one_arm(trace_on):
+        _flags.set_flags({
+            "monitor": True,
+            "trace": trace_on,
+            "slo_latency_ms": 50.0 if trace_on else 0.0,
+        })
+        eng = _eng.ServingEngine(lambda arrays: arrays).start()
+        x = np.random.rand(1, 16).astype("float32")
+        try:
+            for _ in range(20):            # warm the bucket executable
+                eng.submit([x]).result(timeout=10)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                eng.submit([x]).result(timeout=10)
+            per_req_us = (time.perf_counter() - t0) / n * 1e6
+            stats = eng.stats()
+        finally:
+            eng.stop()
+            _flags.set_flags({"monitor": False, "trace": False,
+                              "slo_latency_ms": 0.0})
+            _trace.reset()
+            _slo.reset()
+            monitor.reset()
+        return per_req_us, stats
+
+    base_us, _ = one_arm(False)
+    traced_us, stats = one_arm(True)
+    slo = stats.get("slo") or {}
+    out = {
+        "requests_per_arm": n,
+        "per_request_us_off": round(base_us, 1),
+        "per_request_us_on": round(traced_us, 1),
+        "overhead_pct": round((traced_us - base_us) / base_us * 100, 1)
+        if base_us else None,
+        "latency_ms": {k: round(v, 3) for k, v in
+                       (slo.get("latency_ms") or {}).items()},
+        "burn": slo.get("burn"),
+    }
+    return out
+
+
 def _run_workload(name, fn, backend, partial_extra):
     """Run one bench workload. Outage -> structured {"outage": true} JSON
     (with everything measured so far) and rc=0; any other failure is
@@ -893,6 +948,7 @@ def main():
                     ("ocr_rec_infer", bench_ocr_rec_infer),
                     ("ernie10b_layer", bench_ernie10b_layer),
                     ("allreduce_smoke", bench_allreduce),
+                    ("serving_slo", bench_serving_slo),
                     ("warm_start", bench_warm_start)):
         extra[key] = _run_workload(key, fn, backend, extra)
 

@@ -245,6 +245,38 @@ define_flag("mem_leak_window", 8,
             "mem leak watch: a tag whose census bytes grow strictly for "
             "this many consecutive censuses is flagged as a leak suspect "
             "(warning + mem.leak_suspects counter); 0 disables the check")
+# ---- request tracing + SLO plane (obs/trace.py + obs/slo.py) ---------------
+define_flag("trace", False,
+            "request-scoped distributed tracing (obs/trace.py): mint a "
+            "trace context per PredictorClient request, carry it over the "
+            "wire in an optional 'PDTC' frame and through the fleet message "
+            "bus, and record spans (client.send/serving.request/queue_wait/"
+            "batch/dispatch/reply, ps.rpc.*) into a tail-sampled ring that "
+            "joins the flight-recorder dump and chrome-trace export; "
+            "off = every span site pays one module-attribute check")
+define_flag("trace_ring", 64,
+            "tracing: finished traces kept per ring (one ring for healthy "
+            "traces, one PROTECTED ring for over-deadline/rejected/errored/"
+            "SLO-violating traces that tail sampling always keeps)")
+define_flag("slo_latency_ms", 0.0,
+            "SLO plane (obs/slo.py): latency objective for serving e2e "
+            "latency — a request slower than this (or rejected/deadline-"
+            "expired/errored) burns error budget; 0 = SLO plane off "
+            "(one attribute check per recorded request)")
+define_flag("slo_target", 0.999,
+            "SLO plane: availability target (fraction of requests that "
+            "must meet the latency objective); burn rate = bad_fraction / "
+            "(1 - target), so burn 1.0 = exactly consuming the budget")
+define_flag("slo_windows", "60,300,3600",
+            "SLO plane: comma-separated burn-rate window lengths in "
+            "seconds (multi-window burn alerting: short window catches "
+            "fast burn, long window catches slow leaks)")
+define_flag("slo_shed_burn", 0.0,
+            "SLO plane: admission hook threshold — when the SHORTEST "
+            "window's burn rate exceeds this, ServingEngine.submit sheds "
+            "new requests as overloaded before the budget burns; "
+            "0 = never shed on burn")
+
 # ---- executable plane (core/executable.py + core/compile_cache.py) --------
 define_flag("compile_cache_dir", "",
             "persistent on-disk executable cache (core/compile_cache.py): "

@@ -42,6 +42,42 @@ try:
 except ImportError:
     _flags = None
 
+try:
+    from ..obs import trace as _trace
+except ImportError:
+    class _NullBusSpan:  # standalone runner: tracing plane disabled
+        trace_id = None
+
+        def ctx(self):
+            return None
+
+        def set(self, **attrs):
+            return self
+
+        def end(self, status=None, **attrs):
+            pass
+
+    class _trace:  # noqa: N801
+        _ENABLED = False
+        NULL_SPAN = _NullBusSpan()
+        STATUS_ERROR = "error"
+
+        @staticmethod
+        def pack_ctx(ctx):
+            return b""
+
+        @staticmethod
+        def unpack_ctx(raw):
+            return None
+
+        @staticmethod
+        def context():
+            return None
+
+        @staticmethod
+        def server_span(name, ctx, attrs=None):
+            return _trace.NULL_SPAN
+
 
 def _bus_retry_config():
     """(retries, backoff_s) for the bus send path; flag-driven in-package,
@@ -70,11 +106,15 @@ class InterceptorStuckError(RuntimeError):
 
 
 class Message:
-    __slots__ = ("src", "dst", "kind", "payload", "micro")
+    # trace_ctx: obs.trace.TraceContext carried across the bus (None for
+    # untraced messages — the wire tuple then stays the legacy 5-tuple)
+    __slots__ = ("src", "dst", "kind", "payload", "micro", "trace_ctx")
 
-    def __init__(self, src: int, dst: int, kind: str, payload=None, micro=-1):
+    def __init__(self, src: int, dst: int, kind: str, payload=None, micro=-1,
+                 trace_ctx=None):
         self.src, self.dst, self.kind = src, dst, kind
         self.payload, self.micro = payload, micro
+        self.trace_ctx = trace_ctx
 
 
 class MessageBus:
@@ -392,8 +432,18 @@ class DistMessageBus(MessageBus):
                     data += chunk
                 if _faults._ENABLED:
                     _faults.check("bus.recv")
-                src, dst, kind, payload, micro = self._pickle.loads(data)
-                msg = Message(src, dst, kind, payload, micro)
+                # tolerant unpack: traced peers append a 6th element (the
+                # packed trace ctx); legacy peers send the plain 5-tuple
+                src, dst, kind, payload, micro, *rest = \
+                    self._pickle.loads(data)
+                tctx = None
+                if rest:
+                    try:
+                        tctx = _trace.unpack_ctx(rest[0])
+                    except Exception:
+                        tctx = None  # a trace must never break the bus
+                msg = Message(src, dst, kind, payload, micro,
+                              trace_ctx=tctx)
                 # local delivery (register() may race: wait for the inbox)
                 q = self._inboxes.get(msg.dst)
                 if q is None:
@@ -461,9 +511,20 @@ class DistMessageBus(MessageBus):
             return
         # serialize as a plain tuple: Message's defining module may be
         # loaded under a different name in the peer (spec-loaded runners)
+        # — a packed trace ctx rides along as an OPTIONAL 6th element so
+        # untraced frames stay bit-identical to the legacy 5-tuple
+        tctx = None
+        sp = _trace.NULL_SPAN
+        if _trace._ENABLED:
+            tctx = msg.trace_ctx or _trace.context()
+            sp = _trace.server_span("bus.send", tctx,
+                                    attrs={"dst": msg.dst,
+                                           "kind": msg.kind})
+        tup = (msg.src, msg.dst, msg.kind, msg.payload, msg.micro)
+        if tctx is not None:
+            tup = tup + (_trace.pack_ctx(tctx),)
         data = self._pickle.dumps(
-            (msg.src, msg.dst, msg.kind, msg.payload, msg.micro),
-            protocol=self._pickle.HIGHEST_PROTOCOL)
+            tup, protocol=self._pickle.HIGHEST_PROTOCOL)
         frame = self._struct.pack("<q", len(data)) + data
         import time as _time
         with self._peer_lock(owner):
@@ -478,10 +539,13 @@ class DistMessageBus(MessageBus):
                         _faults.check("bus.send")
                     sk = self._remote_sock(owner)
                     sk.sendall(frame)
+                    sp.end(retries=attempt)
                     return
                 except OSError as e:
                     last = e
                     self._drop_conn(owner)
+            sp.end(status=_trace.STATUS_ERROR,
+                   error=f"peer {owner} unreachable")
             raise PeerGoneError(
                 owner,
                 f"fleet bus: rank {owner} unreachable after "

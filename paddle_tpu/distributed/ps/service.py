@@ -31,6 +31,7 @@ from .table import DenseTable, SparseTable
 from ... import faults as _faults
 from ... import monitor as _monitor
 from ...core import flags as _flags
+from ...obs import trace as _trace
 
 _HDR = struct.Struct("<B16sqq")  # cmd, table name (padded), n, dim
 # payload plausibility caps (the header fields are client-controlled)
@@ -438,27 +439,42 @@ class PsClient:
         return (time.monotonic() + self.call_timeout
                 if self.call_timeout else None)
 
-    def _retry_rpc(self, attempt_fn):
+    def _retry_rpc(self, attempt_fn, op: str = "call"):
         """Run one RPC attempt; on a transport failure (OSError family —
         includes injected resets and recv deadlines) back off and retry.
         Server-reported PsErrors are application failures: never retried.
         Caller must already hold the involved per-server locks so a
-        retried push reuses its sequence numbers without interleaving."""
+        retried push reuses its sequence numbers without interleaving.
+
+        Under `FLAGS_trace` the WHOLE call (retries included) is one
+        `ps.rpc.<op>` span — parented on the calling thread's open span
+        when there is one — that closes with error status when the RPC
+        ultimately fails (injected `ps.rpc.send` conn-resets/timeouts
+        land here: no leaked open spans)."""
+        sp = _trace.span(f"ps.rpc.{op}")
         delay = self.backoff_s
         last: Optional[BaseException] = None
-        for attempt in range(self.max_retries + 1):
-            if attempt:
-                if _monitor._ENABLED:
-                    _monitor.count("ps.retries")
-                time.sleep(delay * (1.0 + random.random()))  # full jitter
-                delay = min(delay * 2, 2.0)
-            try:
-                return attempt_fn()
-            except PsError:
-                raise
-            except OSError as e:
-                last = e
-        raise last
+        try:
+            for attempt in range(self.max_retries + 1):
+                if attempt:
+                    if _monitor._ENABLED:
+                        _monitor.count("ps.retries")
+                    time.sleep(delay * (1.0 + random.random()))  # full jitter
+                    delay = min(delay * 2, 2.0)
+                try:
+                    out = attempt_fn()
+                    sp.end(retries=attempt)
+                    return out
+                except PsError:
+                    raise
+                except OSError as e:
+                    last = e
+            raise last
+        except BaseException as e:
+            # idempotent: only fires when the success path did not end it
+            sp.end(status=_trace.STATUS_ERROR,
+                   error=f"{type(e).__name__}: {str(e)[:200]}")
+            raise
 
     def _ensure_seq(self, s: int) -> bool:
         """True when the CURRENT connection to server s has a registered
@@ -560,7 +576,7 @@ class PsClient:
 
                 self._recv_all(shards, recv_rows, deadline)
 
-            self._retry_rpc(attempt)
+            self._retry_rpc(attempt, op="pull_sparse")
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -594,7 +610,7 @@ class PsClient:
                 self._send_all(shards, payload)
                 self._recv_all(shards, None, deadline)
 
-            self._retry_rpc(attempt)
+            self._retry_rpc(attempt, op="push_sparse")
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -630,7 +646,7 @@ class PsClient:
 
                 self._recv_all(shards, recv_slice, deadline)
 
-            self._retry_rpc(attempt)
+            self._retry_rpc(attempt, op="pull_dense")
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -690,7 +706,7 @@ class PsClient:
                 self._send_all(shards, payload)
                 self._recv_all(shards, None, deadline)
 
-            self._retry_rpc(attempt)
+            self._retry_rpc(attempt, op="push_dense")
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -713,7 +729,7 @@ class PsClient:
                     + clicks[sel].tobytes()))
                 self._recv_all(shards, None, deadline)
 
-            self._retry_rpc(attempt)
+            self._retry_rpc(attempt, op="push_show_click")
         finally:
             for s, _ in shards:
                 self._locks[s].release()
@@ -736,7 +752,7 @@ class PsClient:
 
                 self._recv_all(shards, recv_one, deadline)
 
-            self._retry_rpc(attempt)
+            self._retry_rpc(attempt, op="cmd")
         finally:
             for s, _ in shards:
                 self._locks[s].release()

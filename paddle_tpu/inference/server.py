@@ -11,6 +11,10 @@ into padded shape-bucket batches, enforces deadlines and queue-depth
 backpressure, and drives the jitted Predictor from its worker loop.
 
 Wire protocol (little-endian), on top of csrc/predict_capi.cpp's framing:
+  trace:     u32 'PDTC', 26-byte trace context (OPTIONAL prefix a tracing
+             client sends immediately before its request frame; absence
+             means "no trace" — untraced exchanges are byte-identical to
+             the pre-PDTC protocol, so old peers interoperate)
   request:   u32 'PDRQ', u32 n_tensors, tensors
   deadline:  u32 'PDRD', u32 deadline_ms, u32 n_tensors, tensors
   health:    u32 'PDHQ' (no body)
@@ -18,6 +22,11 @@ Wire protocol (little-endian), on top of csrc/predict_capi.cpp's framing:
              status 0: u32 n_tensors + tensors ('PDHQ': u32 len + JSON)
              status 1 (error) / 2 (overloaded, retryable) /
              status 3 (deadline expired): u32 len + utf-8 message
+
+Under `FLAGS_trace` one request produces one trace: the client's
+`client.send` root span, the server's `serving.request` child carried
+over by 'PDTC', the engine's queue_wait/batch/dispatch spans under it,
+and `serving.reply` around the response write (obs/trace.py).
 """
 from __future__ import annotations
 
@@ -40,11 +49,13 @@ _DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
 _MAX_NDIM = 8
 _MAX_TENSOR_BYTES = 1 << 32  # sanity cap against corrupt headers
 
+from ..obs import trace as _trace  # noqa: E402
 from ..serving import (  # noqa: E402
     DeadlineExceededError, EngineConfig, ServerOverloadedError, ServingEngine)
 from ..utils.net import (  # noqa: E402
     STATUS_DEADLINE, STATUS_ERROR, STATUS_OK, STATUS_OVERLOADED,
-    recv_exact as _recv_exact, send_status_frame)
+    TRACE_MAGIC as _TRACE_MAGIC, recv_exact as _recv_exact,
+    recv_trace_frame, send_status_frame, send_trace_frame)
 
 
 def _read_tensor(conn, deadline: Optional[float] = None) -> np.ndarray:
@@ -122,41 +133,73 @@ class PredictorServer:
     def _handle_one(self, conn) -> bool:
         """One request/response exchange; False = close the connection."""
         magic, = struct.unpack("<I", _recv_exact(conn, 4))
+        tctx = None
+        if magic == _TRACE_MAGIC:
+            # OPTIONAL trace prefix: consume the context, then read the
+            # real request magic that follows
+            read_deadline = time.monotonic() + self._READ_TIMEOUT_S
+            tctx = recv_trace_frame(conn, read_deadline)
+            magic, = struct.unpack("<I", _recv_exact(conn, 4,
+                                                     read_deadline))
         if magic == _HEALTH_MAGIC:
             payload = json.dumps(self.engine.stats(),
                                  default=str).encode()
             conn.sendall(struct.pack("<IB", _RESP_MAGIC, STATUS_OK)
                          + struct.pack("<I", len(payload)) + payload)
             return True
+        # serving.request: the server-side root of this request's trace,
+        # parented on the client's wire context; closes with the same
+        # status the wire response carries (absence of 'PDTC' -> no-op)
+        rspan = _trace.server_span("serving.request", tctx)
+        try:
+            keep = self._handle_request(conn, magic, rspan)
+        except BaseException as e:
+            rspan.end(status=_trace.STATUS_ERROR,
+                      error=f"{type(e).__name__}: {str(e)[:200]}")
+            raise
+        rspan.end()  # idempotent: error paths already set their status
+        return keep
+
+    def _handle_request(self, conn, magic, rspan) -> bool:
         read_deadline = time.monotonic() + self._READ_TIMEOUT_S
         deadline_ms = None
         if magic == _REQ_DEADLINE_MAGIC:
             dl, = struct.unpack("<I", _recv_exact(conn, 4, read_deadline))
             deadline_ms = float(dl) if dl else None
         elif magic != _REQ_MAGIC:
+            rspan.end(status=_trace.STATUS_ERROR, error="bad magic")
             return False  # protocol violation: drop the connection
         n, = struct.unpack("<I", _recv_exact(conn, 4, read_deadline))
         try:
             inputs = [_read_tensor(conn, read_deadline) for _ in range(n)]
         except ValueError as e:
             # header was bad: stream unrecoverable, report + close
+            rspan.end(status=_trace.STATUS_ERROR, error=str(e)[:200])
             send_status_frame(conn, STATUS_ERROR, str(e))
             return False
         try:
-            fut = self.engine.submit(inputs, deadline_ms=deadline_ms)
+            fut = self.engine.submit(inputs, deadline_ms=deadline_ms,
+                                     trace_ctx=rspan.ctx())
             outs = fut.result(timeout=self._RESULT_TIMEOUT_S)
         except ServerOverloadedError as e:
+            rspan.end(status=_trace.STATUS_REJECTED)
             send_status_frame(conn, STATUS_OVERLOADED, str(e))
             return True
         except DeadlineExceededError as e:
+            rspan.end(status=_trace.STATUS_DEADLINE)
             send_status_frame(conn, STATUS_DEADLINE, str(e))
             return True
         except Exception as e:  # surface model errors to the C app
+            rspan.end(status=_trace.STATUS_ERROR,
+                      error=f"{type(e).__name__}: {str(e)[:200]}")
             send_status_frame(conn, STATUS_ERROR, str(e))
             return True
-        conn.sendall(struct.pack("<IBI", _RESP_MAGIC, STATUS_OK, len(outs)))
-        for o in outs:
-            _write_tensor(conn, np.asarray(o))
+        with _trace.server_span("serving.reply", rspan.ctx(),
+                                attrs={"n_outputs": len(outs)}):
+            conn.sendall(struct.pack("<IBI", _RESP_MAGIC, STATUS_OK,
+                                     len(outs)))
+            for o in outs:
+                _write_tensor(conn, np.asarray(o))
         return True
 
     def _handle(self, conn):
@@ -197,25 +240,44 @@ class PredictorClient:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
+    # wire status -> terminal span status for the client.send root span
+    _SPAN_STATUS = {STATUS_OK: _trace.STATUS_OK,
+                    STATUS_ERROR: _trace.STATUS_ERROR,
+                    STATUS_OVERLOADED: _trace.STATUS_REJECTED,
+                    STATUS_DEADLINE: _trace.STATUS_DEADLINE}
+
     def run(self, arrays, deadline_ms: Optional[float] = None):
         """Returns (status, payload): payload is the output list on
-        STATUS_OK, else the server's utf-8 message."""
-        if deadline_ms is not None:
-            hdr = struct.pack("<III", _REQ_DEADLINE_MAGIC,
-                              int(deadline_ms), len(arrays))
-        else:
-            hdr = struct.pack("<II", _REQ_MAGIC, len(arrays))
-        self._sock.sendall(hdr)
-        for a in arrays:
-            _write_tensor(self._sock, np.asarray(a))
-        magic, status = struct.unpack("<IB", _recv_exact(self._sock, 5))
-        if magic != _RESP_MAGIC:
-            raise ConnectionError(f"bad response magic {magic:#x}")
-        if status != STATUS_OK:
-            ln, = struct.unpack("<I", _recv_exact(self._sock, 4))
-            return status, _recv_exact(self._sock, ln).decode()
-        n, = struct.unpack("<I", _recv_exact(self._sock, 4))
-        return status, [_read_tensor(self._sock) for _ in range(n)]
+        STATUS_OK, else the server's utf-8 message.
+
+        Under `FLAGS_trace` each call mints a new trace: a `client.send`
+        root span whose context rides a 'PDTC' prefix frame, so the
+        server (and engine) spans land in the SAME trace. Tracing off =
+        byte-identical frames to the pre-PDTC protocol."""
+        with _trace.span("client.send",
+                         attrs={"n_tensors": len(arrays)}) as sp:
+            if sp.trace_id is not None:
+                send_trace_frame(self._sock, sp.ctx())
+            if deadline_ms is not None:
+                hdr = struct.pack("<III", _REQ_DEADLINE_MAGIC,
+                                  int(deadline_ms), len(arrays))
+            else:
+                hdr = struct.pack("<II", _REQ_MAGIC, len(arrays))
+            self._sock.sendall(hdr)
+            for a in arrays:
+                _write_tensor(self._sock, np.asarray(a))
+            magic, status = struct.unpack("<IB",
+                                          _recv_exact(self._sock, 5))
+            if magic != _RESP_MAGIC:
+                raise ConnectionError(f"bad response magic {magic:#x}")
+            if status != STATUS_OK:
+                ln, = struct.unpack("<I", _recv_exact(self._sock, 4))
+                msg = _recv_exact(self._sock, ln).decode()
+                sp.end(status=self._SPAN_STATUS.get(
+                    status, _trace.STATUS_ERROR))
+                return status, msg
+            n, = struct.unpack("<I", _recv_exact(self._sock, 4))
+            return status, [_read_tensor(self._sock) for _ in range(n)]
 
     def health(self) -> dict:
         self._sock.sendall(struct.pack("<I", _HEALTH_MAGIC))

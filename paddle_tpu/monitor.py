@@ -53,6 +53,7 @@ metadata).
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -65,7 +66,7 @@ __all__ = [
     "enabled", "enable", "disable",
     "counter", "gauge", "histogram",
     "count", "gauge_set", "observe", "log_event", "record_op",
-    "record_collective", "record_retrace",
+    "record_collective", "record_retrace", "record_span",
     "span", "snapshot", "report", "reset",
     "export_json", "prometheus_text", "export_prometheus",
 ]
@@ -148,13 +149,29 @@ class Gauge:
 _DEFAULT_BUCKETS: Tuple[float, ...] = (
     1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
 
+# DDSketch-style quantile sketch parameters: log buckets of ratio gamma
+# guarantee |estimate - true| <= alpha * true for every quantile — the 8
+# fixed exponential buckets above are fine for a Prometheus scrape but
+# cannot produce the accurate p99 SLO routing needs. alpha=0.005 -> <=1%
+# relative error with two sketch buckets to spare.
+_SKETCH_ALPHA = 0.005
+_SKETCH_GAMMA = (1.0 + _SKETCH_ALPHA) / (1.0 - _SKETCH_ALPHA)
+_SKETCH_LOG_GAMMA = math.log(_SKETCH_GAMMA)
+# ~2048 bins cover >10 orders of magnitude at 1% error; beyond that the
+# LOWEST bins collapse together (the tail quantiles everyone routes on
+# live in the highest bins, which never lose precision)
+_SKETCH_MAX_BINS = 2048
+
 
 class Histogram:
     """Cumulative-bucket histogram (Prometheus semantics: each bucket counts
-    observations <= its upper bound; +Inf is implicit via `count`)."""
+    observations <= its upper bound; +Inf is implicit via `count`) plus a
+    bounded-relative-error log-bucket quantile sketch (DDSketch-style):
+    `quantile(q)` is within `_SKETCH_ALPHA` relative error of the exact
+    value, at O(bins) memory independent of observation count."""
 
     __slots__ = ("name", "buckets", "bucket_counts", "count", "sum",
-                 "min", "max", "_lock")
+                 "min", "max", "_sketch", "_sketch_zero", "_lock")
 
     def __init__(self, name: str, buckets: Optional[Tuple[float, ...]] = None):
         self.name = name
@@ -164,6 +181,8 @@ class Histogram:
         self.sum = 0.0
         self.min = float("inf")
         self.max = 0.0
+        self._sketch: Dict[int, int] = {}   # log-bin index -> count
+        self._sketch_zero = 0               # observations <= 0
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -177,6 +196,45 @@ class Histogram:
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
                     self.bucket_counts[i] += 1
+            if value > 0.0:
+                idx = math.ceil(math.log(value) / _SKETCH_LOG_GAMMA)
+                self._sketch[idx] = self._sketch.get(idx, 0) + 1
+                if len(self._sketch) > _SKETCH_MAX_BINS:
+                    self._collapse_locked()
+            else:
+                self._sketch_zero += 1
+
+    def _collapse_locked(self) -> None:
+        # fold the two lowest bins together (DDSketch collapse rule):
+        # precision degrades only at the extreme LOW tail
+        lo = sorted(self._sketch)
+        a, b = lo[0], lo[1]
+        self._sketch[b] += self._sketch.pop(a)
+
+    def quantile(self, q: float) -> float:
+        """Sketch quantile estimate: <= _SKETCH_ALPHA relative error.
+        q in [0, 1]; returns 0.0 on an empty histogram."""
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def _quantile_locked(self, q: float) -> float:
+        total = self._sketch_zero + sum(self._sketch.values())
+        if total == 0:
+            return 0.0
+        rank = q * (total - 1)
+        seen = self._sketch_zero
+        if rank < seen:
+            return 0.0
+        for idx in sorted(self._sketch):
+            seen += self._sketch[idx]
+            if rank < seen:
+                # midpoint of (gamma^(i-1), gamma^i] in relative terms
+                return 2.0 * _SKETCH_GAMMA ** idx / (_SKETCH_GAMMA + 1.0)
+        return self.max
+
+    def quantiles(self, qs=(0.5, 0.95, 0.99)) -> Dict[float, float]:
+        with self._lock:
+            return {q: self._quantile_locked(q) for q in qs}
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -188,6 +246,9 @@ class Histogram:
                 "min": self.min if cnt else 0.0,
                 "max": self.max,
                 "buckets": dict(zip(self.buckets, self.bucket_counts)),
+                "p50": self._quantile_locked(0.5),
+                "p95": self._quantile_locked(0.95),
+                "p99": self._quantile_locked(0.99),
             }
 
     def reset(self) -> None:
@@ -197,6 +258,8 @@ class Histogram:
             self.sum = 0.0
             self.min = float("inf")
             self.max = 0.0
+            self._sketch = {}
+            self._sketch_zero = 0
 
 
 # ---- registry (monitor.h StatRegistry role) --------------------------------
@@ -367,6 +430,18 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def record_span(name: str, t0: float, t1: float, kind: str = "span") -> None:
+    """Book one completed range: `span.<name>.count`/`.dur` metrics plus
+    every active Profiler's host-event stream (and thereby the chrome
+    trace). `monitor.span()` and the request-trace spans (obs/trace.py)
+    both land here, so one dispatcher feeds both export planes."""
+    _REGISTRY.counter(f"span.{name}.count").add(1)
+    _REGISTRY.histogram(f"span.{name}.dur").observe(t1 - t0)
+    from . import profiler as _profiler
+    for p in tuple(_profiler._ACTIVE_STACK):
+        p._record_op(name, t0, t1, kind)
+
+
 class _Span:
     __slots__ = ("name", "kind", "_t0")
 
@@ -380,14 +455,7 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
-        t1 = time.time()
-        _REGISTRY.counter(f"span.{self.name}.count").add(1)
-        _REGISTRY.histogram(f"span.{self.name}.dur").observe(t1 - self._t0)
-        # feed the profiler plane: every active Profiler records the range
-        # on its host-event stream (and thereby into the chrome trace)
-        from . import profiler as _profiler
-        for p in tuple(_profiler._ACTIVE_STACK):
-            p._record_op(self.name, self._t0, t1, self.kind)
+        record_span(self.name, self._t0, time.time(), self.kind)
         return False
 
 
@@ -479,27 +547,51 @@ def _prom_name(name: str) -> str:
     return "paddle_tpu_" + n
 
 
+def _prom_uniq(pn: str, seen: Dict[str, int]) -> str:
+    """Sanitization can collide distinct metric names (`span.a.b` and
+    `span.a_b` both map to `..._span_a_b`); a duplicate family is a
+    format violation, so later arrivals get a deterministic suffix."""
+    n = seen.get(pn, 0)
+    seen[pn] = n + 1
+    return pn if n == 0 else f"{pn}_dup{n}"
+
+
 def prometheus_text() -> str:
-    """Prometheus text exposition format (text/plain; version 0.0.4)."""
+    """Prometheus text exposition format (text/plain; version 0.0.4).
+
+    Histograms emit the full conforming family — cumulative
+    `_bucket{le=...}` including `le="+Inf"`, `_sum`, `_count` — plus a
+    sibling `<name>_q` summary family carrying the sketch quantiles
+    (p50/p95/p99 at <=1% relative error). The summary is a separate
+    family because mixing sample types under one metric name is
+    non-conforming."""
     snap = _REGISTRY.snapshot()
+    seen: Dict[str, int] = {}
     lines: List[str] = []
     for name in sorted(snap["counters"]):
-        pn = _prom_name(name)
+        pn = _prom_uniq(_prom_name(name), seen)
         lines.append(f"# TYPE {pn} counter")
         lines.append(f"{pn} {snap['counters'][name]}")
     for name in sorted(snap["gauges"]):
-        pn = _prom_name(name)
+        pn = _prom_uniq(_prom_name(name), seen)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {snap['gauges'][name]}")
     for name in sorted(snap["histograms"]):
         st = snap["histograms"][name]
-        pn = _prom_name(name)
+        pn = _prom_uniq(_prom_name(name), seen)
         lines.append(f"# TYPE {pn} histogram")
         for ub, c in st["buckets"].items():
             lines.append(f'{pn}_bucket{{le="{ub}"}} {c}')
         lines.append(f'{pn}_bucket{{le="+Inf"}} {st["count"]}')
         lines.append(f"{pn}_sum {st['sum']}")
         lines.append(f"{pn}_count {st['count']}")
+        if "p50" in st:
+            qn = _prom_uniq(pn + "_q", seen)
+            lines.append(f"# TYPE {qn} summary")
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                lines.append(f'{qn}{{quantile="{q}"}} {st[key]}')
+            lines.append(f"{qn}_sum {st['sum']}")
+            lines.append(f"{qn}_count {st['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -566,8 +658,30 @@ def _render_flight_dump(doc: Dict[str, Any]) -> str:
     mem_lines = _render_dump_memory(doc)
     if mem_lines:
         lines.extend(mem_lines)
+    # schema /3 trace + SLO sections (older dumps simply lack the keys)
+    lines.extend(_render_dump_traces(doc))
+    slosec = doc.get("slo")
+    if slosec:
+        from .obs import slo as _slo
+        lines.extend(_slo.render_slo(slosec).splitlines())
     lines.append("-" * 78)
     return "\n".join(lines)
+
+
+def _render_dump_traces(doc: Dict[str, Any]) -> List[str]:
+    """Render the schema-/3 trace ring of a flight dump: tail-sampled
+    request traces (protected bad traces first) as span waterfalls.
+    Returns [] for a /1 or /2 dump — `show` stays version-agnostic."""
+    tracesec = doc.get("traces") or {}
+    kept = tracesec.get("kept") or []
+    ring = tracesec.get("ring") or []
+    if not kept and not ring:
+        return []
+    from .obs import trace as _trace
+    lines = [f"request traces: {len(ring)} in ring, "
+             f"{len(kept)} kept (bad/slow, evict-protected)"]
+    lines.extend(_trace.render_traces(kept + ring).splitlines())
+    return lines
 
 
 def _render_dump_memory(doc: Dict[str, Any]) -> List[str]:
@@ -643,6 +757,22 @@ def _diff_snapshots(a: Dict[str, Any], b: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _slo_main(args) -> int:
+    """`python -m paddle_tpu.monitor slo [path]` — render burn rates and
+    latency quantiles from a flight dump's `slo` section, a snapshot's
+    `slo.*` gauges, or (no path) this process's live SLO plane."""
+    from .obs import slo as _slo
+    if args.path is None:
+        print(_slo.render_slo(_slo.stats()))
+        return 0
+    doc = _load_artifact(args.path)
+    if _is_flight_dump(doc):
+        print(_slo.render_slo(doc.get("slo")))
+        return 0
+    print(_slo.render_slo(_slo.doc_from_snapshot(doc)))
+    return 0
+
+
 def _cache_main(args) -> int:
     """`python -m paddle_tpu.monitor cache [dir] [--gc] [--verify]`."""
     from .core import compile_cache as _cc
@@ -700,6 +830,12 @@ def _main(argv=None) -> int:
         "mem", help="render a flight-recorder dump's memory census "
                     "(no path: take a live census of this process)")
     p_mem.add_argument("path", nargs="?", default=None)
+    p_slo = sub.add_parser(
+        "slo", help="render SLO state: error-budget burn rates, bad-request "
+                    "breakdown, sketch latency quantiles (from a "
+                    "flight-recorder dump, a monitor snapshot's slo.* "
+                    "gauges, or — with no path — this live process)")
+    p_slo.add_argument("path", nargs="?", default=None)
     p_cache = sub.add_parser(
         "cache", help="inspect a persistent compile-cache directory "
                       "(core/compile_cache.py): list entries; --gc to "
@@ -717,6 +853,8 @@ def _main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.cmd == "cache":
         return _cache_main(args)
+    if args.cmd == "slo":
+        return _slo_main(args)
     if args.cmd == "show":
         doc = _load_artifact(args.path)
         if _is_flight_dump(doc):

@@ -31,6 +31,8 @@ from typing import Any, Dict, Optional, Type
 
 from ..core import flags as _flags
 from . import memory  # noqa: F401  (the HBM attribution plane)
+from . import slo  # noqa: F401  (error-budget burn rate plane)
+from . import trace  # noqa: F401  (request-scoped distributed tracing)
 from .cost import attributed_mfu, executable_cost, roofline_gap  # noqa: F401
 from .memory import (census, executable_memory, maybe_dump_oom,  # noqa: F401
                      top_buffers)
@@ -50,7 +52,7 @@ __all__ = [
     "straggler_report", "slim_records", "executable_cost",
     "attributed_mfu", "roofline_gap", "dump_to_chrome_events",
     "memory", "census", "top_buffers", "executable_memory",
-    "maybe_dump_oom",
+    "maybe_dump_oom", "trace", "slo",
 ]
 
 # ---- gates + singletons ----------------------------------------------------

@@ -33,10 +33,11 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["FlightRecorder", "DUMP_SCHEMA", "dump_to_chrome_events"]
 
-# /2 adds the "memory" section: the mem-census ring + per-phase HBM peaks
-# (obs/memory.py). `monitor show` renders both versions — a v1 dump is
-# simply one without the section.
-DUMP_SCHEMA = "paddle_tpu.flight_recorder/2"
+# /2 added the "memory" section: the mem-census ring + per-phase HBM peaks
+# (obs/memory.py). /3 adds "traces" (the tail-sampled request-trace rings,
+# obs/trace.py) and "slo" (error-budget burn, obs/slo.py). `monitor show`
+# renders every version — an older dump is simply one without the section.
+DUMP_SCHEMA = "paddle_tpu.flight_recorder/3"
 
 _COLLECTIVE_RING = 256
 _EVENT_RING = 128
@@ -155,6 +156,10 @@ class FlightRecorder:
         from . import memory as _memory
         out["memory"] = {"census": _memory.census_ring(),
                          "phase_peaks": _memory.phase_peaks()}
+        from . import slo as _slo
+        from . import trace as _trace
+        out["traces"] = _trace.ring_payload()
+        out["slo"] = _slo.stats()
         if extra:
             out["extra"] = extra
         return out
@@ -186,4 +191,10 @@ def dump_to_chrome_events(dump: Dict[str, Any]) -> List[Dict[str, Any]]:
                        "ph": "i", "s": "g",
                        "ts": float(dump.get("ts", 0.0)) * 1e6,
                        "pid": pid, "tid": rank * 10})
+    traces = dump.get("traces") or {}
+    if traces:
+        from .trace import trace_chrome_events
+        events.extend(trace_chrome_events(
+            list(traces.get("kept", [])) + list(traces.get("ring", [])),
+            pid=pid))
     return events

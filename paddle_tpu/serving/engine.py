@@ -37,6 +37,8 @@ from .. import faults as _faults
 from .. import monitor as _monitor
 from .. import obs as _obs
 from ..obs import memory as _mem
+from ..obs import slo as _slo
+from ..obs import trace as _trace
 from ..core import compile_cache as _cc
 from ..core import executable as _exe
 from ..core import flags as _flags
@@ -119,9 +121,10 @@ class ResponseFuture:
 
 class _Request:
     __slots__ = ("inputs", "rows", "sig", "bucket", "future",
-                 "enqueue_t", "deadline")
+                 "enqueue_t", "deadline", "trace_ctx", "qw_span")
 
-    def __init__(self, inputs, rows, sig, bucket, deadline):
+    def __init__(self, inputs, rows, sig, bucket, deadline,
+                 trace_ctx=None):
         self.inputs = inputs
         self.rows = rows
         self.sig = sig
@@ -129,6 +132,10 @@ class _Request:
         self.future = ResponseFuture()
         self.enqueue_t = time.monotonic()
         self.deadline = deadline  # absolute monotonic, or None
+        self.trace_ctx = trace_ctx  # obs.trace.TraceContext, or None
+        # queue_wait child span: opened at enqueue, closed at dispatch
+        # pick-up (ok) or expiry (deadline status -> protected trace ring)
+        self.qw_span = _trace.server_span("serving.queue_wait", trace_ctx)
 
 
 @dataclass
@@ -292,11 +299,14 @@ class ServingEngine:
 
     # ---- request intake ----
     def submit(self, inputs: Sequence[np.ndarray],
-               deadline_ms: Optional[float] = None) -> ResponseFuture:
+               deadline_ms: Optional[float] = None,
+               trace_ctx=None) -> ResponseFuture:
         """Enqueue one request (arrays share a leading batch dim, usually
         1). Raises ServerOverloadedError / EngineStoppedError /
         NoBucketError / ValueError synchronously; everything later lands
-        on the returned future."""
+        on the returned future. `trace_ctx` (an obs.trace.TraceContext,
+        normally the server-side request span's context) parents the
+        engine's queue_wait/batch/dispatch spans."""
         arrays = [np.ascontiguousarray(a) for a in inputs]
         if not arrays:
             raise ValueError("empty request")
@@ -305,12 +315,26 @@ class ServingEngine:
                            for a in arrays):
             raise ValueError(
                 "request inputs must share a leading batch dim >= 1")
+        if _slo._ENABLED and _slo.should_shed():
+            # burn-rate admission control (FLAGS_slo_shed_burn): shed
+            # explicitly while the short-window burn is over threshold —
+            # deliberate small budget spend instead of a brown-out
+            self._bump("rejected")
+            if _monitor._ENABLED:
+                _monitor.count("serving.rejected")
+                _monitor.count("serving.shed")
+            _slo.record_request(None, _slo.OUTCOME_REJECTED)
+            raise ServerOverloadedError(
+                "shedding: SLO error-budget burn rate over "
+                "FLAGS_slo_shed_burn; back off and retry")
         sig = signature_of(arrays)
         bucket = self.buckets.resolve(sig)
         if bucket is None:
             self._bump("rejected")
             if _monitor._ENABLED:
                 _monitor.count("serving.rejected")
+            if _slo._ENABLED:
+                _slo.record_request(None, _slo.OUTCOME_REJECTED)
             raise NoBucketError(
                 f"no declared bucket accepts {sig} and bucket learning "
                 "is disabled (FLAGS_serving_learn_buckets)")
@@ -322,27 +346,35 @@ class ServingEngine:
             deadline_ms = self.config.default_deadline_ms
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms and deadline_ms > 0 else None)
-        req = _Request(arrays, rows, sig, bucket, deadline)
-        with self._cv:
-            if self._stopping:
-                raise EngineStoppedError("engine is stopped/draining")
-            if self._pending >= self.config.queue_depth:
-                self._counts["rejected"] += 1
-                if _monitor._ENABLED:
-                    _monitor.count("serving.rejected")
-                err = ServerOverloadedError(
-                    f"queue at capacity ({self.config.queue_depth} "
-                    "pending); back off and retry")
-                if _obs._FR_ENABLED:
-                    _obs.record_event("serving.overload",
-                                      queue_depth=self.config.queue_depth,
-                                      pending=self._pending)
-                    _obs.dump_on_error(err)
-                raise err
-            self._lanes.setdefault(bucket.key(), []).append(req)
-            self._pending += 1
-            self._counts["requests"] += 1
-            self._cv.notify()
+        req = _Request(arrays, rows, sig, bucket, deadline,
+                       trace_ctx=trace_ctx)
+        try:
+            with self._cv:
+                if self._stopping:
+                    raise EngineStoppedError("engine is stopped/draining")
+                if self._pending >= self.config.queue_depth:
+                    self._counts["rejected"] += 1
+                    if _monitor._ENABLED:
+                        _monitor.count("serving.rejected")
+                    if _slo._ENABLED:
+                        _slo.record_request(None, _slo.OUTCOME_REJECTED)
+                    err = ServerOverloadedError(
+                        f"queue at capacity ({self.config.queue_depth} "
+                        "pending); back off and retry")
+                    if _obs._FR_ENABLED:
+                        _obs.record_event(
+                            "serving.overload",
+                            queue_depth=self.config.queue_depth,
+                            pending=self._pending)
+                        _obs.dump_on_error(err)
+                    raise err
+                self._lanes.setdefault(bucket.key(), []).append(req)
+                self._pending += 1
+                self._counts["requests"] += 1
+                self._cv.notify()
+        except ServingError:
+            req.qw_span.end(status=_trace.STATUS_REJECTED)
+            raise
         if _monitor._ENABLED:
             _monitor.count("serving.requests")
         self._set_queue_gauge()
@@ -402,6 +434,10 @@ class ServingEngine:
         self._counts["expired"] += 1
         req.future._set_exception(DeadlineExceededError(
             "deadline expired before dispatch"))
+        req.qw_span.end(status=_trace.STATUS_DEADLINE)
+        if _slo._ENABLED:
+            _slo.record_request(time.monotonic() - req.enqueue_t,
+                                _slo.OUTCOME_DEADLINE)
         if _monitor._ENABLED:
             _monitor.count("serving.deadline_expired")
 
@@ -419,6 +455,25 @@ class ServingEngine:
                     live.append(req)
         if not live:
             return
+        batch_span = _trace.NULL_SPAN
+        disp_spans = None
+        if _trace._ENABLED:
+            # the batch belongs to no single trace: it parents onto the
+            # OLDEST member's request span and LINKS every member span, so
+            # any member's trace reaches the shared coalesce + dispatch
+            batch_span = _trace.server_span("serving.batch",
+                                            live[0].trace_ctx)
+            for r in live:
+                if r.trace_ctx is not None:
+                    batch_span.link_ctx(r.trace_ctx)
+            batch_span.set(rows=sum(r.rows for r in live),
+                           requests=len(live))
+            # per-member dispatch spans: each trace's waterfall shows the
+            # (shared) predictor call it rode
+            disp_spans = [_trace.server_span("serving.dispatch",
+                                             r.trace_ctx) for r in live]
+        for r in live:
+            r.qw_span.end()
         try:
             rows = sum(r.rows for r in live)
             bs = bucket.round_up_batch(rows)
@@ -437,11 +492,15 @@ class ServingEngine:
                 req.future._set_result([o[off:off + req.rows]
                                         for o in outs])
                 off += req.rows
+            if disp_spans is not None:
+                for sp in disp_spans:
+                    sp.end()
+                batch_span.end(batch=bs)
             self._record_batch(live, rows, bs, waste, t_disp, t_done)
         except ServingError as e:
-            self._fail_batch(live, e)
+            self._fail_batch(live, e, disp_spans, batch_span)
         except Exception as e:  # noqa: BLE001 — model errors go to callers
-            self._fail_batch(live, e)
+            self._fail_batch(live, e, disp_spans, batch_span)
         finally:
             with self._cv:
                 self._inflight -= len(live)
@@ -491,10 +550,22 @@ class ServingEngine:
                     _monitor.span("serving.predict"):
                 return [np.asarray(o) for o in self._call(arrays)]
 
-    def _fail_batch(self, live: List[_Request], err: BaseException) -> None:
+    def _fail_batch(self, live: List[_Request], err: BaseException,
+                    disp_spans=None, batch_span=_trace.NULL_SPAN) -> None:
         self._bump("failed", len(live))
         if _monitor._ENABLED:
             _monitor.count("serving.failed", len(live))
+        msg = f"{type(err).__name__}: {str(err)[:200]}"
+        if disp_spans is not None:
+            # a dispatch fault (injected conn-reset/timeout included) must
+            # close every member's span with error status — a leaked open
+            # span is a bug the autouse _no_trace_leak fixture catches
+            for sp in disp_spans:
+                sp.end(status=_trace.STATUS_ERROR, error=msg)
+        batch_span.end(status=_trace.STATUS_ERROR, error=msg)
+        if _slo._ENABLED:
+            for _ in live:
+                _slo.record_request(None, _slo.OUTCOME_ERROR)
         for req in live:
             req.future._set_exception(err)
 
@@ -514,6 +585,17 @@ class ServingEngine:
             self._counts["rows"] += rows
             self._counts["padded_rows"] += bs - rows
             self._counts["padding_waste_elems"] += waste
+        if _slo._ENABLED:
+            for req in live:
+                bad = _slo.record_request(t_done - req.enqueue_t)
+                if bad and _trace._ENABLED and req.trace_ctx is not None:
+                    # over the latency objective: drop an instant marker
+                    # span so tail sampling keeps this trace (protected
+                    # ring) even though every stage span closed ok
+                    _trace.server_span(
+                        "serving.slo_violation", req.trace_ctx,
+                        attrs={"e2e_ms": (t_done - req.enqueue_t) * 1e3},
+                    ).end(status=_trace.STATUS_SLO_VIOLATION)
         if not _monitor._ENABLED:
             return
         _monitor.count("serving.completed", len(live))
@@ -565,4 +647,9 @@ class ServingEngine:
             # came off disk (hits) or compiled fresh (misses)
             "warm_start_ms": self._warm_start_ms,
             "compile_cache": _cc.stats(),
+            # error-budget burn for the replica router (None = no SLO
+            # configured): objective, per-window burn rates, good/bad
+            # split, sketch latency quantiles, and whether the engine is
+            # currently shedding on burn
+            "slo": _slo.stats(),
         }

@@ -20,6 +20,33 @@ STATUS_DEADLINE = 3      # payload: u32 len + message; request expired
 
 _RESP_MAGIC = 0x50445253  # 'PDRS'
 
+# 'PDTC' — OPTIONAL trace-context prefix frame: u32 magic + the 26-byte
+# context of obs/trace.py (u8 version, 16B trace id, 8B span id, u8
+# flags), sent by a tracing client immediately BEFORE its 'PDRQ'/'PDRD'
+# request frame. Absence means "no trace": an untraced exchange is
+# byte-identical to the pre-PDTC protocol, so old clients and servers
+# interoperate with new ones.
+TRACE_MAGIC = 0x50445443  # 'PDTC'
+
+
+def send_trace_frame(sock, ctx) -> None:
+    """Send the 'PDTC' prefix for a traced request (`ctx` is an
+    obs.trace.TraceContext)."""
+    from ..obs import trace as _trace
+    sock.sendall(struct.pack("<I", TRACE_MAGIC) + _trace.pack_ctx(ctx))
+
+
+def recv_trace_frame(sock, deadline: float | None = None):
+    """Read the 'PDTC' body (the magic itself was already consumed by the
+    caller's dispatch read). Returns a TraceContext, or None on a corrupt
+    body (a trace must never break serving)."""
+    from ..obs import trace as _trace
+    raw = recv_exact(sock, _trace.CTX_WIRE_LEN, deadline)
+    try:
+        return _trace.unpack_ctx(raw)
+    except (ValueError, struct.error):
+        return None
+
 
 def send_status_frame(sock, status: int, msg: bytes | str = b"") -> None:
     """Send a non-OK inference response frame: magic + status + message.

@@ -123,3 +123,30 @@ def _no_lazy_leak():
     assert pending == 0, (
         f"{pending} deferred op(s) leaked out of the test "
         "(paddle.sync() / flush_pending() not reached?)")
+
+
+@pytest.fixture(autouse=True)
+def _no_trace_leak():
+    """An unclosed request span leaking out of a test would (a) pin its
+    trace in the buffer's open-set forever and (b) leave a stale span on
+    the thread stack so an unrelated later test's spans parent under it.
+    Assert the tracing plane is idle and FLAGS_trace is back to its
+    pre-test state after EVERY test (and restore, so one offender cannot
+    cascade)."""
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.obs import trace as _trace
+    flag_before = _flags.flag("trace")
+    depth_before = _trace.active_depth()
+    yield
+    flag_after = _flags.flag("trace")
+    depth_after = _trace.active_depth()
+    if flag_after != flag_before:
+        _flags.set_flags({"trace": flag_before})
+    if depth_after != depth_before:
+        _trace.reset()
+    assert flag_after == flag_before, (
+        f"FLAGS_trace leaked out of the test: {flag_after!r} "
+        f"(was {flag_before!r})")
+    assert depth_after == depth_before, (
+        f"{depth_after - depth_before} open span(s) leaked out of the "
+        "test (Span.end() never reached — error path missing a close?)")

@@ -1,0 +1,42 @@
+"""Traced serving-server runner (executed by test_trace.py).
+
+Starts a PredictorServer with the tracing + SLO planes ON in a real child
+process, publishes its port, serves until the parent writes a line on
+stdin, then dumps the flight recorder (schema v3 — carries the trace
+ring) to the given path and exits. The parent asserts that ONE traced
+client request produced a SINGLE trace_id whose spans cover
+queue_wait/batch/dispatch/reply on THIS side of the socket.
+
+argv: [port_file, dump_path]
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+port_file = sys.argv[1]
+dump_path = sys.argv[2]
+
+from paddle_tpu.core import flags as _flags  # noqa: E402
+from paddle_tpu import obs  # noqa: E402
+from paddle_tpu.inference.server import PredictorServer  # noqa: E402
+from paddle_tpu.serving import EngineConfig  # noqa: E402
+
+_flags.set_flags({"monitor": True, "trace": True, "slo_latency_ms": 1000.0})
+
+srv = PredictorServer(lambda a: a * 2.0,
+                      engine_config=EngineConfig(warmup_on_start=False,
+                                                 batch_timeout_ms=5)).start()
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    f.write(f"{srv.host} {srv.port}")
+os.rename(tmp, port_file)   # atomic: the parent never reads a half-write
+
+sys.stdin.readline()        # parent says "done sending"
+srv.stop()
+obs.dump(dump_path, reason="test")
+print(json.dumps({"dump": dump_path}))

@@ -805,7 +805,11 @@ class TestSelfLint:
              # executable substrate + persistent compile cache (ISSUE
              # 11): every dispatch regime rides these on the hot path
              os.path.join(PKG, "core", "executable.py"),
-             os.path.join(PKG, "core", "compile_cache.py")],
+             os.path.join(PKG, "core", "compile_cache.py"),
+             # request tracing + SLO plane (ISSUE 12): every request
+             # crosses these — span bookkeeping must stay sync-free
+             os.path.join(PKG, "obs", "trace.py"),
+             os.path.join(PKG, "obs", "slo.py")],
             all_functions=True)
         assert n_files > 25
         assert findings == [], "\n".join(f.format() for f in findings)

@@ -292,7 +292,7 @@ class TestOOMForensics:
         assert "[flight recorder:" in str(errs[0])
         assert getattr(errs[1], "dump_path", None) is None  # rate-limited
         doc = _latest_dump(errs[0])
-        assert doc["schema"] == "paddle_tpu.flight_recorder/2"
+        assert doc["schema"] == "paddle_tpu.flight_recorder/3"
         assert doc["reason"] == "oom"
         mem = doc["extra"]["memory"]
         top = mem["top_buffers"]
@@ -367,14 +367,15 @@ class TestOOMForensics:
             hoard.clear()
 
 
-# ---- dump schema v2 + v1 back-compat ----------------------------------------
+# ---- dump schema v3 + v1/v2 back-compat -------------------------------------
 
 class TestDumpSchema:
-    def test_v2_dump_always_carries_memory_section(self, with_mem, tmp_path):
+    def test_v3_dump_always_carries_memory_section(self, with_mem, tmp_path):
         path = obs.dump(str(tmp_path / "manual.json"), reason="manual")
         doc = json.load(open(path))
-        assert doc["schema"] == "paddle_tpu.flight_recorder/2"
+        assert doc["schema"] == "paddle_tpu.flight_recorder/3"
         assert "census" in doc["memory"] and "phase_peaks" in doc["memory"]
+        assert "traces" in doc and "slo" in doc   # v3 sections always present
 
     def test_v1_fixture_still_renders(self):
         """Back-compat gate: a checked-in /1 artifact (no memory section)
@@ -392,6 +393,23 @@ class TestDumpSchema:
         path = os.path.join(FIXTURES, "flightrec_v1.json")
         assert _main(["mem", path]) == 0       # says "no memory census"
         assert _main(["show", path]) == 0
+
+    def test_v2_fixture_still_renders(self, capsys):
+        """Back-compat gate: a checked-in /2 artifact (memory section, no
+        traces/slo) must render through `show`, `mem`, and `slo` without
+        crashing — `show` stays version-agnostic across all three schemas."""
+        from paddle_tpu.monitor import _main, _is_flight_dump
+        path = os.path.join(FIXTURES, "flightrec_v2.json")
+        doc = json.load(open(path))
+        assert doc["schema"] == "paddle_tpu.flight_recorder/2"
+        assert _is_flight_dump(doc)
+        assert _main(["show", path]) == 0
+        assert _main(["mem", path]) == 0
+        assert _main(["slo", path]) == 0   # says "(no SLO configured ...)"
+        out = capsys.readouterr().out
+        assert "flight recorder dump" in out
+        assert "memory census" in out
+        assert "no SLO configured" in out
 
     def test_v2_oom_dump_through_mem_cli(self, with_mem, capsys):
         from paddle_tpu.monitor import _main

@@ -234,8 +234,10 @@ class TestRobustness:
                            EngineConfig(warmup_on_start=False))
         st = eng.stats()
         for key in ("running", "queue_depth", "queue_capacity", "inflight",
-                    "max_batch_size", "buckets", "counters", "workers"):
+                    "max_batch_size", "buckets", "counters", "workers",
+                    "slo"):
             assert key in st
+        assert st["slo"] is None    # SLO plane unconfigured: explicit null
 
 
 class TestZeroRetraceSteadyState:

@@ -1,0 +1,377 @@
+"""SLO plane (obs/slo.py) + the quantile sketch behind it (monitor.py):
+bounded-relative-error quantiles vs exact oracles, Prometheus exposition
+conformance (parse-back), multi-window error-budget burn rate, burn-rate
+admission control (shedding), the 'PDHQ' probe under a deadline-violation
+storm, and the disabled-path overhead guard."""
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.monitor as monitor
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.obs import slo
+from paddle_tpu.serving import (EngineConfig, ServerOverloadedError,
+                                ServingEngine)
+
+
+@pytest.fixture()
+def monitored():
+    monitor.reset()
+    paddle.set_flags({"FLAGS_monitor": True})
+    yield monitor
+    paddle.set_flags({"FLAGS_monitor": False})
+    monitor.reset()
+
+
+@pytest.fixture()
+def slo_plane():
+    """SLO objective: p(latency <= 50ms) >= 99% over 2s/10s windows."""
+    monitor.reset()
+    paddle.set_flags({"FLAGS_monitor": True, "FLAGS_slo_latency_ms": 50.0,
+                      "FLAGS_slo_target": 0.99, "FLAGS_slo_windows": "2,10"})
+    yield slo
+    paddle.set_flags({"FLAGS_monitor": False, "FLAGS_slo_latency_ms": 0.0,
+                      "FLAGS_slo_target": 0.999,
+                      "FLAGS_slo_windows": "60,300,3600",
+                      "FLAGS_slo_shed_burn": 0.0})
+    monitor.reset()
+
+
+# ---------------------------------------------------------------------------
+# quantile sketch: accuracy against exact oracles
+# ---------------------------------------------------------------------------
+
+class TestQuantileSketch:
+    @pytest.mark.parametrize("dist", ["lognormal", "uniform", "exponential"])
+    def test_quantiles_within_1pct_of_exact(self, dist):
+        rng = np.random.RandomState(7)
+        xs = {"lognormal": rng.lognormal(-4.0, 1.0, 20000),
+              "uniform": rng.uniform(1e-4, 2.0, 20000),
+              "exponential": rng.exponential(0.01, 20000)}[dist]
+        h = monitor.Histogram("t.lat")
+        for v in xs:
+            h.observe(float(v))
+        xs_sorted = np.sort(xs)
+        for q in (0.5, 0.9, 0.95, 0.99, 0.999):
+            exact = float(xs_sorted[int(q * (len(xs) - 1))])
+            got = h.quantile(q)
+            assert abs(got - exact) <= 0.01 * exact + 1e-12, (
+                f"{dist} p{q * 100}: sketch {got} vs exact {exact}")
+
+    def test_zero_and_negative_observations(self):
+        h = monitor.Histogram("t.z")
+        for v in (-1.0, 0.0, 0.0, 1.0):
+            h.observe(v)
+        assert h.quantile(0.0) == 0.0
+        assert h.quantile(0.5) == 0.0        # 3 of 4 obs are <= 0
+        assert abs(h.quantile(1.0) - 1.0) <= 0.01
+
+    def test_empty_histogram_quantile_is_zero(self):
+        assert monitor.Histogram("t.e").quantile(0.99) == 0.0
+
+    def test_bin_cap_collapses_low_tail_only(self):
+        """Push >2048 distinct log-bins: the cap must hold and the HIGH
+        quantiles keep their precision (only the low tail collapses)."""
+        h = monitor.Histogram("t.c")
+        v = 1e-12
+        while v < 1e10:                       # ~50k distinct bins worth
+            h.observe(v)
+            v *= 1.01
+        assert len(h._sketch) <= 2048 + 1
+        assert h.quantile(0.99) > 1e8         # high tail uncollapsed
+
+    def test_stats_carry_quantiles_and_reset_clears(self, monitored):
+        for ms in range(1, 101):
+            monitor.observe("s.lat", ms / 1e3)
+        st = monitor.histogram("s.lat").stats()
+        assert abs(st["p50"] - 0.0505) < 0.002
+        assert abs(st["p99"] - 0.100) < 0.002
+        monitor.histogram("s.lat").reset()
+        assert monitor.histogram("s.lat").stats()["p99"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance (satellite: parse-back audit)
+# ---------------------------------------------------------------------------
+
+def _parse_prometheus(txt):
+    """Minimal text-format 0.0.4 parser: {family: {"type": t, "samples":
+    [(name, labels, value)]}}. Raises on malformed lines — the parse IS
+    the conformance assertion."""
+    families = {}
+    cur = None
+    line_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(-?[0-9.eE+-]+|NaN)$')
+    for line in txt.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, fam, typ = line.split(maxsplit=3)
+            assert typ in ("counter", "gauge", "histogram", "summary"), typ
+            cur = families[fam] = {"type": typ, "samples": []}
+            continue
+        assert not line.startswith("#"), f"unexpected comment: {line}"
+        m = line_re.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, _, labels_raw, value = m.groups()
+        labels = {}
+        for item in (labels_raw or "").split(","):
+            if item:
+                k, v = item.split("=", 1)
+                assert v.startswith('"') and v.endswith('"'), line
+                labels[k] = v[1:-1]
+        assert cur is not None, f"sample before any # TYPE: {line!r}"
+        cur["samples"].append((name, labels, float(value)))
+    return families
+
+
+class TestPrometheusConformance:
+    def test_histogram_family_parses_back_consistently(self, monitored):
+        rng = np.random.RandomState(0)
+        for v in rng.lognormal(-5.0, 1.0, 500):
+            monitor.observe("req.dur", float(v))
+        monitor.count("req.total", 500)
+        fams = _parse_prometheus(monitor.prometheus_text())
+
+        h = fams["paddle_tpu_req_dur"]
+        assert h["type"] == "histogram"
+        buckets = [(float(lb["le"]) if lb["le"] != "+Inf" else float("inf"),
+                    v) for n, lb, v in h["samples"]
+                   if n == "paddle_tpu_req_dur_bucket"]
+        assert buckets[-1][0] == float("inf")
+        # cumulative + monotone non-decreasing, +Inf == _count
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts)
+        count = [v for n, lb, v in h["samples"]
+                 if n == "paddle_tpu_req_dur_count"][0]
+        total = [v for n, lb, v in h["samples"]
+                 if n == "paddle_tpu_req_dur_sum"][0]
+        assert buckets[-1][1] == count == 500
+        assert total == pytest.approx(
+            monitor.histogram("req.dur").sum)
+
+        # sketch quantiles ride a SEPARATE summary-typed family
+        s = fams["paddle_tpu_req_dur_q"]
+        assert s["type"] == "summary"
+        qs = {lb["quantile"]: v for n, lb, v in s["samples"]
+              if n == "paddle_tpu_req_dur_q" and "quantile" in lb}
+        assert set(qs) == {"0.5", "0.95", "0.99"}
+        assert qs["0.5"] <= qs["0.95"] <= qs["0.99"]
+        assert [v for n, lb, v in s["samples"]
+                if n == "paddle_tpu_req_dur_q_count"] == [500]
+
+    def test_name_sanitization_collisions_stay_unique(self, monitored):
+        monitor.count("a.b", 1)
+        monitor.count("a-b", 2)          # sanitizes to the same prom name
+        fams = _parse_prometheus(monitor.prometheus_text())
+        assert "paddle_tpu_a_b" in fams
+        assert "paddle_tpu_a_b_dup1" in fams
+
+    def test_slo_gauges_exported(self, slo_plane):
+        slo.record_request(0.010)
+        slo.record_request(0.200)        # over the 50ms objective
+        slo._PLANE._publish(time.time())   # bypass the 1/s throttle
+        fams = _parse_prometheus(monitor.prometheus_text())
+        assert fams["paddle_tpu_slo_bad"]["samples"][0][2] == 1.0
+        assert fams["paddle_tpu_slo_good"]["samples"][0][2] == 1.0
+        assert "paddle_tpu_slo_burn_2s" in fams
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math
+# ---------------------------------------------------------------------------
+
+class TestBurnRate:
+    def test_burn_is_bad_fraction_over_budget(self):
+        p = slo.SloPlane(latency_ms=50.0, target=0.99, windows=[60])
+        for _ in range(98):
+            p.record(0.010, slo.OUTCOME_OK)
+        for _ in range(2):
+            p.record(0.200, slo.OUTCOME_OK)   # slow -> bad
+        # bad_fraction=0.02, budget=0.01 -> burn 2.0
+        assert p.burn_rate(60) == pytest.approx(2.0, rel=1e-6)
+        st = p.stats()
+        assert st["bad_by_outcome"] == {slo.OUTCOME_SLOW: 2}
+
+    def test_empty_window_burns_zero(self):
+        p = slo.SloPlane(latency_ms=50.0, target=0.99, windows=[60])
+        assert p.burn_rate(60) == 0.0
+        assert not p.should_shed()
+
+    def test_short_window_recovers_before_long(self):
+        p = slo.SloPlane(latency_ms=50.0, target=0.9, windows=[1, 3600])
+        now = time.time()
+        # a burst of bad requests 2s ago: outside the 1s window, inside 1h
+        for _ in range(10):
+            p.record(0.500, slo.OUTCOME_OK, now=now - 2.0)
+        for _ in range(10):
+            p.record(0.001, slo.OUTCOME_OK, now=now)
+        assert p.burn_rate(1, now=now) == 0.0
+        assert p.burn_rate(3600, now=now) == pytest.approx(5.0, rel=1e-6)
+
+    def test_outcomes_counted_separately(self):
+        p = slo.SloPlane(latency_ms=50.0, target=0.99, windows=[60])
+        p.record(None, slo.OUTCOME_REJECTED)
+        p.record(None, slo.OUTCOME_DEADLINE)
+        p.record(None, slo.OUTCOME_ERROR)
+        p.record(0.001, slo.OUTCOME_OK)
+        st = p.stats()
+        assert st["bad"] == 3 and st["good"] == 1
+        assert st["bad_by_outcome"] == {slo.OUTCOME_REJECTED: 1,
+                                        slo.OUTCOME_DEADLINE: 1,
+                                        slo.OUTCOME_ERROR: 1}
+
+    def test_window_spec_parsing(self):
+        assert slo._parse_windows("60,300,3600") == [60, 300, 3600]
+        assert slo._parse_windows("300, 60, 60") == [60, 300]
+        assert slo._parse_windows("garbage") == [60, 300, 3600]
+
+    def test_disabled_record_is_noop(self):
+        assert not slo._ENABLED and slo._PLANE is None
+        assert slo.record_request(5.0) is False
+        assert slo.stats() is None and slo.burn_rates() == {}
+
+    def test_disabled_path_is_attribute_check(self):
+        """PR-1-style overhead guard: FLAGS_slo_latency_ms=0 keeps
+        record_request a plane-is-None check."""
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            slo.record_request(0.001)
+        t_gate = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            pass
+        t_base = time.perf_counter() - t0
+        assert t_gate < t_base + 0.05
+
+
+# ---------------------------------------------------------------------------
+# serving integration: 'PDHQ' probe + shedding
+# ---------------------------------------------------------------------------
+
+class TestServingSlo:
+    def test_health_probe_burn_moves_under_deadline_storm(self, slo_plane):
+        """THE acceptance drill: a deadline-violation storm must move the
+        burn rate the 'PDHQ' probe reports — the load-aware routing
+        signal."""
+        from paddle_tpu.inference.server import PredictorClient, \
+            PredictorServer
+        hold = threading.Event()
+
+        def stall(a):
+            hold.wait(15)
+            return a
+
+        srv = PredictorServer(stall, engine_config=EngineConfig(
+            warmup_on_start=False, batch_timeout_ms=1, max_batch_size=1,
+            num_workers=1)).start()
+        try:
+            c = PredictorClient(srv.host, srv.port, timeout=60)
+            h0 = c.health()
+            assert h0["slo"]["burn"]["2"] == 0.0
+            x = np.ones((1, 4), np.float32)
+            blocker = PredictorClient(srv.host, srv.port, timeout=60)
+            t_hold = threading.Thread(target=lambda: blocker.run([x]))
+            t_hold.start()               # parks the single worker in stall()
+            time.sleep(0.2)
+            # 6 concurrent requests queue behind it with a 30ms deadline;
+            # expiry fires when the worker next scans the lane
+            storm = [PredictorClient(srv.host, srv.port, timeout=60)
+                     for _ in range(6)]
+            outs = {}
+
+            def fire(i, cl):
+                outs[i] = cl.run([x], deadline_ms=30)
+
+            ts = [threading.Thread(target=fire, args=(i, cl))
+                  for i, cl in enumerate(storm)]
+            [t.start() for t in ts]
+            time.sleep(0.2)              # all queued, all past deadline
+            hold.set()                   # worker wakes, expires the queue
+            [t.join(30) for t in ts]
+            for s in storm:
+                s.close()
+            assert all(st == 3 for st, _ in outs.values())  # DEADLINE
+            t_hold.join(timeout=30)
+            blocker.close()
+            h1 = c.health()
+            c.close()
+            assert h1["slo"]["bad"] >= 6
+            assert h1["slo"]["bad_by_outcome"]["deadline"] >= 6
+            # 6 deadline misses of ~7 requests vs a 1% budget
+            assert h1["slo"]["burn"]["2"] > 10.0
+        finally:
+            hold.set()
+            srv.stop()
+
+    def test_burn_rate_admission_control_sheds(self, slo_plane):
+        """FLAGS_slo_shed_burn: once the short-window burn crosses the
+        threshold, submit() rejects explicitly BEFORE enqueueing."""
+        paddle.set_flags({"FLAGS_slo_shed_burn": 10.0})
+        eng = ServingEngine(lambda a: a, EngineConfig(
+            warmup_on_start=False, batch_timeout_ms=1)).start()
+        try:
+            for _ in range(20):              # burn the whole budget
+                slo.record_request(None, slo.OUTCOME_DEADLINE)
+            assert slo.should_shed()
+            with pytest.raises(ServerOverloadedError, match="shedding"):
+                eng.submit([np.ones((1, 4), np.float32)])
+            st = eng.stats()
+            assert st["counters"]["rejected"] == 1
+            assert st["slo"]["shedding"] is True
+        finally:
+            eng.stop()
+
+    def test_e2e_latency_quantiles_in_health(self, slo_plane):
+        eng = ServingEngine(lambda a: a, EngineConfig(
+            warmup_on_start=False, batch_timeout_ms=1)).start()
+        try:
+            for _ in range(10):
+                eng.submit([np.ones((1, 4), np.float32)]).result(timeout=10)
+        finally:
+            eng.stop()
+        st = eng.stats()["slo"]
+        assert st["good"] == 10 and st["bad"] == 0
+        assert st["latency_ms"]["p99"] > 0.0
+        assert st["objective"] == {"latency_ms": 50.0, "target": 0.99}
+
+
+# ---------------------------------------------------------------------------
+# CLI + dump
+# ---------------------------------------------------------------------------
+
+class TestSloCli:
+    def test_slo_subcommand_renders_live_dump_and_snapshot(
+            self, slo_plane, tmp_path, capsys):
+        from paddle_tpu import obs
+        from paddle_tpu.monitor import _main
+        for _ in range(9):
+            slo.record_request(0.001)
+        slo.record_request(0.300)            # one slow request
+        monitor.observe("serving.e2e_latency", 0.001)
+
+        # live
+        assert _main(["slo"]) == 0
+        live = capsys.readouterr().out
+        assert "SLO: 99.000% of requests within 50.0ms" in live
+        assert "bad by outcome: slow=1" in live
+        # flight dump
+        path = obs.dump(str(tmp_path / "d.json"), reason="manual")
+        assert _main(["slo", path]) == 0
+        assert "SLO: 99.000%" in capsys.readouterr().out
+        # snapshot export (gauges only)
+        slo._PLANE._publish(time.time() + 2.0)
+        snap = str(tmp_path / "snap.json")
+        monitor.export_json(snap)
+        assert _main(["slo", snap]) == 0
+        out = capsys.readouterr().out
+        assert "SLO: 99.000%" in out
+        # no-SLO artifact renders the hint, not a crash
+        json.dump({"schema": "paddle_tpu.flight_recorder/2"},
+                  open(str(tmp_path / "v2.json"), "w"))
+        assert _main(["slo", str(tmp_path / "v2.json")]) == 0
+        assert "no SLO configured" in capsys.readouterr().out
