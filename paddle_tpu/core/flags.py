@@ -177,6 +177,51 @@ define_flag("serving_warmup", True,
             "serving engine: pre-run every declared bucket x batch size "
             "at start() so steady-state serving never compiles")
 
+# ---- fleet serving tier (paddle_tpu.serving.fleet) --------------------------
+define_flag("serving_client_max_retries", 3,
+            "PredictorClient: bounded connect attempts per endpoint "
+            "(exponential backoff + full jitter, mirrors the "
+            "FLAGS_ps_rpc_* hardening) — a dead server burns milliseconds "
+            "of the request deadline, not all of it")
+define_flag("serving_client_backoff_ms", 25.0,
+            "PredictorClient: initial reconnect backoff; doubles per "
+            "attempt, capped at 1s, with full (0..100%) uniform jitter")
+define_flag("serving_client_connect_timeout_s", 2.0,
+            "PredictorClient: per-attempt TCP connect timeout (also "
+            "clipped to the remaining per-call deadline)")
+define_flag("fleet_heartbeat_s", 0.5,
+            "fleet replica: heartbeat interval for the replica's "
+            "ElasticManager lease (FleetRouter detects death at lease "
+            "expiry OR on a dispatch connection error, whichever first)")
+define_flag("fleet_lease_ttl_s", 2.0,
+            "fleet replica: lease TTL; a replica whose lease is this "
+            "stale is dead and its traffic re-routes")
+define_flag("fleet_health_interval_s", 0.5,
+            "fleet router: 'PDHQ' probe interval per replica (feeds the "
+            "load-aware routing score: queue depth, SLO burn, "
+            "warm_start_ms) and the rejoin detector for recovered "
+            "replicas")
+define_flag("fleet_max_replicas", 16,
+            "fleet router: replica-id space scanned in the rendezvous "
+            "store for registrations")
+define_flag("fleet_failover_attempts", 3,
+            "fleet router: distinct replicas tried per request before "
+            "giving up (each retry bounded by the request's ORIGINAL "
+            "deadline; the sequence ledger keeps delivery exactly-once)")
+define_flag("fleet_route_burn_weight", 2.0,
+            "fleet router: weight of a replica's shortest-window SLO "
+            "burn rate in its routing score (score = queue fraction + "
+            "weight * burn; lowest score wins)")
+define_flag("fleet_canary_burn", 1.0,
+            "fleet rollout: canary burn-rate threshold — a pushed model "
+            "version whose canary-replica tenant burn exceeds this rolls "
+            "back instantly via the guard checkpoint .bak generation")
+define_flag("fleet_hbm_budget_mb", 0.0,
+            "fleet replica: HBM budget for hosted model weights "
+            "(mem.model.<name>.bytes admission control: a push that "
+            "would exceed it evicts idle LRU tenants first, then is "
+            "rejected; 0 = unlimited)")
+
 # ---- hot-path overlap plane (io/prefetch.py, parallel/reducer.py, fused opt) --
 define_flag("prefetch", False,
             "async double-buffered host->device prefetch: hapi.Model.fit "

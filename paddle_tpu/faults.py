@@ -13,7 +13,11 @@ torn write. The training guard plane (`paddle_tpu.guard`) adds the loop
 seams: `guard.step` (inside the supervised train step — `delay` wedges it
 under the watchdog, `error` crashes it), `guard.snapshot` (crash point
 between a guard checkpoint's payload and its commit record) and
-`guard.snapshot.write` (torn checkpoint payload, via `mangle()`).
+`guard.snapshot.write` (torn checkpoint payload, via `mangle()`). The
+fleet serving tier (`serving/fleet.py`) adds the replica-pool seams:
+`router.dispatch` (before each routed send — `conn_reset` drives the
+failover drills), `replica.register` (rendezvous with the fleet store)
+and `replica.drain` (the graceful-drain path).
 
 Spec grammar (`FLAGS_fault_inject`, also `register()`/`inject()`):
 

@@ -20,7 +20,8 @@ from .errors import (DivergedError, GuardError, PreemptedError,  # noqa: F401
                      RankDesyncError, StepStalledError)
 from .watchdog import StepWatchdog  # noqa: F401
 from .desync import DesyncDetector, array_crc, fingerprint  # noqa: F401
-from .checkpoint import (has_guard_state, load_guard_state,  # noqa: F401
+from .checkpoint import (guard_state_version, has_guard_state,  # noqa: F401
+                         load_guard_state, rollback_guard_state,
                          save_guard_state)
 from .supervisor import GuardConfig, TrainGuard  # noqa: F401
 
@@ -30,4 +31,5 @@ __all__ = [
     "GuardConfig", "TrainGuard", "StepWatchdog", "DesyncDetector",
     "fingerprint", "array_crc",
     "save_guard_state", "load_guard_state", "has_guard_state",
+    "rollback_guard_state", "guard_state_version",
 ]

@@ -139,3 +139,28 @@ def load_guard_state(dirname: str) -> Tuple[Dict[str, np.ndarray], dict]:
 def has_guard_state(dirname: str) -> bool:
     mpath = os.path.join(dirname, _META)
     return os.path.exists(mpath) or os.path.exists(mpath + ".bak")
+
+
+def guard_state_version(dirname: str) -> int:
+    """Version of the current committed generation (0 = none)."""
+    return int(_read_meta(os.path.join(dirname, _META)).get("version", 0))
+
+
+def rollback_guard_state(dirname: str) -> int:
+    """INSTANT rollback: promote the `.bak` fallback generation to
+    current (the fleet tier's bad-model-push escape hatch — the previous
+    generation's payload is still on disk because `_gc` always keeps it).
+    The fallback is CRC-verified BEFORE promotion; returns the restored
+    version. Raises CheckpointCorruptError when there is no intact
+    fallback to roll back to."""
+    mpath = os.path.join(dirname, _META)
+    bak = mpath + ".bak"
+    if not os.path.exists(bak):
+        raise CheckpointCorruptError(
+            f"no fallback generation to roll back to in {dirname}")
+    _load_one(dirname, bak)  # verify intact before promoting
+    record = _read_meta(bak)
+    atomic_write(mpath, json.dumps(record).encode())
+    if _monitor._ENABLED:
+        _monitor.count("guard.ckpt_rollbacks")
+    return int(record.get("version", 0))

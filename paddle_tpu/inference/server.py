@@ -15,12 +15,20 @@ Wire protocol (little-endian), on top of csrc/predict_capi.cpp's framing:
              client sends immediately before its request frame; absence
              means "no trace" — untraced exchanges are byte-identical to
              the pre-PDTC protocol, so old peers interoperate)
+  model:     u32 'PDMQ', u32 len, utf-8 model name (OPTIONAL prefix:
+             routes the following request to a named hosted model on a
+             multi-model replica; absence = the default model)
   request:   u32 'PDRQ', u32 n_tensors, tensors
   deadline:  u32 'PDRD', u32 deadline_ms, u32 n_tensors, tensors
   health:    u32 'PDHQ' (no body)
+  drain:     u32 'PDDR' (no body) — graceful drain: the listening port
+             closes, queued+in-flight work completes, the replica
+             deregisters; answers status 0 + u32 len + JSON drain report
+  model ctl: u32 'PDMV', u32 len, JSON {op: reload|rollback, model};
+             answers status 0 + u32 len + JSON {ok, version, ...}
   response:  u32 'PDRS', u8 status;
              status 0: u32 n_tensors + tensors ('PDHQ': u32 len + JSON)
-             status 1 (error) / 2 (overloaded, retryable) /
+             status 1 (error) / 2 (overloaded/draining, retryable) /
              status 3 (deadline expired): u32 len + utf-8 message
 
 Under `FLAGS_trace` one request produces one trace: the client's
@@ -31,11 +39,12 @@ and `serving.reply` around the response write (obs/trace.py).
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -48,14 +57,19 @@ _DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
                 np.dtype(np.int64): 2}
 _MAX_NDIM = 8
 _MAX_TENSOR_BYTES = 1 << 32  # sanity cap against corrupt headers
+_MAX_NAME_LEN = 1 << 16      # cap on control-frame string bodies
 
+from ..core import flags as _flags  # noqa: E402
 from ..obs import trace as _trace  # noqa: E402
 from ..serving import (  # noqa: E402
-    DeadlineExceededError, EngineConfig, ServerOverloadedError, ServingEngine)
+    DeadlineExceededError, EngineConfig, EngineStoppedError,
+    ServerOverloadedError, ServingEngine)
 from ..utils.net import (  # noqa: E402
-    STATUS_DEADLINE, STATUS_ERROR, STATUS_OK, STATUS_OVERLOADED,
-    TRACE_MAGIC as _TRACE_MAGIC, recv_exact as _recv_exact,
-    recv_trace_frame, send_status_frame, send_trace_frame)
+    DRAIN_MAGIC as _DRAIN_MAGIC, MODEL_CTL_MAGIC as _MODEL_CTL_MAGIC,
+    MODEL_MAGIC as _MODEL_MAGIC, STATUS_DEADLINE, STATUS_ERROR, STATUS_OK,
+    STATUS_OVERLOADED, TRACE_MAGIC as _TRACE_MAGIC,
+    recv_exact as _recv_exact, recv_trace_frame, send_status_frame,
+    send_trace_frame)
 
 
 def _read_tensor(conn, deadline: Optional[float] = None) -> np.ndarray:
@@ -101,9 +115,23 @@ class PredictorServer:
 
     def __init__(self, predictor, host="127.0.0.1", port=0,
                  engine: Optional[ServingEngine] = None,
-                 engine_config: Optional[EngineConfig] = None):
+                 engine_config: Optional[EngineConfig] = None,
+                 on_drain=None, on_model_ctl=None, stats_extra=None):
         self.predictor = predictor
         self.engine = engine or ServingEngine(predictor, engine_config)
+        # named hosted models (multi-model replicas): 'PDMQ'-selected
+        # requests route to engines[name]; the unnamed default stays
+        # `self.engine` so single-model callers are untouched
+        self.engines: Dict[str, ServingEngine] = {}
+        # fleet hooks, all optional: `on_drain()` runs between the port
+        # closing and the engines draining (the agent deregisters its
+        # lease there); `on_model_ctl(req: dict) -> dict` answers 'PDMV';
+        # `stats_extra() -> dict` is merged into the 'PDHQ' payload (the
+        # agent reports per-tenant SLO + memory there)
+        self.on_drain = on_drain
+        self.on_model_ctl = on_model_ctl
+        self.stats_extra = stats_extra
+        self.drain_info: dict = {}  # merged into the 'PDDR' drain report
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -111,15 +139,38 @@ class PredictorServer:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._draining = False
+        self._drain_lock = threading.Lock()
 
     def start(self):
         self.engine.start()
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="predictor-serve")
         self._thread.start()
         return self
 
+    def register_model(self, name: str, engine: ServingEngine):
+        """Host an additional named model; its engine is started here and
+        drained with the server's own."""
+        engine.start()
+        self.engines[name] = engine
+        return engine
+
+    def unregister_model(self, name: str, drain: bool = True):
+        eng = self.engines.pop(name, None)
+        if eng is not None:
+            eng.stop(drain=drain)
+
+    def _engine_for(self, model: Optional[str]) -> Optional[ServingEngine]:
+        if model is None:
+            return self.engine
+        return self.engines.get(model)
+
     def _serve(self):
-        self._sock.settimeout(0.2)
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:
+            return  # drained/stopped before this thread first ran
         while not self._stop.is_set():
             try:
                 conn, _ = self._sock.accept()
@@ -134,25 +185,46 @@ class PredictorServer:
         """One request/response exchange; False = close the connection."""
         magic, = struct.unpack("<I", _recv_exact(conn, 4))
         tctx = None
-        if magic == _TRACE_MAGIC:
-            # OPTIONAL trace prefix: consume the context, then read the
-            # real request magic that follows
-            read_deadline = time.monotonic() + self._READ_TIMEOUT_S
-            tctx = recv_trace_frame(conn, read_deadline)
+        model: Optional[str] = None
+        read_deadline = None
+        # OPTIONAL prefix frames ('PDTC' trace, 'PDMQ' model select) in
+        # any order, then the verb magic. The first prefix arms the
+        # read deadline: once a multi-frame exchange starts, the rest
+        # must follow promptly.
+        while magic in (_TRACE_MAGIC, _MODEL_MAGIC):
+            if read_deadline is None:
+                read_deadline = time.monotonic() + self._READ_TIMEOUT_S
+            if magic == _TRACE_MAGIC:
+                tctx = recv_trace_frame(conn, read_deadline)
+            else:
+                ln, = struct.unpack("<I", _recv_exact(conn, 4,
+                                                      read_deadline))
+                if ln > _MAX_NAME_LEN:
+                    return False  # corrupt header: unrecoverable stream
+                model = _recv_exact(conn, ln, read_deadline).decode(
+                    "utf-8", "replace")
             magic, = struct.unpack("<I", _recv_exact(conn, 4,
                                                      read_deadline))
         if magic == _HEALTH_MAGIC:
-            payload = json.dumps(self.engine.stats(),
-                                 default=str).encode()
+            stats = self.stats()
+            payload = json.dumps(stats, default=str).encode()
             conn.sendall(struct.pack("<IB", _RESP_MAGIC, STATUS_OK)
                          + struct.pack("<I", len(payload)) + payload)
             return True
+        if magic == _DRAIN_MAGIC:
+            report = self.drain()
+            payload = json.dumps(report, default=str).encode()
+            conn.sendall(struct.pack("<IB", _RESP_MAGIC, STATUS_OK)
+                         + struct.pack("<I", len(payload)) + payload)
+            return False  # drained: nothing more to serve
+        if magic == _MODEL_CTL_MAGIC:
+            return self._handle_model_ctl(conn)
         # serving.request: the server-side root of this request's trace,
         # parented on the client's wire context; closes with the same
         # status the wire response carries (absence of 'PDTC' -> no-op)
         rspan = _trace.server_span("serving.request", tctx)
         try:
-            keep = self._handle_request(conn, magic, rspan)
+            keep = self._handle_request(conn, magic, rspan, model)
         except BaseException as e:
             rspan.end(status=_trace.STATUS_ERROR,
                       error=f"{type(e).__name__}: {str(e)[:200]}")
@@ -160,7 +232,33 @@ class PredictorServer:
         rspan.end()  # idempotent: error paths already set their status
         return keep
 
-    def _handle_request(self, conn, magic, rspan) -> bool:
+    def _handle_model_ctl(self, conn) -> bool:
+        read_deadline = time.monotonic() + self._READ_TIMEOUT_S
+        ln, = struct.unpack("<I", _recv_exact(conn, 4, read_deadline))
+        if ln > _MAX_NAME_LEN:
+            return False
+        try:
+            req = json.loads(_recv_exact(conn, ln, read_deadline).decode())
+        except ValueError:
+            send_status_frame(conn, STATUS_ERROR, "bad model-ctl body")
+            return False
+        if self.on_model_ctl is None:
+            send_status_frame(conn, STATUS_ERROR,
+                              "model control not supported here")
+            return True
+        try:
+            resp = self.on_model_ctl(req)
+        except Exception as e:
+            send_status_frame(conn, STATUS_ERROR,
+                              f"{type(e).__name__}: {str(e)[:300]}")
+            return True
+        payload = json.dumps(resp, default=str).encode()
+        conn.sendall(struct.pack("<IB", _RESP_MAGIC, STATUS_OK)
+                     + struct.pack("<I", len(payload)) + payload)
+        return True
+
+    def _handle_request(self, conn, magic, rspan,
+                        model: Optional[str] = None) -> bool:
         read_deadline = time.monotonic() + self._READ_TIMEOUT_S
         deadline_ms = None
         if magic == _REQ_DEADLINE_MAGIC:
@@ -177,11 +275,25 @@ class PredictorServer:
             rspan.end(status=_trace.STATUS_ERROR, error=str(e)[:200])
             send_status_frame(conn, STATUS_ERROR, str(e))
             return False
+        if self._draining:
+            # tensors were consumed (stream stays framed) but no new work
+            # is accepted: overloaded is the retry-elsewhere signal
+            rspan.end(status=_trace.STATUS_REJECTED)
+            send_status_frame(conn, STATUS_OVERLOADED, "replica draining")
+            return True
+        engine = self._engine_for(model)
+        if engine is None:
+            rspan.end(status=_trace.STATUS_ERROR, error="unknown model")
+            send_status_frame(conn, STATUS_ERROR,
+                              f"unknown model {model!r}")
+            return True
         try:
-            fut = self.engine.submit(inputs, deadline_ms=deadline_ms,
-                                     trace_ctx=rspan.ctx())
+            fut = engine.submit(inputs, deadline_ms=deadline_ms,
+                                trace_ctx=rspan.ctx())
             outs = fut.result(timeout=self._RESULT_TIMEOUT_S)
-        except ServerOverloadedError as e:
+        except (ServerOverloadedError, EngineStoppedError) as e:
+            # a stopped/draining engine is backpressure, not failure:
+            # the client should fail over to another replica
             rspan.end(status=_trace.STATUS_REJECTED)
             send_status_frame(conn, STATUS_OVERLOADED, str(e))
             return True
@@ -217,28 +329,122 @@ class PredictorServer:
         queue/bucket/deadline counters plus `warm_start_ms` and the
         `compile_cache` hit/miss stats, so a fleet dashboard can tell a
         replica that warm-started from the persistent executable cache
-        from one that paid its own compiles."""
-        return self.engine.stats()
+        from one that paid its own compiles. Hosted models appear under
+        `models`; a `stats_extra()` hook merges on top (fleet agents
+        report per-tenant SLO + memory there)."""
+        stats = self.engine.stats()
+        stats["draining"] = self._draining
+        if self.engines:
+            stats["models"] = {name: eng.stats()
+                               for name, eng in self.engines.items()}
+        if self.stats_extra is not None:
+            try:
+                stats.update(self.stats_extra())
+            except Exception:
+                pass  # a broken hook must not break the health probe
+        return stats
 
-    def stop(self, drain: bool = True):
+    def _close_listener(self):
+        # shutdown() BEFORE close(): close() alone only drops this
+        # process's fd — a parked accept() or a connecting peer can keep
+        # the port half-alive. shutdown() tears the socket down
+        # immediately so the port is observably closed (PR-3 regression).
         self._stop.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
         if self._thread is not None:
             self._thread.join(timeout=2)
-        self.engine.stop(drain=drain)
+
+    def drain(self) -> dict:
+        """Graceful drain ('PDDR'): every ACCEPTED request completes or is
+        rejected with the overloaded status — never silently dropped.
+        Ordering: (1) mark draining so requests still arriving on live
+        connections get STATUS_OVERLOADED, (2) close the listening port
+        (no new connections), (3) `on_drain()` (the fleet agent
+        deregisters its lease), (4) every engine finishes its queued work
+        (`stop(drain=True)`). Idempotent; returns the drain report."""
+        with self._drain_lock:
+            already = self._draining
+            self._draining = True
+        if already:
+            return {"drained": True, "already": True}
+        self._close_listener()
+        if self.on_drain is not None:
+            try:
+                self.on_drain()
+            except Exception:
+                pass  # the drain itself must still complete
+        report = {"drained": True, "completed": {}, "port": self.port,
+                  **self.drain_info}
+        for name, eng in [("", self.engine), *self.engines.items()]:
+            eng.stop(drain=True)
+            counts = eng.stats().get("counters", {})
+            report["completed"][name or "default"] = \
+                counts.get("completed", 0)
+        return report
+
+    def stop(self, drain: bool = True):
+        if drain:
+            self.drain()
+            return
+        self._draining = True
+        self._close_listener()
+        self.engine.stop(drain=False)
+        for eng in self.engines.values():
+            eng.stop(drain=False)
+
+
+class ReplicaConnectError(ConnectionError):
+    """No replica accepted a connection within the retry budget."""
 
 
 class PredictorClient:
-    """Minimal python-side client of the wire protocol (the C client in
-    csrc/predict_capi.cpp is the production ABI; this one drives tests and
-    python tooling — including the health probe)."""
+    """Python-side client of the wire protocol (the C client in
+    csrc/predict_capi.cpp is the production ABI; this one drives tests,
+    tooling and the fleet router — including the health probe).
 
-    def __init__(self, host, port, timeout: float = 60.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    Hardened the same way the PS RPC plane is (FLAGS_ps_rpc_* lineage):
+    connects are BOUNDED — `FLAGS_serving_client_max_retries` attempts
+    with exponential backoff and full jitter, each capped at
+    `FLAGS_serving_client_connect_timeout_s` — and every call takes an
+    optional deadline that bounds the wire wait, so a wedged replica
+    surfaces as TimeoutError instead of a hang.
+
+    Construct with a single `(host, port)` (back-compat) or
+    `replicas=[(h, p), ...]`; with several replicas, transport errors
+    transparently fail over to the next one (`failover=False` for
+    at-most-one-attempt callers like the fleet router, which keeps its
+    own exactly-once ledger)."""
+
+    def __init__(self, host=None, port=None, timeout: float = 60.0,
+                 replicas=None, failover: Optional[bool] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_ms: Optional[float] = None,
+                 connect_timeout: Optional[float] = None):
+        if replicas is None:
+            if host is None or port is None:
+                raise ValueError("need (host, port) or replicas=[...]")
+            replicas = [(host, int(port))]
+        self.replicas = [(h, int(p)) for h, p in replicas]
+        self.timeout = timeout
+        self.failover = (len(self.replicas) > 1) if failover is None \
+            else failover
+        self._max_retries = int(_flags.flag("serving_client_max_retries")
+                                if max_retries is None else max_retries)
+        self._backoff_ms = float(_flags.flag("serving_client_backoff_ms")
+                                 if backoff_ms is None else backoff_ms)
+        self._connect_timeout = float(
+            _flags.flag("serving_client_connect_timeout_s")
+            if connect_timeout is None else connect_timeout)
+        self._sock: Optional[socket.socket] = None
+        self._idx = 0  # replica the live socket points at
+        self._connect()
 
     # wire status -> terminal span status for the client.send root span
     _SPAN_STATUS = {STATUS_OK: _trace.STATUS_OK,
@@ -246,49 +452,165 @@ class PredictorClient:
                     STATUS_OVERLOADED: _trace.STATUS_REJECTED,
                     STATUS_DEADLINE: _trace.STATUS_DEADLINE}
 
-    def run(self, arrays, deadline_ms: Optional[float] = None):
+    @property
+    def endpoint(self):
+        """(host, port) the live connection points at."""
+        return self.replicas[self._idx % len(self.replicas)]
+
+    def _connect(self, deadline: Optional[float] = None):
+        """Bounded connect: up to max_retries+1 rounds over the replica
+        list, exponential backoff with FULL jitter between rounds (decorr
+        against thundering-herd reconnects), the whole dance optionally
+        bounded by an absolute `deadline`."""
+        self._disconnect()
+        last: Optional[Exception] = None
+        for attempt in range(self._max_retries + 1):
+            for k in range(len(self.replicas)):
+                idx = (self._idx + k) % len(self.replicas)
+                host, port = self.replicas[idx]
+                ct = self._connect_timeout
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            "connect deadline exceeded") from last
+                    ct = min(ct, remaining)
+                try:
+                    sock = socket.create_connection((host, port),
+                                                    timeout=ct)
+                    sock.settimeout(self.timeout)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    self._sock, self._idx = sock, idx
+                    return
+                except OSError as e:
+                    last = e
+            if attempt < self._max_retries:
+                # full jitter: sleep U(0, base * 2^attempt)
+                delay = random.random() * (self._backoff_ms / 1000.0
+                                           ) * (2 ** attempt)
+                if deadline is not None:
+                    delay = min(delay,
+                                max(0.0, deadline - time.monotonic()))
+                time.sleep(delay)
+        raise ReplicaConnectError(
+            f"no replica reachable after {self._max_retries + 1} "
+            f"rounds over {self.replicas}") from last
+
+    def _disconnect(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _ensure(self, deadline: Optional[float] = None):
+        if self._sock is None:
+            self._connect(deadline)
+        return self._sock
+
+    def run(self, arrays, deadline_ms: Optional[float] = None,
+            model: Optional[str] = None):
         """Returns (status, payload): payload is the output list on
-        STATUS_OK, else the server's utf-8 message.
+        STATUS_OK, else the server's utf-8 message. `deadline_ms` rides
+        the wire ('PDRD') AND bounds the local wait; `model` sends the
+        'PDMQ' prefix to pick a hosted model on a multi-model replica.
+
+        With several replicas (and `failover` on), a transport error
+        moves to the next replica and retries the WHOLE request within
+        the original deadline. That is at-least-once: a reply lost in
+        flight may mean the work ran twice — callers needing
+        exactly-once (the fleet router) set failover=False and keep a
+        sequence ledger.
 
         Under `FLAGS_trace` each call mints a new trace: a `client.send`
         root span whose context rides a 'PDTC' prefix frame, so the
         server (and engine) spans land in the SAME trace. Tracing off =
         byte-identical frames to the pre-PDTC protocol."""
+        deadline = (time.monotonic() + deadline_ms / 1000.0
+                    if deadline_ms is not None else None)
+        attempts = len(self.replicas) if self.failover else 1
+        last: Optional[Exception] = None
+        for attempt in range(attempts):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError("client deadline exceeded") from last
+            try:
+                return self._run_once(arrays, deadline_ms, deadline, model)
+            except (ConnectionError, TimeoutError, OSError,
+                    struct.error) as e:
+                last = e
+                self._disconnect()
+                self._idx += 1  # next attempt starts at the next replica
+        raise last  # type: ignore[misc]
+
+    def _run_once(self, arrays, deadline_ms, deadline, model):
+        sock = self._ensure(deadline)
         with _trace.span("client.send",
                          attrs={"n_tensors": len(arrays)}) as sp:
             if sp.trace_id is not None:
-                send_trace_frame(self._sock, sp.ctx())
+                send_trace_frame(sock, sp.ctx())
+            if model is not None:
+                name = model.encode()
+                sock.sendall(struct.pack("<II", _MODEL_MAGIC, len(name))
+                             + name)
             if deadline_ms is not None:
                 hdr = struct.pack("<III", _REQ_DEADLINE_MAGIC,
                                   int(deadline_ms), len(arrays))
             else:
                 hdr = struct.pack("<II", _REQ_MAGIC, len(arrays))
-            self._sock.sendall(hdr)
+            sock.sendall(hdr)
             for a in arrays:
-                _write_tensor(self._sock, np.asarray(a))
-            magic, status = struct.unpack("<IB",
-                                          _recv_exact(self._sock, 5))
+                _write_tensor(sock, np.asarray(a))
+            magic, status = struct.unpack(
+                "<IB", _recv_exact(sock, 5, deadline))
             if magic != _RESP_MAGIC:
                 raise ConnectionError(f"bad response magic {magic:#x}")
             if status != STATUS_OK:
-                ln, = struct.unpack("<I", _recv_exact(self._sock, 4))
-                msg = _recv_exact(self._sock, ln).decode()
+                ln, = struct.unpack("<I", _recv_exact(sock, 4, deadline))
+                msg = _recv_exact(sock, ln, deadline).decode()
                 sp.end(status=self._SPAN_STATUS.get(
                     status, _trace.STATUS_ERROR))
                 return status, msg
-            n, = struct.unpack("<I", _recv_exact(self._sock, 4))
-            return status, [_read_tensor(self._sock) for _ in range(n)]
+            n, = struct.unpack("<I", _recv_exact(sock, 4, deadline))
+            return status, [_read_tensor(sock, deadline)
+                            for _ in range(n)]
 
-    def health(self) -> dict:
-        self._sock.sendall(struct.pack("<I", _HEALTH_MAGIC))
-        magic, status = struct.unpack("<IB", _recv_exact(self._sock, 5))
-        if magic != _RESP_MAGIC or status != STATUS_OK:
-            raise ConnectionError("bad health response")
-        ln, = struct.unpack("<I", _recv_exact(self._sock, 4))
-        return json.loads(_recv_exact(self._sock, ln).decode())
+    def _json_exchange(self, magic: int, body: bytes = b"",
+                       deadline_ms: Optional[float] = None) -> dict:
+        deadline = (time.monotonic() + deadline_ms / 1000.0
+                    if deadline_ms is not None else None)
+        sock = self._ensure(deadline)
+        if body:
+            sock.sendall(struct.pack("<II", magic, len(body)) + body)
+        else:
+            sock.sendall(struct.pack("<I", magic))
+        rmagic, status = struct.unpack("<IB", _recv_exact(sock, 5,
+                                                          deadline))
+        if rmagic != _RESP_MAGIC:
+            raise ConnectionError(f"bad response magic {rmagic:#x}")
+        ln, = struct.unpack("<I", _recv_exact(sock, 4, deadline))
+        payload = _recv_exact(sock, ln, deadline).decode()
+        if status != STATUS_OK:
+            raise ConnectionError(f"status {status}: {payload}")
+        return json.loads(payload)
+
+    def health(self, deadline_ms: Optional[float] = None) -> dict:
+        return self._json_exchange(_HEALTH_MAGIC, deadline_ms=deadline_ms)
+
+    def drain(self, deadline_ms: Optional[float] = None) -> dict:
+        """Graceful drain ('PDDR'); returns the replica's drain report.
+        The server closes the connection afterwards."""
+        report = self._json_exchange(_DRAIN_MAGIC, deadline_ms=deadline_ms)
+        self._disconnect()
+        return report
+
+    def model_ctl(self, op: str, model: str,
+                  deadline_ms: Optional[float] = None) -> dict:
+        """'PDMV' model-version control: op is `reload` or `rollback`."""
+        body = json.dumps({"op": op, "model": model}).encode()
+        return self._json_exchange(_MODEL_CTL_MAGIC, body,
+                                   deadline_ms=deadline_ms)
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._disconnect()

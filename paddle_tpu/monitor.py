@@ -773,6 +773,51 @@ def _slo_main(args) -> int:
     return 0
 
 
+def _fleet_main(args) -> int:
+    """`python -m paddle_tpu.monitor fleet [path] [--probe HOST:PORT ...]`
+    — render the replica table from a flight dump's `fleet` section
+    (FleetRouter.dump), or build one live by probing each `--probe`
+    replica's 'PDHQ' endpoint."""
+    import sys as _sys
+    from .serving.fleet import render_fleet
+    if args.probe:
+        from .inference.server import PredictorClient
+        doc = {"fleet": "probe", "replicas": {}}
+        for i, spec in enumerate(args.probe):
+            host, _, port = spec.rpartition(":")
+            row = {"host": host or "127.0.0.1", "port": int(port),
+                   "healthy": False, "draining": False, "score": 0.0,
+                   "served": 0, "failures": 0, "queue_depth": 0,
+                   "warm_start_ms": None, "tenants": []}
+            try:
+                c = PredictorClient(row["host"], row["port"],
+                                    connect_timeout=2.0, max_retries=0)
+                s = c.health(deadline_ms=3000)
+                c.close()
+                rid = s.get("replica_id", i)
+                row.update(healthy=True,
+                           draining=bool(s.get("draining")),
+                           queue_depth=s.get("queue_depth", 0),
+                           warm_start_ms=s.get("warm_start_ms"),
+                           tenants=sorted((s.get("tenants") or {}).keys()))
+            except Exception as e:
+                rid = i
+                row["error"] = f"{type(e).__name__}"
+            doc["replicas"][str(rid)] = row
+        print(render_fleet(doc))
+        return 0
+    if args.path is None:
+        print("error: pass a flight dump path or --probe HOST:PORT",
+              file=_sys.stderr)
+        return 2
+    doc = _load_artifact(args.path)
+    # FleetRouter.dump passes the snapshot via the recorder's `extra`
+    # channel, which lands under "extra" in the artifact
+    fleet_doc = doc.get("fleet") or (doc.get("extra") or {}).get("fleet")
+    print(render_fleet(fleet_doc))
+    return 0
+
+
 def _cache_main(args) -> int:
     """`python -m paddle_tpu.monitor cache [dir] [--gc] [--verify]`."""
     from .core import compile_cache as _cc
@@ -836,6 +881,15 @@ def _main(argv=None) -> int:
                     "flight-recorder dump, a monitor snapshot's slo.* "
                     "gauges, or — with no path — this live process)")
     p_slo.add_argument("path", nargs="?", default=None)
+    p_fleet = sub.add_parser(
+        "fleet", help="render a fleet replica table: from a flight dump's "
+                      "`fleet` section (FleetRouter.dump), or live via "
+                      "--probe HOST:PORT health probes")
+    p_fleet.add_argument("path", nargs="?", default=None)
+    p_fleet.add_argument("--probe", action="append", default=[],
+                         metavar="HOST:PORT",
+                         help="probe a replica's 'PDHQ' endpoint "
+                              "(repeatable)")
     p_cache = sub.add_parser(
         "cache", help="inspect a persistent compile-cache directory "
                       "(core/compile_cache.py): list entries; --gc to "
@@ -853,6 +907,8 @@ def _main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.cmd == "cache":
         return _cache_main(args)
+    if args.cmd == "fleet":
+        return _fleet_main(args)
     if args.cmd == "slo":
         return _slo_main(args)
     if args.cmd == "show":

@@ -270,6 +270,21 @@ def reset() -> None:
         _PLANE.reset()
 
 
+def shortest_window_burn(stats_doc: Optional[Dict[str, Any]]) -> float:
+    """Burn rate of the SHORTEST window in a `stats()`-shaped doc (keys
+    are `str(window_seconds)`). The fastest-reacting window is the fleet
+    tier's routing/canary signal — it spikes on a fresh error burst long
+    before the long windows move. 0.0 on a missing/empty/garbled doc: a
+    replica that reports no SLO section routes on queue alone."""
+    if not isinstance(stats_doc, dict):
+        return 0.0
+    windows = stats_doc.get("burn") or {}
+    try:
+        return float(windows[min(windows, key=lambda w: int(w))])
+    except (ValueError, TypeError, KeyError):
+        return 0.0
+
+
 # ---- rendering (monitor CLI `slo` subcommand) -------------------------------
 
 def doc_from_snapshot(snap: Dict[str, Any]) -> Optional[Dict[str, Any]]:

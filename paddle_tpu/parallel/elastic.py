@@ -38,11 +38,20 @@ class ElasticManager:
         self.heartbeat_interval = heartbeat_interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # on_rank_dead plane: callbacks fired once per lease-expiry
+        # TRANSITION (a rank that heartbeats again re-arms), driven by a
+        # dedicated watcher thread so callers don't have to poll
+        # alive_ranks themselves
+        self._dead_cbs: List = []
+        self._known_dead: set = set()
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
 
     # -- node side --
     def register(self):
         self._beat()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="elastic-heartbeat")
         self._thread.start()
         return self
 
@@ -70,10 +79,55 @@ class ElasticManager:
 
     def stop(self):
         self._stop.set()
+        self._watch_stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2)
+            self._watch_thread = None
 
     # -- watcher side --
+    def on_rank_dead(self, callback, interval: Optional[float] = None):
+        """Register `callback(rank)` to fire ONCE per lease-expiry
+        transition (the fleet router and tests react to replica death
+        promptly instead of polling `alive_ranks`). Each newly expired
+        lease also counts `elastic.lease_expired`. A rank whose lease
+        recovers (rejoin) re-arms: a later expiry fires again. The first
+        registration starts the `elastic-watcher` thread; `stop()` ends
+        it."""
+        self._dead_cbs.append(callback)
+        if self._watch_thread is None:
+            iv = interval if interval is not None else \
+                min(1.0, self.heartbeat_interval)
+            self._watch_stop.clear()
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, args=(iv,), daemon=True,
+                name="elastic-watcher")
+            self._watch_thread.start()
+        return self
+
+    def _watch_loop(self, interval: float) -> None:
+        ever_alive: set = set()
+        while not self._watch_stop.wait(interval):
+            try:
+                alive = set(self.alive_ranks())
+            except Exception:
+                continue  # transient store blip: check next interval
+            ever_alive |= alive
+            # only a rank that was OBSERVED alive can expire — a fleet
+            # watching a sparse id space must not page for ids that never
+            # registered
+            dead = ever_alive - alive
+            fresh = dead - self._known_dead
+            self._known_dead = dead  # recovered ranks re-arm implicitly
+            for r in sorted(fresh):
+                if _monitor._ENABLED:
+                    _monitor.count("elastic.lease_expired")
+                for cb in list(self._dead_cbs):
+                    try:
+                        cb(r)
+                    except Exception:
+                        pass  # one bad callback must not kill the watcher
     def alive_ranks(self) -> List[int]:
         now = time.time()
         alive = []

@@ -16,10 +16,17 @@ from .engine import (  # noqa: F401
     DeadlineExceededError, EngineConfig, EngineStoppedError, NoBucketError,
     ResponseFuture, ServerOverloadedError, ServingEngine, ServingError,
 )
+from .fleet import (  # noqa: F401  (after engine: fleet builds on it)
+    FleetError, FleetRouter, HBMBudgetExceededError, ModelTenant,
+    NoHealthyReplicaError, ReplicaAgent, RolloutResult, SequenceLedger,
+)
 
 __all__ = [
     "ServingEngine", "EngineConfig", "ResponseFuture",
     "ShapeBucket", "BucketSet", "default_batch_sizes", "signature_of",
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "EngineStoppedError", "NoBucketError",
+    "FleetRouter", "ReplicaAgent", "ModelTenant", "SequenceLedger",
+    "RolloutResult", "FleetError", "NoHealthyReplicaError",
+    "HBMBudgetExceededError",
 ]

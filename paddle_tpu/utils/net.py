@@ -28,6 +28,28 @@ _RESP_MAGIC = 0x50445253  # 'PDRS'
 # interoperate with new ones.
 TRACE_MAGIC = 0x50445443  # 'PDTC'
 
+# Fleet-tier control frames (serving/fleet.py). Same back-compat
+# discipline as 'PDTC': every frame is opt-in, absence means the
+# single-replica protocol, so a fleet router can talk to a pre-fleet
+# server (it just cannot drain it).
+#
+# 'PDDR' — graceful drain (no body). The replica stops accepting new
+#   work (its listening port CLOSES, new requests on live connections
+#   get STATUS_OVERLOADED), finishes every in-flight/queued batch,
+#   deregisters its lease, then answers STATUS_OK + u32 len + JSON drain
+#   report on the control connection.
+DRAIN_MAGIC = 0x50444452  # 'PDDR'
+# 'PDMQ' — OPTIONAL model-select prefix: u32 len + utf-8 model name,
+#   sent before 'PDRQ'/'PDRD' to route the request to a named hosted
+#   model (multi-model replicas). Absence = the default model.
+MODEL_MAGIC = 0x50444D51  # 'PDMQ'
+# 'PDMV' — model version control: u32 len + JSON {op: reload|rollback,
+#   model: name}; answers STATUS_OK + u32 len + JSON {ok, version, ...}.
+#   `reload` re-reads the newest committed generation of the tenant's
+#   versioned weight store; `rollback` promotes the guard checkpoint
+#   .bak generation first (instant rollback of a bad push).
+MODEL_CTL_MAGIC = 0x50444D56  # 'PDMV'
+
 
 def send_trace_frame(sock, ctx) -> None:
     """Send the 'PDTC' prefix for a traced request (`ctx` is an

@@ -150,3 +150,41 @@ def _no_trace_leak():
     assert depth_after == depth_before, (
         f"{depth_after - depth_before} open span(s) leaked out of the "
         "test (Span.end() never reached — error path missing a close?)")
+
+
+@pytest.fixture(autouse=True)
+def _no_fleet_leak():
+    """A fleet router or replica agent leaking out of a test keeps its
+    health/heartbeat/watcher threads probing dead endpoints under every
+    later test. Assert the fleet plane is quiescent after EVERY test (and
+    reap leftovers, so one offender cannot cascade)."""
+    import threading
+    import time
+    from paddle_tpu.serving import fleet as _fleet
+
+    def fleet_threads():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive() and t.name in
+                ("fleet-health", "elastic-heartbeat", "elastic-watcher",
+                 "predictor-serve")]
+
+    before = len(fleet_threads())
+    yield
+    leaked = [obj for obj in list(_fleet._LIVE)
+              if not getattr(obj, "_closed", True)]
+    for obj in leaked:
+        try:
+            obj.close() if hasattr(obj, "close") else obj.stop(drain=False)
+        except Exception:
+            pass
+    for _ in range(20):  # reaped threads need a beat to exit
+        after = fleet_threads()
+        if len(after) <= before:
+            break
+        time.sleep(0.1)
+    assert not leaked, (
+        f"{len(leaked)} fleet object(s) leaked out of the test "
+        f"(router.close()/agent.stop() never reached): "
+        f"{[type(o).__name__ for o in leaked]}")
+    assert len(after := fleet_threads()) <= before, (
+        f"fleet/elastic thread(s) leaked out of the test: {after}")

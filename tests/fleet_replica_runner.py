@@ -1,0 +1,46 @@
+"""Fleet replica runner (executed by test_fleet.py's chaos soak).
+
+Joins a fleet as ONE ReplicaAgent in a real child process: connects to
+the parent's TCPStore, registers + heartbeats, serves until killed
+(SIGKILL is the point of the drill) or until the parent writes a line on
+stdin for a graceful exit. Publishes `replica_id host port` through the
+port file once registered.
+
+argv: [store_host, store_port, fleet_name, port_file]
+env:  FLEET_REPLICA_ID (optional) — rejoin with a FIXED id instead of
+      claiming a fresh one (the respawn half of the chaos drill).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+store_host = sys.argv[1]
+store_port = int(sys.argv[2])
+fleet_name = sys.argv[3]
+port_file = sys.argv[4]
+
+from paddle_tpu._native import TCPStore  # noqa: E402
+from paddle_tpu.core import flags as _flags  # noqa: E402
+from paddle_tpu.serving import EngineConfig, ReplicaAgent  # noqa: E402
+
+_flags.set_flags({"fleet_heartbeat_s": 0.15, "fleet_lease_ttl_s": 0.6})
+
+store = TCPStore(store_host, store_port, is_master=False)
+rid = os.environ.get("FLEET_REPLICA_ID")
+agent = ReplicaAgent(
+    lambda x: x * 2.0, store, fleet=fleet_name,
+    replica_id=int(rid) if rid else None,
+    engine_config=EngineConfig(warmup_on_start=False, batch_timeout_ms=2,
+                               max_batch_size=8)).start()
+
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    f.write(f"{agent.replica_id} {agent.host} {agent.port}")
+os.rename(tmp, port_file)   # atomic: the parent never reads a half-write
+
+sys.stdin.readline()        # parent says "exit gracefully" (or SIGKILLs us)
+agent.stop(drain=True)

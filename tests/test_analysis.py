@@ -809,7 +809,10 @@ class TestSelfLint:
              # request tracing + SLO plane (ISSUE 12): every request
              # crosses these — span bookkeeping must stay sync-free
              os.path.join(PKG, "obs", "trace.py"),
-             os.path.join(PKG, "obs", "slo.py")],
+             os.path.join(PKG, "obs", "slo.py"),
+             # fleet serving tier (ISSUE 13): every routed request
+             # crosses the dispatch/scoring path
+             os.path.join(PKG, "serving", "fleet.py")],
             all_functions=True)
         assert n_files > 25
         assert findings == [], "\n".join(f.format() for f in findings)
