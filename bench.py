@@ -911,6 +911,85 @@ def bench_serving_slo(backend):
     return out
 
 
+def bench_llm(backend):
+    """Continuous-batching LLM serving (serving/llm.py): concurrent
+    variable-length requests through the slot-paged KV-cache engine.
+    Reports prefill vs decode tokens/s, TTFT and inter-token latency
+    histograms (p50/p95/p99), steady-state compile count (the zero-
+    compile claim, measured), and — in the ab arm — the fp32 vs int8
+    weight-only A/B (BENCH_r08 follow-on to resnet50_infer_int8, but on
+    the decode path where weight HBM reads dominate).
+
+    Knob: BENCH_LLM=on|ab|off (default ab runs both arms)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.monitor as monitor
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTModel
+    from paddle_tpu.serving.llm import LLMConfig, LLMEngine
+
+    arm = os.environ.get("BENCH_LLM", "ab").lower()
+    if arm == "off":
+        return {"skipped": "BENCH_LLM=off"}
+    big = backend == "tpu"
+    vocab, n_req = 8192, (32 if big else 8)
+    max_new = 48 if big else 16
+
+    def one_arm(quant):
+        paddle.seed(0)
+        lm = GPTForCausalLM(GPTModel(
+            vocab_size=vocab, hidden_size=256 if big else 64,
+            num_layers=4 if big else 2, num_heads=8 if big else 4,
+            max_seq_len=512, dropout=0.0))
+        cfg = LLMConfig(num_slots=8, max_len=256 if big else 64,
+                        max_new_tokens=max_new, quant=quant,
+                        kv_int8=(quant == "int8"))
+        _flags.set_flags({"monitor": True})
+        monitor.reset()
+        eng = LLMEngine(lm, cfg).start()   # warmup pays every compile
+        rng = np.random.default_rng(0)
+        lens = rng.integers(4, cfg.max_len - max_new, size=n_req)
+        prompts = [rng.integers(0, vocab, size=int(L)).tolist()
+                   for L in lens]
+        c0 = monitor.snapshot()["counters"].get("trace_compile", 0)
+        t0 = time.perf_counter()
+        streams = [eng.submit(p) for p in prompts]
+        results = [s.result(timeout=600.0) for s in streams]
+        wall = time.perf_counter() - t0
+        snap = monitor.snapshot()
+        hist = snap["histograms"]
+        compiles = snap["counters"].get("trace_compile", 0) - c0
+        decode_toks = sum(len(t) for _, t in results)
+        first_token = hist.get("llm.ttft_ms", {})
+        inter = hist.get("llm.inter_token_ms", {})
+        out = {
+            "requests": n_req,
+            "prefill_tokens_per_s": round(
+                float(sum(lens)) / max(wall, 1e-9), 1),
+            "decode_tokens_per_s": round(decode_toks / max(wall, 1e-9), 1),
+            "ttft_ms": {k: round(first_token.get(k, 0.0), 2)
+                        for k in ("p50", "p95", "p99")},
+            "inter_token_ms": {k: round(inter.get(k, 0.0), 3)
+                               for k in ("p50", "p95", "p99")},
+            "steady_state_compiles": compiles,
+            "kv_pool_mb": round(eng.kv_pool_bytes() / 2**20, 2),
+            "warm_start_ms": round(eng.stats()["warm_start_ms"], 1),
+        }
+        eng.stop()
+        monitor.reset()
+        _flags.set_flags({"monitor": False})
+        return out
+
+    fp32 = one_arm("off")
+    if arm != "ab":
+        return fp32
+    int8 = one_arm("int8")
+    speedup = None
+    if fp32.get("decode_tokens_per_s"):
+        speedup = round(int8["decode_tokens_per_s"]
+                        / fp32["decode_tokens_per_s"], 3)
+    return {"fp32": fp32, "int8": int8, "int8_decode_speedup": speedup}
+
+
 def _run_workload(name, fn, backend, partial_extra):
     """Run one bench workload. Outage -> structured {"outage": true} JSON
     (with everything measured so far) and rc=0; any other failure is
@@ -949,6 +1028,7 @@ def main():
                     ("ernie10b_layer", bench_ernie10b_layer),
                     ("allreduce_smoke", bench_allreduce),
                     ("serving_slo", bench_serving_slo),
+                    ("llm", bench_llm),
                     ("warm_start", bench_warm_start)):
         extra[key] = _run_workload(key, fn, backend, extra)
 

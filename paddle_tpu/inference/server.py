@@ -26,6 +26,15 @@ Wire protocol (little-endian), on top of csrc/predict_capi.cpp's framing:
              deregisters; answers status 0 + u32 len + JSON drain report
   model ctl: u32 'PDMV', u32 len, JSON {op: reload|rollback, model};
              answers status 0 + u32 len + JSON {ok, version, ...}
+  stream:    u32 'PDSQ', u32 max_new_tokens, u32 deadline_ms (0 = none),
+             u32 n_tensors (=1), one 1-D i32 prompt tensor — continuous-
+             batching LLM generation (serving/llm.py, pass `llm_engine=`).
+             Each generated token is pushed the moment the scheduler
+             emits it as u32 'PDST' + u32 index + i32 token; the exchange
+             then ends in a standard 'PDRS' frame (status 0 + the full
+             token tensor, or error/overloaded/deadline + message), so a
+             non-streaming caller can skip 'PDST' frames and read the
+             terminal response like any other request
   response:  u32 'PDRS', u8 status;
              status 0: u32 n_tensors + tensors ('PDHQ': u32 len + JSON)
              status 1 (error) / 2 (overloaded/draining, retryable) /
@@ -67,7 +76,8 @@ from ..serving import (  # noqa: E402
 from ..utils.net import (  # noqa: E402
     DRAIN_MAGIC as _DRAIN_MAGIC, MODEL_CTL_MAGIC as _MODEL_CTL_MAGIC,
     MODEL_MAGIC as _MODEL_MAGIC, STATUS_DEADLINE, STATUS_ERROR, STATUS_OK,
-    STATUS_OVERLOADED, TRACE_MAGIC as _TRACE_MAGIC,
+    STATUS_OVERLOADED, STREAM_MAGIC as _STREAM_MAGIC,
+    STREAM_REQ_MAGIC as _STREAM_REQ_MAGIC, TRACE_MAGIC as _TRACE_MAGIC,
     recv_exact as _recv_exact, recv_trace_frame, send_status_frame,
     send_trace_frame)
 
@@ -116,9 +126,14 @@ class PredictorServer:
     def __init__(self, predictor, host="127.0.0.1", port=0,
                  engine: Optional[ServingEngine] = None,
                  engine_config: Optional[EngineConfig] = None,
-                 on_drain=None, on_model_ctl=None, stats_extra=None):
+                 llm_engine=None, on_drain=None, on_model_ctl=None,
+                 stats_extra=None):
         self.predictor = predictor
         self.engine = engine or ServingEngine(predictor, engine_config)
+        # continuous-batching generation plane (serving/llm.py): serves
+        # 'PDSQ' streaming requests when present; absent -> 'PDSQ' gets a
+        # clean STATUS_ERROR and the batch protocol is untouched
+        self.llm_engine = llm_engine
         # named hosted models (multi-model replicas): 'PDMQ'-selected
         # requests route to engines[name]; the unnamed default stays
         # `self.engine` so single-model callers are untouched
@@ -144,6 +159,8 @@ class PredictorServer:
 
     def start(self):
         self.engine.start()
+        if self.llm_engine is not None:
+            self.llm_engine.start()
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name="predictor-serve")
         self._thread.start()
@@ -219,6 +236,16 @@ class PredictorServer:
             return False  # drained: nothing more to serve
         if magic == _MODEL_CTL_MAGIC:
             return self._handle_model_ctl(conn)
+        if magic == _STREAM_REQ_MAGIC:
+            rspan = _trace.server_span("serving.stream", tctx)
+            try:
+                keep = self._handle_stream(conn, rspan)
+            except BaseException as e:
+                rspan.end(status=_trace.STATUS_ERROR,
+                          error=f"{type(e).__name__}: {str(e)[:200]}")
+                raise
+            rspan.end()
+            return keep
         # serving.request: the server-side root of this request's trace,
         # parented on the client's wire context; closes with the same
         # status the wire response carries (absence of 'PDTC' -> no-op)
@@ -314,6 +341,74 @@ class PredictorServer:
                 _write_tensor(conn, np.asarray(o))
         return True
 
+    def _handle_stream(self, conn, rspan) -> bool:
+        """'PDSQ' streaming generation. This handler thread is the SINGLE
+        socket writer: it drains the LLMStream's token queue and pushes a
+        'PDST' frame per token, then the terminal 'PDRS' — the scheduler
+        thread never touches the connection."""
+        read_deadline = time.monotonic() + self._READ_TIMEOUT_S
+        max_new, dl, n = struct.unpack(
+            "<III", _recv_exact(conn, 12, read_deadline))
+        try:
+            inputs = [_read_tensor(conn, read_deadline) for _ in range(n)]
+        except ValueError as e:
+            rspan.end(status=_trace.STATUS_ERROR, error=str(e)[:200])
+            send_status_frame(conn, STATUS_ERROR, str(e))
+            return False
+        if self.llm_engine is None:
+            send_status_frame(conn, STATUS_ERROR,
+                              "no llm engine hosted here")
+            return True
+        if n != 1 or self._draining:
+            if self._draining:
+                rspan.end(status=_trace.STATUS_REJECTED)
+                send_status_frame(conn, STATUS_OVERLOADED,
+                                  "replica draining")
+            else:
+                send_status_frame(conn, STATUS_ERROR,
+                                  f"stream request wants 1 prompt "
+                                  f"tensor, got {n}")
+            return True
+        from ..serving import ServingError
+        try:
+            stream = self.llm_engine.submit(
+                np.asarray(inputs[0]).reshape(-1),
+                max_new_tokens=int(max_new) or None,
+                deadline_ms=float(dl) if dl else None)
+        except (ServerOverloadedError, EngineStoppedError) as e:
+            rspan.end(status=_trace.STATUS_REJECTED)
+            send_status_frame(conn, STATUS_OVERLOADED, str(e))
+            return True
+        except ServingError as e:
+            rspan.end(status=_trace.STATUS_ERROR, error=str(e)[:200])
+            send_status_frame(conn, STATUS_ERROR, str(e))
+            return True
+        try:
+            for idx, tok in enumerate(stream.iter(
+                    timeout=self._RESULT_TIMEOUT_S)):
+                conn.sendall(struct.pack("<IIi", _STREAM_MAGIC, idx, tok))
+        except Exception:
+            # consumer gone or queue starved: the sequence keeps running
+            # server-side until its own budget/deadline evicts it
+            rspan.end(status=_trace.STATUS_ERROR, error="stream broken")
+            raise
+        status, tokens = stream.result(timeout=1.0)
+        if status == "done":
+            conn.sendall(struct.pack("<IBI", _RESP_MAGIC, STATUS_OK, 1))
+            _write_tensor(conn, np.asarray(tokens, np.int32))
+        elif status == "deadline":
+            rspan.end(status=_trace.STATUS_DEADLINE)
+            send_status_frame(conn, STATUS_DEADLINE,
+                              "generation deadline exceeded")
+        elif status == "stopped":
+            rspan.end(status=_trace.STATUS_REJECTED)
+            send_status_frame(conn, STATUS_OVERLOADED, "engine stopped")
+        else:
+            rspan.end(status=_trace.STATUS_ERROR,
+                      error=(stream.error or status)[:200])
+            send_status_frame(conn, STATUS_ERROR, stream.error or status)
+        return True
+
     def _handle(self, conn):
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -334,6 +429,8 @@ class PredictorServer:
         report per-tenant SLO + memory there)."""
         stats = self.engine.stats()
         stats["draining"] = self._draining
+        if self.llm_engine is not None:
+            stats["llm"] = self.llm_engine.stats()
         if self.engines:
             stats["models"] = {name: eng.stats()
                                for name, eng in self.engines.items()}
@@ -387,6 +484,10 @@ class PredictorServer:
             counts = eng.stats().get("counters", {})
             report["completed"][name or "default"] = \
                 counts.get("completed", 0)
+        if self.llm_engine is not None:
+            self.llm_engine.stop(drain=True)
+            report["completed"]["llm"] = self.llm_engine.stats()[
+                "counters"].get("completed", 0)
         return report
 
     def stop(self, drain: bool = True):
@@ -398,6 +499,8 @@ class PredictorServer:
         self.engine.stop(drain=False)
         for eng in self.engines.values():
             eng.stop(drain=False)
+        if self.llm_engine is not None:
+            self.llm_engine.stop(drain=False)
 
 
 class ReplicaConnectError(ConnectionError):
@@ -575,6 +678,48 @@ class PredictorClient:
             n, = struct.unpack("<I", _recv_exact(sock, 4, deadline))
             return status, [_read_tensor(sock, deadline)
                             for _ in range(n)]
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 deadline_ms: Optional[float] = None, on_token=None):
+        """Streaming LLM generation over 'PDSQ'. Returns (status,
+        payload): the full token list on STATUS_OK, else the server's
+        message. `on_token(index, token)` fires per 'PDST' frame as it
+        arrives, which is the streaming part — by the time this returns,
+        the generation is over.
+
+        No failover: a stream is stateful on its replica, so a transport
+        error mid-generation surfaces to the caller instead of silently
+        re-running the prompt elsewhere (tokens already delivered cannot
+        be un-streamed)."""
+        deadline = (time.monotonic() + deadline_ms / 1000.0
+                    if deadline_ms is not None else None)
+        sock = self._ensure(deadline)
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        sock.sendall(struct.pack("<IIII", _STREAM_REQ_MAGIC,
+                                 int(max_new_tokens),
+                                 int(deadline_ms or 0), 1))
+        _write_tensor(sock, prompt)
+        tokens = []
+        while True:
+            magic, = struct.unpack("<I", _recv_exact(sock, 4, deadline))
+            if magic == _STREAM_MAGIC:
+                idx, tok = struct.unpack(
+                    "<Ii", _recv_exact(sock, 8, deadline))
+                tokens.append(tok)
+                if on_token is not None:
+                    on_token(idx, tok)
+                continue
+            if magic != _RESP_MAGIC:
+                raise ConnectionError(f"bad stream magic {magic:#x}")
+            status, = struct.unpack("<B", _recv_exact(sock, 1, deadline))
+            if status != STATUS_OK:
+                ln, = struct.unpack("<I", _recv_exact(sock, 4, deadline))
+                return status, _recv_exact(sock, ln, deadline).decode()
+            n, = struct.unpack("<I", _recv_exact(sock, 4, deadline))
+            final = [_read_tensor(sock, deadline) for _ in range(n)]
+            if final:
+                tokens = [int(t) for t in np.asarray(final[0]).reshape(-1)]
+            return status, tokens
 
     def _json_exchange(self, magic: int, body: bytes = b"",
                        deadline_ms: Optional[float] = None) -> dict:

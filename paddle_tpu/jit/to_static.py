@@ -15,6 +15,7 @@ boundary exactly like `run_program_op`'s grad in the reference
 from __future__ import annotations
 
 import functools
+import weakref
 
 import jax
 
@@ -55,15 +56,33 @@ class StaticFunction:
     def layer(self):
         return self._layer
 
+    def release(self) -> None:
+        """Drop every cached executable. The `pure` closures in
+        `_jit_cache` reference `self` through jax's C-level function
+        wrappers, which the cycle collector cannot traverse — an owner
+        that wants `self._layer`'s weights freed must break the cycle
+        explicitly (e.g. a serving engine on `stop()`)."""
+        self._jit_cache.clear()
+        self._ledger.clear()
+
     def _get_pure(self, training, pnames, bnames, static_kwargs):
         key = ("pure", training, tuple(pnames), tuple(bnames),
                tuple(sorted(static_kwargs.items())))
         pure = self._jit_cache.get(key)
         if pure is None:
-            layer, func = self._layer, self._function
+            # Capture self WEAKLY: jax's C-level jit machinery keeps a
+            # reference to `pure` in a process-global cache, so a strong
+            # `layer`/`func` cell here would pin the whole model long
+            # after the StaticFunction is dropped. The weakref is always
+            # live during a call — the caller IS the StaticFunction.
+            self_ref = weakref.ref(self)
             kw = dict(static_kwargs)
 
             def pure(param_arrays, buffer_arrays, rng_key, input_arrays):
+                sf = self_ref()
+                if sf is None:  # pragma: no cover - defensive
+                    raise RuntimeError("StaticFunction was released")
+                layer, func = sf._layer, sf._function
                 rnd.push_trace_key(rng_key)
                 swapped = layer is not None and isinstance(
                     layer.__dict__.get("forward"), StaticFunction)

@@ -100,6 +100,105 @@ class ErnieSelfAttention(nn.Layer):
         ctx = reshape(ctx, [b, s, self.num_heads * self.head_dim])
         return self.out(ctx)
 
+    def forward_cached(self, x, k_cache, v_cache, positions,
+                       k_scale=None, v_scale=None):
+        """Cached-attention step over a fixed-shape KV cache (decode path).
+
+        x: [B, T, H] current block (T = prompt length at prefill, 1 at
+        decode). k_cache/v_cache: [B, L, nh, hd] with L fixed (the slot
+        page) — fp32, or int8 for the weight-only KV arm. positions: [B]
+        int32, tokens already cached per row; the block's K/V are written
+        at positions[b]..positions[b]+T-1 and attention runs over the
+        whole page under a validity mask (key j visible to query i iff
+        j <= positions[b]+i), so every (B, T, L) signature is ONE
+        executable regardless of how full each row is.
+
+        int8 mode (k_cache.dtype == int8): scale-per-row symmetric
+        quantization. With k_scale/v_scale None the scales are computed
+        fresh from this block's K/V (the prefill step); otherwise the
+        given [B] scales are reused and new entries clip into their grid
+        (the decode steps). Reads always dequantize cache * scale.
+
+        Inference-only: dropout is not applied inside the attention (the
+        surrounding norms/MLP still honor train/eval mode). Returns
+        (out, k_cache, v_cache, k_scale, v_scale) — scales are None in
+        fp32 mode.
+        """
+        import math as _math
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops._dispatch import run_op
+        from ..ops.math import _precision
+
+        b, t = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        qkv = reshape(qkv, [b, t, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scale = 1.0 / _math.sqrt(self.head_dim)
+        quant = "int8" in str(k_cache.dtype)
+        fresh = quant and k_scale is None
+        ins = [q, k, v, k_cache, v_cache, positions]
+        if quant and not fresh:
+            ins += [k_scale, v_scale]
+
+        def f(qa, ka, va, kc, vc, pos, *scales):
+            if quant:
+                if fresh:
+                    # symmetric per-row grid from this block's dynamic
+                    # range; later (decode) writes clip into it
+                    ks = jnp.maximum(jnp.max(jnp.abs(ka), axis=(1, 2, 3)),
+                                     1e-6) / 127.0
+                    vs = jnp.maximum(jnp.max(jnp.abs(va), axis=(1, 2, 3)),
+                                     1e-6) / 127.0
+                else:
+                    ks, vs = scales
+                kw = jnp.clip(jnp.round(ka / ks[:, None, None, None]),
+                              -127, 127).astype(jnp.int8)
+                vw = jnp.clip(jnp.round(va / vs[:, None, None, None]),
+                              -127, 127).astype(jnp.int8)
+            else:
+                kw, vw = ka, va
+
+            def upd(page, blk, p):
+                return jax.lax.dynamic_update_slice(page, blk, (p, 0, 0))
+
+            kc = jax.vmap(upd)(kc, kw, pos)
+            vc = jax.vmap(upd)(vc, vw, pos)
+            if quant:
+                kr = kc.astype(qa.dtype) * ks[:, None, None, None]
+                vr = vc.astype(qa.dtype) * vs[:, None, None, None]
+            else:
+                kr, vr = kc, vc
+            # mirror scaled_dot_product_attention's fused path exactly
+            # (same einsums/precision/mask value) so cached decode is
+            # bit-identical to the full-sequence forward
+            qh = jnp.swapaxes(qa, 1, 2)
+            kh = jnp.swapaxes(kr, 1, 2)
+            vh = jnp.swapaxes(vr, 1, 2)
+            logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh,
+                                precision=_precision()) * scale
+            span = jnp.arange(kh.shape[2], dtype=pos.dtype)
+            qpos = pos[:, None] + jnp.arange(qa.shape[1], dtype=pos.dtype)
+            valid = span[None, None, None, :] <= qpos[:, None, :, None]
+            logits = jnp.where(valid, logits, jnp.asarray(-1e9, logits.dtype))
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhst,bhtd->bhsd", probs, vh,
+                             precision=_precision())
+            out = jnp.swapaxes(out, 1, 2)
+            if quant:
+                return out, kc, vc, ks, vs
+            return out, kc, vc
+
+        outs = run_op(f, ins, "cached_attention")
+        if quant:
+            ctx, k_cache, v_cache, k_scale, v_scale = outs
+        else:
+            ctx, k_cache, v_cache = outs
+        ctx = reshape(ctx, [b, t, self.num_heads * self.head_dim])
+        return self.out(ctx), k_cache, v_cache, k_scale, v_scale
+
 
 class ErnieLayer(nn.Layer):
     def __init__(self, hidden_size, num_heads, intermediate_size, dropout=0.1,
@@ -116,6 +215,17 @@ class ErnieLayer(nn.Layer):
         x = self.norm1(x + self.dropout(self.attention(x, attn_mask)))
         x = self.norm2(x + self.mlp(x))
         return x
+
+    def forward_cached(self, x, k_cache, v_cache, positions,
+                       k_scale=None, v_scale=None):
+        """One transformer block through the cached-attention path; same
+        post-LN residual wiring as forward. Returns
+        (x, k_cache, v_cache, k_scale, v_scale)."""
+        attn, k_cache, v_cache, k_scale, v_scale = self.attention.forward_cached(
+            x, k_cache, v_cache, positions, k_scale, v_scale)
+        x = self.norm1(x + self.dropout(attn))
+        x = self.norm2(x + self.mlp(x))
+        return x, k_cache, v_cache, k_scale, v_scale
 
 
 class ErnieModel(nn.Layer):

@@ -25,8 +25,12 @@ class GPTEmbeddings(nn.Layer):
         self.position_embeddings = nn.Embedding(max_seq_len, hidden_size)
         self.dropout = nn.Dropout(dropout)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, position_offset=None):
         pos = unsqueeze(arange(input_ids.shape[1], dtype="int32"), 0)
+        if position_offset is not None:
+            # cached decode: [B] tokens-already-seen offsets the block's
+            # position ids so step N embeds position N, not 0
+            pos = pos + reshape(position_offset, [-1, 1])
         return self.dropout(self.word_embeddings(input_ids)
                             + self.position_embeddings(pos))
 
@@ -51,6 +55,38 @@ class GPTModel(nn.Layer):
             x = layer(x)
         return self.final_norm(x)
 
+    def init_kv_cache(self, batch_size, max_len, dtype="float32"):
+        """Fresh zero KV pages for forward_cached: one (k, v) pair per
+        layer, each [batch, max_len, num_heads, head_dim]. dtype "int8"
+        builds the quantized-KV pages (scales start as None and are
+        computed by the first forward_cached call)."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        attn = self.layers[0].attention
+        shape = (batch_size, max_len, attn.num_heads, attn.head_dim)
+        return [(Tensor(jnp.zeros(shape, dtype=dtype)),
+                 Tensor(jnp.zeros(shape, dtype=dtype)))
+                for _ in self.layers]
+
+    def forward_cached(self, input_ids, past_kv, positions, kv_scales=None):
+        """Prefill/decode step over explicit KV-cache carries.
+
+        input_ids [B, T]; past_kv: list over layers of (k, v) fixed-shape
+        pages [B, L, nh, hd]; positions [B] int32 tokens-already-cached
+        per row (also the position-embedding offset). kv_scales: list of
+        (k_scale, v_scale) [B] pairs for int8 pages, or None.
+        Returns (hidden, new_past_kv, new_kv_scales)."""
+        x = self.embeddings(input_ids, position_offset=positions)
+        new_kv, new_scales = [], []
+        for i, layer in enumerate(self.layers):
+            ks, vs = (None, None) if kv_scales is None else kv_scales[i]
+            k, v = past_kv[i]
+            x, k, v, ks, vs = layer.forward_cached(x, k, v, positions, ks, vs)
+            new_kv.append((k, v))
+            new_scales.append((ks, vs))
+        return self.final_norm(x), new_kv, new_scales
+
 
 class GPTForCausalLM(nn.Layer):
     def __init__(self, gpt: GPTModel):
@@ -62,6 +98,16 @@ class GPTForCausalLM(nn.Layer):
         h = self.gpt(input_ids)
         w = self.gpt.embeddings.word_embeddings.weight
         return matmul(h, w, transpose_y=True)
+
+    def forward_cached(self, input_ids, past_kv, positions, kv_scales=None):
+        """Cached-attention LM step: (logits, new_past_kv, new_kv_scales).
+        Weight-tied head over GPTModel.forward_cached — a decode step
+        ([B, 1] input) is one-token work against the cache pages."""
+        from ..ops.math import matmul
+        h, new_kv, new_scales = self.gpt.forward_cached(
+            input_ids, past_kv, positions, kv_scales)
+        w = self.gpt.embeddings.word_embeddings.weight
+        return matmul(h, w, transpose_y=True), new_kv, new_scales
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -92,6 +92,12 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     values=row cotangents) instead of a dense [vocab, dim] scatter —
     reference lookup_table grad -> SelectedRows -> sparse optimizer path."""
     x, weight = ensure_tensor(x), ensure_tensor(weight)
+    from ...ops import lazy as _lazy
+    if _lazy._ACTIVE:
+        # ids ride the op as a closure (a host-side value), so a deferred
+        # payload (e.g. position ids computed by a lazy add) must resolve
+        # here — this is a sync point either way
+        _lazy._materialize_inputs([x])
     ids = x._value.astype(jnp.int32)
 
     def f(w):

@@ -215,3 +215,69 @@ def quantize_weights(model: nn.Layer, bits: int = 8
 def dequantize_weights(artifact: Dict[str, Tuple[np.ndarray, np.ndarray]]
                        ) -> Dict[str, np.ndarray]:
     return {k: q.astype(np.float32) * s for k, (q, s) in artifact.items()}
+
+
+def _shadow_weight_only(layer: nn.Layer, dist_attr) -> None:
+    """Replace `layer.forward` with an instance shadow that dequantizes
+    the int8 buffers into a transient fp weight, delegates to the class
+    forward, and removes the transient again. The dequant runs through
+    `run_op`, so under `to_static` it is part of the trace (int8 HBM
+    resident, fp dequant fused into the consuming matmul by XLA) and the
+    int8 q + scale ride the buffer side of `jit.functional.split_state`.
+    The transient `_parameters["weight"]` window makes this single-
+    threaded per layer instance — the serving engines only dispatch from
+    one scheduler thread."""
+    inner = type(layer).forward
+
+    def forward(*args, **kwargs):
+        from ..ops._dispatch import run_op
+        deq = run_op(lambda qa, sa: qa.astype(sa.dtype) * sa,
+                     [layer.wo_weight_q, layer.wo_weight_scale],
+                     "weight_only_dequant")
+        deq.stop_gradient = True
+        if dist_attr is not None:
+            deq.dist_attr = dist_attr
+        layer._parameters["weight"] = deq
+        try:
+            return inner(layer, *args, **kwargs)
+        finally:
+            del layer._parameters["weight"]
+
+    layer.forward = forward
+
+
+def quant_weight_only(model: nn.Layer, bits: int = 8) -> nn.Layer:
+    """TRUE int8 weight-only conversion IN PLACE: every 2-D matmul weight
+    (nn.Linear and the tensor-parallel ColumnParallelLinear /
+    RowParallelLinear) is replaced by int8 `wo_weight_q` + per-channel
+    f32 `wo_weight_scale` buffers; the fp Parameter is dropped from the
+    layer. Embeddings stay fp (lookup tables dequantize per-row anyway
+    and the GPT head is weight-tied to one). Unlike `quantize_weights`
+    (fake storage: fp weights snapped to the grid), the model after this
+    call genuinely holds int8 — state_dict carries q + scale, memory
+    census sees the 4x smaller arrays — and dist_attr survives so mp
+    sharding of the quantized buffers is unchanged. Inference-only:
+    the weight Parameter no longer exists for optimizers. Returns model."""
+    try:
+        from ..parallel.mp_layers import ColumnParallelLinear, RowParallelLinear
+        linear_types: tuple = (nn.Linear, ColumnParallelLinear,
+                               RowParallelLinear)
+    except Exception:  # parallel plane unavailable -> plain linears only
+        linear_types = (nn.Linear,)
+    converted = 0
+    for layer in model.sublayers(include_self=True):
+        if not isinstance(layer, linear_types):
+            continue
+        w = layer._parameters.get("weight")
+        if w is None or len(w.shape) != 2:
+            continue
+        q, scale = channel_quant(np.asarray(w._value), bits)
+        dist_attr = getattr(w, "dist_attr", None)
+        layer.register_buffer("wo_weight_q", Tensor(jnp.asarray(q)))
+        layer.register_buffer("wo_weight_scale", Tensor(jnp.asarray(scale)))
+        del layer._parameters["weight"]
+        _shadow_weight_only(layer, dist_attr)
+        converted += 1
+    if converted == 0:
+        raise ValueError("quant_weight_only found no 2-D linear weights")
+    return model

@@ -20,8 +20,10 @@ from .fleet import (  # noqa: F401  (after engine: fleet builds on it)
     FleetError, FleetRouter, HBMBudgetExceededError, ModelTenant,
     NoHealthyReplicaError, ReplicaAgent, RolloutResult, SequenceLedger,
 )
+from .llm import LLMConfig, LLMEngine, LLMStream  # noqa: F401
 
 __all__ = [
+    "LLMEngine", "LLMConfig", "LLMStream",
     "ServingEngine", "EngineConfig", "ResponseFuture",
     "ShapeBucket", "BucketSet", "default_batch_sizes", "signature_of",
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",

@@ -1,0 +1,701 @@
+"""Continuous-batching autoregressive serving (the LLM decode plane).
+
+The ServingEngine batches fixed-shape `run_batch` calls — right for
+ResNet/OCR, wrong for decoders, where per-request full-sequence recompute
+wastes nearly all decode FLOPs and fixed batches idle between stragglers.
+This module serves GPT/ERNIE decoders the way LLM traffic actually wants:
+
+- **KV cache as explicit carry** — `GPTForCausalLM.forward_cached` takes
+  fixed-shape cache pages in and returns updated pages, so a decode step
+  is one-token work instead of a full-sequence forward.
+- **Slot-paged fixed-shape pool** — per layer, one `[num_slots, page_len,
+  heads, head_dim]` array pair. Sequences borrow a slot for their
+  lifetime; shapes never depend on which slots are live, so steady state
+  runs exactly two kinds of cached executables — one prefill per length
+  bucket, one decode — with ZERO steady-state compiles (the `jit.*`
+  retrace counters stay flat; tests assert it). Pool bytes carry the
+  `mem.kv_pool.bytes` census tag.
+- **Continuous scheduler** — every decode step admits queued sequences
+  into free slots and evicts on EOS/length/deadline, streaming each token
+  to the caller the moment it exists (and over the wire as `'PDST'`
+  frames via `inference/server.py`). Admission sheds on SLO burn
+  (`obs/slo.py`) and queue depth, like the batch engine.
+- **Quantized decode arm** — `LLMConfig(quant="int8")` runs the decoder
+  matmuls through `quantization.quant_weight_only`; `kv_int8=True` stores
+  the pool as int8 with a dequantization scale per slot.
+
+Decode blocks are `decode_block` (=2) tokens wide with only row 0 real:
+XLA lowers a rank-1 matmul through a differently-accumulated path, so a
+1-wide decode drifts ~1e-6 from the full-sequence forward, while any
+block >= 2 is bit-identical to it (tests/test_llm_serving.py proves
+logits-exact decode). The junk row's cache write lands one past the live
+prefix and is overwritten by the next real token before it can be read.
+
+Reference parity: this is the Paddle-Serving deployment role (PAPER.md
+§1 row 8) taken to continuous batching over a paged KV cache — the
+vLLM-style iteration-level scheduler, built TPU-first (fixed shapes, two
+executables, zero steady-state compiles) instead of kernel-first.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import faults as _faults
+from .. import monitor as _monitor
+from .. import nn
+from .. import obs as _obs
+from ..core import executable as _exe
+from ..core import flags as _flags
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..obs import memory as _mem
+from ..obs import slo as _slo
+from .engine import (
+    DeadlineExceededError, EngineStoppedError, ServerOverloadedError,
+    ServingError,
+)
+
+__all__ = ["LLMConfig", "LLMEngine", "LLMStream"]
+
+
+def _prefill_ladder(max_len: int, declared: Sequence[int] = ()) -> List[int]:
+    """Prefill length buckets: declared ones (clamped to max_len), or
+    powers of two from 8 up to max_len. One cached executable each."""
+    if declared:
+        ladder = sorted({int(b) for b in declared if 0 < int(b) <= max_len})
+        if ladder:
+            return ladder
+    ladder, b = [], 8
+    while b < max_len:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_len)
+    return sorted(set(ladder))
+
+
+@dataclass
+class LLMConfig:
+    """Knobs for the continuous-batching engine (FLAGS_llm_* defaults).
+
+    Pool sizing recipe: bytes = 2 (K and V) * num_layers * num_slots *
+    (max_len + decode_block) * heads * head_dim * itemsize — fp32
+    itemsize 4, kv_int8 itemsize 1 (+ two f32 scales per slot per
+    layer). `LLMEngine.kv_pool_bytes()` reports the real figure and the
+    census publishes it as `mem.kv_pool.bytes`."""
+
+    num_slots: int = 8
+    max_len: int = 256
+    prefill_buckets: Tuple[int, ...] = ()
+    max_new_tokens: int = 64
+    eos_token_id: Optional[int] = None
+    queue_depth: int = 256
+    default_deadline_ms: Optional[float] = None
+    warmup_on_start: bool = True
+    quant: str = "off"          # "off" | "int8" weight-only decoder matmuls
+    kv_int8: bool = False
+    # block width of one decode step; >= 2 keeps decode bit-identical to
+    # the full-sequence forward (see module docstring)
+    decode_block: int = 2
+    idle_park_s: float = 0.02   # scheduler nap when no work is queued
+
+    @classmethod
+    def from_flags(cls) -> "LLMConfig":
+        buckets: Tuple[int, ...] = ()
+        raw = str(_flags.flag("llm_prefill_buckets") or "").strip()
+        if raw:
+            buckets = tuple(int(p) for p in raw.split(",") if p.strip())
+        ddl = float(_flags.flag("llm_default_deadline_ms"))
+        return cls(
+            num_slots=int(_flags.flag("llm_num_slots")),
+            max_len=int(_flags.flag("llm_max_len")),
+            prefill_buckets=buckets,
+            max_new_tokens=int(_flags.flag("llm_max_new_tokens")),
+            queue_depth=int(_flags.flag("llm_queue_depth")),
+            default_deadline_ms=ddl if ddl > 0 else None,
+            warmup_on_start=bool(_flags.flag("llm_warmup")),
+            quant=str(_flags.flag("llm_quant")),
+            kv_int8=bool(_flags.flag("llm_kv_int8")),
+        )
+
+
+class LLMStream:
+    """Per-request handle: tokens stream into it as the scheduler emits
+    them; iterate to consume incrementally, or `result()` to wait for the
+    terminal status. Terminal statuses: "done" (EOS or token budget),
+    "deadline", "error" (injected/model fault), "stopped" (engine shut
+    down before completion)."""
+
+    def __init__(self, request_id: int, on_token: Optional[Callable] = None):
+        self.request_id = request_id
+        self.tokens: List[int] = []
+        self.status = "queued"
+        self.error: Optional[str] = None
+        self._on_token = on_token
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+
+    # scheduler-side
+    def _emit(self, tok: int) -> None:
+        self.tokens.append(tok)
+        self._q.put(tok)
+        if self._on_token is not None:
+            try:
+                self._on_token(len(self.tokens) - 1, tok)
+            except Exception:
+                pass  # a broken callback must not kill the scheduler
+
+    def _finish(self, status: str, error: Optional[str] = None) -> None:
+        if self._done.is_set():
+            return
+        self.status = status
+        self.error = error
+        self._done.set()
+        self._q.put(None)
+
+    # consumer-side
+    def __iter__(self):
+        return self.iter()
+
+    def iter(self, timeout: Optional[float] = 600.0):
+        """Yield tokens as they arrive until the stream terminates."""
+        while True:
+            tok = self._q.get(timeout=timeout)
+            if tok is None:
+                return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> Tuple[str, List[int]]:
+        """(terminal status, all tokens); raises TimeoutError on wait."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still in flight")
+        return self.status, list(self.tokens)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+@dataclass
+class _Seq:
+    stream: LLMStream
+    prompt: np.ndarray
+    max_new: int
+    deadline: Optional[float]          # absolute monotonic, or None
+    submit_t: float
+    slot: int = -1
+    pos: int = 0                       # tokens cached so far
+    last_token: int = 0
+    last_emit_t: float = 0.0
+    admit_t: float = 0.0
+
+
+class _PrefillNet(nn.Layer):
+    """One prefill executable per length bucket: (tokens [B, Lb],
+    lengths [B]) -> (first greedy token [B], last-position logits [B, V],
+    fresh KV pages, [int8 scales]). Pages are created inside the trace so
+    the wire signature is just the token block."""
+
+    def __init__(self, lm, page_len: int, kv_int8: bool):
+        super().__init__()
+        self.lm = lm
+        self._page_len = page_len
+        self._kv_int8 = kv_int8
+
+    def forward(self, tokens, lengths):
+        import jax.numpy as jnp
+
+        from ..ops._dispatch import run_op
+        from ..ops.creation import zeros
+        from ..ops.manipulation import cast
+        from ..ops.search import argmax
+
+        b = tokens.shape[0]
+        dtype = "int8" if self._kv_int8 else "float32"
+        pages = self.lm.gpt.init_kv_cache(b, self._page_len, dtype=dtype)
+        positions = zeros([b], dtype="int32")
+        logits, kv, scales = self.lm.forward_cached(tokens, pages, positions)
+
+        def _last(la, ln):
+            idx = (ln - 1).astype(jnp.int32)[:, None, None]
+            return jnp.take_along_axis(la, idx, axis=1)[:, 0]
+
+        last = run_op(_last, [logits, lengths], "llm_last_logits")
+        first = cast(argmax(last, axis=-1), "int32")
+        outs = [first, last]
+        for k, v in kv:
+            outs += [k, v]
+        if self._kv_int8:
+            for ks, vs in scales:
+                outs += [ks, vs]
+        return tuple(outs)
+
+
+class _DecodeNet(nn.Layer):
+    """THE decode executable: one fixed-shape step for the whole pool.
+    (tokens [S], positions [S], *pool state) -> (next greedy token [S],
+    logits [S, V], updated pool pages). Free slots ride along as masked
+    junk rows — occupancy never changes the signature."""
+
+    def __init__(self, lm, num_layers: int, block: int, kv_int8: bool):
+        super().__init__()
+        self.lm = lm
+        self._n = num_layers
+        self._block = block
+        self._kv_int8 = kv_int8
+
+    def forward(self, tokens, positions, *state):
+        import jax.numpy as jnp
+
+        from ..ops._dispatch import run_op
+        from ..ops.manipulation import cast
+        from ..ops.search import argmax
+
+        n, block = self._n, self._block
+        kv = [(state[2 * i], state[2 * i + 1]) for i in range(n)]
+        scales = None
+        if self._kv_int8:
+            off = 2 * n
+            scales = [(state[off + 2 * i], state[off + 2 * i + 1])
+                      for i in range(n)]
+        # [S] -> [S, block]: row 0 real, the rest padding (bit-exactness
+        # trick — see module docstring)
+        blk = run_op(
+            lambda t: jnp.broadcast_to(t[:, None], (t.shape[0], block)),
+            [tokens], "llm_decode_block")
+        logits, kv, _ = self.lm.forward_cached(blk, kv, positions, scales)
+        last = logits[:, 0]
+        nxt = cast(argmax(last, axis=-1), "int32")
+        outs = [nxt, last]
+        for k, v in kv:
+            outs += [k, v]
+        return tuple(outs)
+
+
+class LLMEngine:
+    """Continuous-batching scheduler over a slot-paged KV-cache pool.
+
+    `submit()` is thread-safe and returns an `LLMStream` immediately; a
+    single scheduler thread owns the pool and runs the admit -> decode ->
+    evict loop. See LLMConfig for sizing and the module docstring for the
+    executable-count invariant."""
+
+    _FAULT_SITE = "llm.decode"
+
+    def __init__(self, model, config: Optional[LLMConfig] = None):
+        from ..models.gpt import GPTForCausalLM, GPTModel
+        cfg = config or LLMConfig.from_flags()
+        if isinstance(model, GPTModel):
+            model = GPTForCausalLM(model)
+        if not hasattr(model, "forward_cached"):
+            raise ServingError(
+                "LLMEngine needs a model with a cached-attention path "
+                "(GPTForCausalLM / GPTModel)")
+        self.config = cfg
+        self.lm = model
+        self.lm.eval()  # serving path: dropout off, rng-stable
+        if cfg.quant == "int8":
+            from ..quantization import quant_weight_only
+            quant_weight_only(self.lm)
+        elif cfg.quant not in ("", "off"):
+            raise ServingError(f"unknown llm quant arm {cfg.quant!r}")
+
+        gpt = self.lm.gpt
+        attn = gpt.layers[0].attention
+        self._n_layers = len(gpt.layers)
+        self._heads, self._head_dim = attn.num_heads, attn.head_dim
+        self._page_len = cfg.max_len + cfg.decode_block
+        self.buckets = _prefill_ladder(cfg.max_len, cfg.prefill_buckets)
+
+        self._prefill = _PrefillNet(self.lm, self._page_len, cfg.kv_int8)
+        self._decode = _DecodeNet(self.lm, self._n_layers,
+                                  cfg.decode_block, cfg.kv_int8)
+        from ..jit import to_static
+        to_static(self._prefill)
+        to_static(self._decode)
+
+        import jax.numpy as jnp
+        s = cfg.num_slots
+        shape = (s, self._page_len, self._heads, self._head_dim)
+        kdt = jnp.int8 if cfg.kv_int8 else jnp.float32
+        self._pool: List[Tensor] = []   # k0, v0, k1, v1, ...
+        for _ in range(self._n_layers):
+            self._pool += [Tensor(jnp.zeros(shape, kdt)),
+                           Tensor(jnp.zeros(shape, kdt))]
+        self._scales: List[Tensor] = []  # ks0, vs0, ... ([S] f32 per slot)
+        if cfg.kv_int8:
+            for _ in range(self._n_layers):
+                self._scales += [Tensor(jnp.ones((s,), jnp.float32)),
+                                 Tensor(jnp.ones((s,), jnp.float32))]
+
+        self._free: List[int] = list(range(s))
+        self._active: Dict[int, _Seq] = {}
+        self._pending: "collections.deque[_Seq]" = collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._next_id = 0
+        self._counters = {"requests": 0, "completed": 0, "shed": 0,
+                          "evictions.eos": 0, "evictions.length": 0,
+                          "evictions.deadline": 0, "evictions.error": 0}
+        self._warm_ms = 0.0
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "LLMEngine":
+        if self._thread is not None:
+            return self
+        if self.config.warmup_on_start:
+            self._warmup()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="llm-scheduler")
+        self._thread.start()
+        return self
+
+    def _warmup(self) -> None:
+        """Trace+compile every prefill bucket and the decode step up
+        front so steady-state serving performs zero compiles."""
+        import jax.numpy as jnp
+        t0 = time.monotonic()
+        with no_grad():
+            for lb in self.buckets:
+                self._prefill(Tensor(jnp.zeros((1, lb), jnp.int32)),
+                              Tensor(jnp.ones((1,), jnp.int32)))
+            s = self.config.num_slots
+            self._decode(Tensor(jnp.zeros((s,), jnp.int32)),
+                         Tensor(jnp.zeros((s,), jnp.int32)),
+                         *self._pool, *self._scales)
+        self._warm_ms = (time.monotonic() - t0) * 1000.0
+        if _monitor._ENABLED:
+            _monitor.gauge_set("llm.warm_start_ms", self._warm_ms)
+            _monitor.count("llm.warmup_runs", len(self.buckets) + 1)
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        if drain and self._thread is not None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._pending and not self._active:
+                        break
+                time.sleep(0.01)
+        with self._work:
+            self._stopped = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        with self._lock:
+            leftovers = list(self._pending) + list(self._active.values())
+            self._pending.clear()
+            self._active.clear()
+            self._free = list(range(self.config.num_slots))
+        for seq in leftovers:
+            seq.stream._finish("stopped", "engine stopped")
+        # Break the StaticFunction <-> jax.jit reference cycle so the
+        # model weights and KV pool become collectable once the engine
+        # is dropped (the cycle runs through C-level jit wrappers the
+        # garbage collector cannot traverse).
+        for net in (self._prefill, self._decode):
+            fwd = getattr(net, "forward", None)
+            if hasattr(fwd, "release"):
+                fwd.release()
+
+    # ---- submission --------------------------------------------------------
+
+    def submit(self, prompt_ids: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               on_token: Optional[Callable] = None) -> LLMStream:
+        """Queue one generation; returns its LLMStream immediately.
+        Sheds with ServerOverloadedError on queue depth or SLO burn
+        (`FLAGS_slo_shed_burn`), like ServingEngine.submit."""
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ServingError("empty prompt")
+        if prompt.size > self.buckets[-1]:
+            raise ServingError(
+                f"prompt length {prompt.size} exceeds the largest prefill "
+                f"bucket {self.buckets[-1]} (raise FLAGS_llm_max_len)")
+        if self._stopped or self._thread is None:
+            raise EngineStoppedError("LLM engine not running")
+        if _slo._ENABLED and _slo.should_shed():
+            self._counters["shed"] += 1
+            if _monitor._ENABLED:
+                _monitor.count("llm.shed")
+            _slo.record_request(None, _slo.OUTCOME_REJECTED)
+            raise ServerOverloadedError("shedding on SLO burn rate")
+        budget = self.config.max_len - int(prompt.size)
+        max_new = min(int(max_new_tokens or self.config.max_new_tokens),
+                      max(budget, 1))
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        now = time.monotonic()
+        deadline = now + deadline_ms / 1000.0 if deadline_ms else None
+        with self._work:
+            if len(self._pending) >= self.config.queue_depth:
+                self._counters["shed"] += 1
+                if _monitor._ENABLED:
+                    _monitor.count("llm.shed")
+                raise ServerOverloadedError(
+                    f"llm queue full ({self.config.queue_depth})")
+            self._next_id += 1
+            stream = LLMStream(self._next_id, on_token)
+            seq = _Seq(stream=stream, prompt=prompt, max_new=max_new,
+                       deadline=deadline, submit_t=now)
+            self._pending.append(seq)
+            self._counters["requests"] += 1
+            self._work.notify()
+        if _monitor._ENABLED:
+            _monitor.count("llm.requests")
+        return stream
+
+    def generate(self, prompt_ids: Sequence[int],
+                 max_new_tokens: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 timeout: float = 600.0) -> List[int]:
+        """Blocking convenience wrapper: submit + wait; raises the
+        deadline/error terminal statuses as serving exceptions."""
+        status, toks = self.submit(
+            prompt_ids, max_new_tokens, deadline_ms).result(timeout)
+        if status == "deadline":
+            raise DeadlineExceededError("generation deadline exceeded")
+        if status != "done":
+            raise ServingError(f"generation {status}")
+        return toks
+
+    # ---- scheduler ---------------------------------------------------------
+
+    def _run(self) -> None:
+        with no_grad():
+            while True:
+                with self._work:
+                    if self._stopped:
+                        return
+                    if not self._pending and not self._active:
+                        self._work.wait(timeout=self.config.idle_park_s)
+                        if self._stopped:
+                            return
+                    pending_now = bool(self._pending)
+                if pending_now:
+                    self._admit()
+                if self._active:
+                    try:
+                        self._step()
+                    except Exception as e:  # scheduler must survive
+                        self._evict_all("error",
+                                        f"{type(e).__name__}: {e}")
+
+    def _admit(self) -> None:
+        while True:
+            with self._lock:
+                if not self._free or not self._pending:
+                    return
+                seq = self._pending.popleft()
+                slot = self._free.pop()
+            now = time.monotonic()
+            if seq.deadline is not None and now > seq.deadline:
+                with self._lock:
+                    self._free.append(slot)
+                self._finish(seq, "deadline", "expired before admission")
+                continue
+            seq.slot, seq.admit_t = slot, now
+            self._prefill_into(seq)
+
+    def _prefill_into(self, seq: _Seq) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops._dispatch import run_op
+
+        cfg = self.config
+        plen = int(seq.prompt.size)
+        lb = next(b for b in self.buckets if b >= plen)
+        padded = np.zeros((1, lb), np.int32)
+        padded[0, :plen] = seq.prompt
+        outs = self._prefill(Tensor(jnp.asarray(padded)),
+                             Tensor(jnp.full((1,), plen, jnp.int32)))
+        first = int(np.asarray(outs[0].numpy())[0])
+        slot_t = Tensor(jnp.asarray(seq.slot, jnp.int32))
+
+        def _row(pool, row, s):
+            return jax.lax.dynamic_update_slice(pool, row, (s, 0, 0, 0))
+
+        def _cell(vec, val, s):
+            return jax.lax.dynamic_update_slice(vec, val, (s,))
+
+        pages = outs[2:2 + 2 * self._n_layers]
+        for i, page in enumerate(pages):
+            self._pool[i] = run_op(_row, [self._pool[i], page, slot_t],
+                                   "llm_slot_write")
+        if cfg.kv_int8:
+            svals = outs[2 + 2 * self._n_layers:]
+            for i, sv in enumerate(svals):
+                self._scales[i] = run_op(_cell, [self._scales[i], sv, slot_t],
+                                         "llm_scale_write")
+        now = time.monotonic()
+        seq.pos = plen
+        seq.last_token = first
+        seq.last_emit_t = now
+        seq.stream.status = "running"
+        seq.stream._emit(first)
+        with self._lock:
+            self._active[seq.slot] = seq
+        if _monitor._ENABLED:
+            _monitor.count("llm.prefill.requests")
+            _monitor.count("llm.tokens_generated")
+            _monitor.observe("llm.queue_wait", seq.admit_t - seq.submit_t)
+            _monitor.observe("llm.ttft_ms", (now - seq.submit_t) * 1000.0)
+            _monitor.gauge_set("llm.slots_active", len(self._active))
+        self._retag_pool()
+        # a one-token budget (or instant EOS) finishes without decoding
+        if first == cfg.eos_token_id:
+            self._evict(seq, "eos")
+        elif len(seq.stream.tokens) >= seq.max_new:
+            self._evict(seq, "length")
+
+    def _step(self) -> None:
+        """One decode step for every active slot: fault drill, dispatch,
+        emit, evict. Fixed shapes — occupancy is data, not signature."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        now = time.monotonic()
+        with self._lock:
+            live = sorted(self._active.items())
+        # the llm.decode fault site is checked once per in-flight
+        # sequence so an injected error takes down exactly one of them
+        for slot, seq in live:
+            if seq.deadline is not None and now > seq.deadline:
+                self._evict(seq, "deadline")
+                continue
+            if _faults._ENABLED:
+                try:
+                    _faults.check(self._FAULT_SITE)
+                except Exception as e:
+                    self._evict(seq, "error",
+                                f"{type(e).__name__}: {e}")
+        with self._lock:
+            live = sorted(self._active.items())
+        if not live:
+            return
+        s = cfg.num_slots
+        toks = np.zeros((s,), np.int32)
+        pos = np.zeros((s,), np.int32)
+        for slot, seq in live:
+            toks[slot] = seq.last_token
+            pos[slot] = seq.pos
+
+        def _dispatch():
+            report = lambda: {"kv_pool_bytes": self.kv_pool_bytes()}
+            with _exe.dispatch_guard("llm_decode", report=report), \
+                    _monitor.span("llm.decode_step"):
+                return self._decode(Tensor(jnp.asarray(toks)),
+                                    Tensor(jnp.asarray(pos)),
+                                    *self._pool, *self._scales)
+
+        if _obs._TL_ENABLED and not _obs.in_phase():
+            with _obs.timeline().phase("decode_step"):
+                outs = _dispatch()
+        else:
+            outs = _dispatch()
+        nxt = np.asarray(outs[0].numpy())
+        self._pool = list(outs[2:2 + 2 * self._n_layers])
+        now = time.monotonic()
+        for slot, seq in live:
+            tok = int(nxt[slot])
+            seq.pos += 1
+            seq.last_token = tok
+            seq.stream._emit(tok)
+            if _monitor._ENABLED:
+                _monitor.count("llm.tokens_generated")
+                _monitor.observe("llm.inter_token_ms",
+                                 (now - seq.last_emit_t) * 1000.0)
+            seq.last_emit_t = now
+            if tok == cfg.eos_token_id:
+                self._evict(seq, "eos")
+            elif len(seq.stream.tokens) >= seq.max_new \
+                    or seq.pos >= cfg.max_len:
+                self._evict(seq, "length")
+            elif seq.deadline is not None and now > seq.deadline:
+                self._evict(seq, "deadline")
+        if _monitor._ENABLED:
+            _monitor.count("llm.decode.steps")
+            _monitor.gauge_set("llm.slots_active", len(self._active))
+        self._retag_pool()
+
+    # ---- eviction / bookkeeping --------------------------------------------
+
+    def _evict(self, seq: _Seq, reason: str, error: Optional[str] = None) -> None:
+        """Free the sequence's slot and terminate its stream. The pool
+        row needs no scrub: free slots are never read (the validity mask
+        keys off per-row positions) and the next prefill replaces the
+        whole page."""
+        with self._lock:
+            if self._active.pop(seq.slot, None) is not None:
+                self._free.append(seq.slot)
+        status = {"eos": "done", "length": "done"}.get(reason, reason)
+        self._counters[f"evictions.{reason}"] = \
+            self._counters.get(f"evictions.{reason}", 0) + 1
+        if _monitor._ENABLED:
+            _monitor.count(f"llm.evictions.{reason}")
+        self._finish(seq, status, error)
+
+    def _evict_all(self, status: str, error: str) -> None:
+        with self._lock:
+            live = list(self._active.values())
+            self._active.clear()
+            self._free = list(range(self.config.num_slots))
+        for seq in live:
+            self._counters["evictions.error"] += 1
+            if _monitor._ENABLED:
+                _monitor.count("llm.evictions.error")
+            self._finish(seq, status, error)
+
+    def _finish(self, seq: _Seq, status: str, error: Optional[str]) -> None:
+        latency = time.monotonic() - seq.submit_t
+        self._counters["completed"] += 1
+        if _monitor._ENABLED:
+            _monitor.count("llm.completed")
+            _monitor.observe("llm.e2e_latency", latency)
+        if _slo._ENABLED:
+            outcome = {"done": _slo.OUTCOME_OK,
+                       "deadline": _slo.OUTCOME_DEADLINE}.get(
+                           status, _slo.OUTCOME_ERROR)
+            _slo.record_request(
+                latency if outcome == _slo.OUTCOME_OK else None, outcome)
+        seq.stream._finish(status, error)
+
+    def _retag_pool(self) -> None:
+        if _mem._ENABLED:
+            _mem.tag("kv_pool",
+                     [t._value for t in (*self._pool, *self._scales)],
+                     origin="LLMEngine")
+
+    # ---- introspection -----------------------------------------------------
+
+    def kv_pool_bytes(self) -> int:
+        total = 0
+        for t in (*self._pool, *self._scales):
+            v = t._value
+            total += int(getattr(v, "nbytes", 0) or
+                         int(np.prod(v.shape)) * v.dtype.itemsize)
+        return total
+
+    def stats(self) -> dict:
+        with self._lock:
+            active, free, queued = (len(self._active), len(self._free),
+                                    len(self._pending))
+        return {
+            "slots": self.config.num_slots, "active": active, "free": free,
+            "queued": queued, "buckets": list(self.buckets),
+            "page_len": self._page_len, "kv_pool_bytes": self.kv_pool_bytes(),
+            "kv_int8": self.config.kv_int8, "quant": self.config.quant,
+            "warm_start_ms": self._warm_ms,
+            "counters": dict(self._counters),
+        }

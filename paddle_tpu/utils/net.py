@@ -49,6 +49,22 @@ MODEL_MAGIC = 0x50444D51  # 'PDMQ'
 #   versioned weight store; `rollback` promotes the guard checkpoint
 #   .bak generation first (instant rollback of a bad push).
 MODEL_CTL_MAGIC = 0x50444D56  # 'PDMV'
+# LLM streaming-generation frames (serving/llm.py). Opt-in like every
+# extension above: a client that never sends 'PDSQ' sees the exact
+# pre-streaming protocol, and the stream itself ends in a standard
+# 'PDRS' status frame so error/overload/deadline handling is shared
+# with the batch path.
+#
+# 'PDSQ' — streaming generation request: u32 magic + u32 max_new_tokens
+#   + u32 deadline_ms (0 = none) + u32 n_tensors (=1) + one 1-D i32
+#   prompt tensor in the standard tensor framing.
+STREAM_REQ_MAGIC = 0x50445351  # 'PDSQ'
+# 'PDST' — one streamed token, sent the moment the scheduler emits it:
+#   u32 magic + u32 token index + i32 token id. The terminal 'PDRS'
+#   carries STATUS_OK + u32 n=1 + the full i32 token tensor (so a
+#   non-incremental caller can ignore 'PDST' frames it already read),
+#   or STATUS_ERROR/OVERLOADED/DEADLINE + message.
+STREAM_MAGIC = 0x50445354  # 'PDST'
 
 
 def send_trace_frame(sock, ctx) -> None:

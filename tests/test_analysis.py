@@ -812,7 +812,10 @@ class TestSelfLint:
              os.path.join(PKG, "obs", "slo.py"),
              # fleet serving tier (ISSUE 13): every routed request
              # crosses the dispatch/scoring path
-             os.path.join(PKG, "serving", "fleet.py")],
+             os.path.join(PKG, "serving", "fleet.py"),
+             # continuous-batching LLM plane (ISSUE 14): the decode loop
+             # dispatches every step — no host syncs beyond the tokens
+             os.path.join(PKG, "serving", "llm.py")],
             all_functions=True)
         assert n_files > 25
         assert findings == [], "\n".join(f.format() for f in findings)
