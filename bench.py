@@ -911,6 +911,78 @@ def bench_serving_slo(backend):
     return out
 
 
+def bench_ps_durability(backend):
+    """PS durability tax A/B: sequenced sparse-push throughput with the
+    WAL off vs on (FLAGS_ps_wal_dir), plus the recovery path timed —
+    snapshot, then a cold restart that loads the snapshot and replays
+    the post-snapshot WAL suffix. The delta between arms is exactly what
+    the durability plane adds per push: one CRC-framed append + fsync
+    policy; the recovery numbers bound how long a standby-less restart
+    keeps trainers waiting.
+
+    Knob: BENCH_PS=ab|on|off (default ab runs both arms)."""
+    import shutil
+    import tempfile
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    arm_cfg = os.environ.get("BENCH_PS", "ab").lower()
+    if arm_cfg == "off":
+        return {"skipped": "BENCH_PS=off"}
+    n_push, batch, dim = 300, 64, 16
+    ids = np.arange(batch, dtype=np.int64)
+    grads = np.ones((batch, dim), np.float32)
+
+    def one_arm(wal_dir):
+        server = PsServer("127.0.0.1", 0, wal_dir=wal_dir)
+        server.run()
+        client = PsClient([f"127.0.0.1:{server.port}"])
+        try:
+            client.create_sparse_table("emb", dim, optimizer="sgd",
+                                       lr=0.1, seed=7)
+            client.push_sparse("emb", ids, grads)   # warm the table rows
+            t0 = time.perf_counter()
+            for _ in range(n_push):
+                client.push_sparse("emb", ids, grads)
+            per_push_us = (time.perf_counter() - t0) / n_push * 1e6
+        finally:
+            client.close()
+            server.stop()
+        return per_push_us
+
+    out = {"pushes_per_arm": n_push, "batch": batch, "dim": dim}
+    wal_dir = tempfile.mkdtemp(prefix="bench-ps-wal-")
+    try:
+        if arm_cfg == "ab":
+            out["per_push_us_off"] = round(one_arm(None), 1)
+        out["per_push_us_on"] = round(one_arm(wal_dir), 1)
+        if "per_push_us_off" in out and out["per_push_us_off"]:
+            out["overhead_pct"] = round(
+                (out["per_push_us_on"] - out["per_push_us_off"])
+                / out["per_push_us_off"] * 100, 1)
+
+        # recovery path: snapshot, append a WAL suffix, cold restart
+        server = PsServer("127.0.0.1", 0, wal_dir=wal_dir)
+        server.run()
+        client = PsClient([f"127.0.0.1:{server.port}"])
+        try:
+            t0 = time.perf_counter()
+            server.snapshot()
+            out["snapshot_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            for _ in range(50):
+                client.push_sparse("emb", ids, grads)
+        finally:
+            client.close()
+            server.stop()
+        t0 = time.perf_counter()
+        server = PsServer("127.0.0.1", 0, wal_dir=wal_dir)
+        out["recover_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        out["recovered_lsn"] = server.applied_lsn
+        server.stop()
+    finally:
+        shutil.rmtree(wal_dir, ignore_errors=True)
+    return out
+
+
 def bench_llm(backend):
     """Continuous-batching LLM serving (serving/llm.py): concurrent
     variable-length requests through the slot-paged KV-cache engine.
@@ -1028,6 +1100,7 @@ def main():
                     ("ernie10b_layer", bench_ernie10b_layer),
                     ("allreduce_smoke", bench_allreduce),
                     ("serving_slo", bench_serving_slo),
+                    ("ps_durability", bench_ps_durability),
                     ("llm", bench_llm),
                     ("warm_start", bench_warm_start)):
         extra[key] = _run_workload(key, fn, backend, extra)

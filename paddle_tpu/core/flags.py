@@ -113,6 +113,28 @@ define_flag("ps_rpc_backoff_ms", 50.0,
 define_flag("ps_rpc_call_timeout_s", 120.0,
             "PS client: per-call deadline for connect + each response "
             "read (0 = wait forever)")
+define_flag("ps_wal_dir", "",
+            "PS durability: directory for the server's write-ahead delta "
+            "log + crash-atomic snapshots; empty = in-memory only "
+            "(PsServer(wal_dir=...) overrides per instance)")
+define_flag("ps_wal_segment_mb", 16.0,
+            "PS durability: WAL segment rollover size in MiB")
+define_flag("ps_snapshot_every_records", 0,
+            "PS durability: auto-compact the WAL into a snapshot every N "
+            "committed delta records; 0 = manual snapshot() only")
+define_flag("ps_replication_interval_ms", 20.0,
+            "PS HA: standby poll interval for tailing the primary's delta "
+            "stream (CMD_REPLICATE)")
+define_flag("ps_communicator_max_requeues", 3,
+            "Communicator: times one async push batch may be re-enqueued "
+            "after a transport failure (client failover) before the "
+            "worker records a permanent error")
+define_flag("ps_ha_lease_ttl_s", 2.0,
+            "PS HA: primary lease time-to-live in the rendezvous store; "
+            "a standby promotes itself after this long without heartbeats")
+define_flag("ps_ha_heartbeat_s", 0.5,
+            "PS HA: lease heartbeat interval (must be well under "
+            "FLAGS_ps_ha_lease_ttl_s)")
 define_flag("bus_send_retries", 3,
             "fleet message bus: reconnect-and-resend attempts per frame "
             "before raising PeerGoneError")

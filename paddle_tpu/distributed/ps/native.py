@@ -23,6 +23,13 @@ class NativePsServer:
     """C++ parameter server bound to 127.0.0.1:<port> (0 = ephemeral)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        # teardown-safe defaults FIRST: __del__ runs even when __init__
+        # raises (no toolchain, bind failure), so every attribute stop()
+        # touches must already exist
+        import threading
+        self._h = None
+        self._lib = None
+        self._stopped = threading.Event()
         if host not in ("127.0.0.1", "localhost"):
             raise ValueError(
                 "NativePsServer binds loopback only for now; front a "
@@ -33,8 +40,6 @@ class NativePsServer:
                 "native PS server requires the C++ toolchain (g++); "
                 "use distributed.ps.PsServer (python) instead")
         self._lib = lib
-        import threading
-        self._stopped = threading.Event()
         out_port = ctypes.c_int(0)
         self._h = lib.ps_native_server_start(int(port),
                                              ctypes.byref(out_port))
@@ -102,13 +107,17 @@ class NativePsServer:
         return self
 
     def stop(self):
-        if self._h:
-            self._lib.ps_native_server_stop(self._h)
-            self._h = None
+        # shutdown-before-close (PsServer.stop() ordering): wake blocked
+        # run() callers BEFORE the native handle is freed, so none of
+        # them can observe a half-torn-down server
         self._stopped.set()
+        h, self._h = self._h, None
+        if h and self._lib is not None:
+            self._lib.ps_native_server_stop(h)
 
     def __del__(self):
         try:
-            self.stop()
+            if getattr(self, "_h", None) is not None:
+                self.stop()
         except Exception:
             pass

@@ -17,16 +17,19 @@ brpc client's concurrent-request role).
 """
 from __future__ import annotations
 
+import json
 import os
 import random
 import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import wal as _wal
 from .table import DenseTable, SparseTable
 from ... import faults as _faults
 from ... import monitor as _monitor
@@ -63,6 +66,18 @@ CMD_NODE_FEAT = 13          # graph table: ids[n] -> [n, feat_dim] f32
 CMD_HELLO = 14              # client id in the name field, no payload
 CMD_PUSH_SPARSE_SEQ = 15    # i64 seq + CMD_PUSH_SPARSE payload
 CMD_PUSH_DENSE_SEQ = 16     # i64 seq + CMD_PUSH_DENSE payload
+# Durability/HA extension (PR 15). REPLICATE streams the WAL delta
+# records after a watermark to a tailing standby (and doubles as the
+# standby's ack: the watermark it sends IS its applied lsn). HA_STATUS
+# returns a JSON role/watermark document; HANDBACK lets a recovered
+# ex-primary hand the new primary any WAL records the replication tail
+# missed (dedup'd by the seq ledger); FETCH_STATE is the full-state
+# bootstrap a rejoining standby anchors its own WAL on.
+CMD_PUSH_SHOW_CLICK_SEQ = 17  # i64 seq + CMD_PUSH_SHOW_CLICK payload
+CMD_REPLICATE = 18            # i64 after_lsn + i64 max_records
+CMD_HA_STATUS = 19            # no payload -> JSON frame
+CMD_HANDBACK = 20             # i64 blob_len + concatenated records
+CMD_FETCH_STATE = 21          # no payload -> meta JSON + npz blob
 
 from .table import OPT_WIRE_IDS as _OPT_IDS  # single source, both planes
 _SPARSE_CFG = struct.Struct("<ffqBBfffffff")   # lr,std,seed,opt,ctr,b1,b2,eps,sdec,ccoef,dth,ttl
@@ -103,11 +118,26 @@ def _check_status(sock, deadline: Optional[float] = None):
     raise PsError(_recv_exact(sock, ln, deadline).decode())
 
 
-class PsServer:
-    """One parameter-server process/thread (brpc_ps_server role)."""
+# live PsServer instances, for the conftest leak guard (`_no_ps_leak`)
+_LIVE = weakref.WeakSet()
 
-    def __init__(self, host="127.0.0.1", port=0):
+
+class PsServer:
+    """One parameter-server process/thread (brpc_ps_server role).
+
+    With `wal_dir` (or `FLAGS_ps_wal_dir`) set, every mutating request is
+    committed to a CRC-framed write-ahead log BEFORE it touches a table,
+    and `snapshot()` compacts the log into a crash-atomic generation (see
+    `wal.py`). Construction over an existing wal_dir RECOVERS: newest
+    intact snapshot + WAL replay, dedup'd by the persisted seq ledger, so
+    a trainer retry replayed across the crash is still exactly-once.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, wal_dir: Optional[str] = None):
         self._tables: Dict[str, object] = {}
+        # table name -> (kind, constructor cfg): rides the snapshot
+        # manifest so recovery can rebuild tables before loading arrays
+        self._cfgs: Dict[str, tuple] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -115,29 +145,238 @@ class PsServer:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # open handler connections, so stop() can close them out from
+        # under blocked recv_exact calls instead of leaking the threads
+        self._conns: "weakref.WeakSet[socket.socket]" = weakref.WeakSet()
         # generation-counted barrier: CMD_BARRIER carries n participants;
         # the ACK is held until all n arrive (gloo-barrier role)
         self._barrier_cond = threading.Condition()
         self._barrier_arrived = 0
         self._barrier_gen = 0
-        # at-most-once push ledger: client id -> last applied request seq
-        # (survives the client's reconnects — that is the point)
-        self._applied_seq: Dict[str, int] = {}
+        # at-most-once push ledger: (client id, request seq) applied set.
+        # Floor+extras (wal.SeqLedger), NOT a monotonic high-water mark:
+        # after a failover, a handed-back seq can arrive BELOW seqs the
+        # new primary already applied and must still apply exactly once.
+        self._ledger = _wal.SeqLedger()
         self._seq_lock = threading.Lock()
+        # ---- durability plane ----
+        if wal_dir is None:
+            wal_dir = str(_flags.flag("ps_wal_dir")) or None
+        self.wal_dir = wal_dir
+        self._wal: Optional[_wal.WalWriter] = None
+        self._wal_lock = threading.Lock()
+        self._snap_lock = threading.Lock()
+        self._commits_since_snap = 0
+        self._snap_every = int(_flags.flag("ps_snapshot_every_records"))
+        self._snap_skip_warned = False
+        # ---- HA plane (driven by ha.HaPsNode; inert otherwise) ----
+        self.ha_role: Optional[str] = None
+        self._repl_acks: Dict[str, int] = {}   # standby id -> acked lsn
+        self._handback_floor = 0
+        self.applied_lsn = 0
+        if wal_dir is not None:
+            self._recover()
+        self._closed = False
+        _LIVE.add(self)
+
+    # ---- durable state: recovery, commit, snapshot ----
+
+    def _recover(self):
+        """snapshot + WAL replay -> tables/ledger; then open the writer
+        right after the last intact record (`wal.repair` truncates a torn
+        tail so the next recovery can read past this session's appends)."""
+        snap = _wal.load_snapshot(self.wal_dir)
+        after = 0
+        if snap is not None:
+            for name, (kind, cfg) in snap.tables.items():
+                self._install_table(name, kind, cfg)
+            per_table: Dict[str, dict] = {}
+            for key, arr in snap.arrays.items():
+                tname, field = key.split("::", 1)
+                per_table.setdefault(tname, {})[field] = arr
+            for tname, arrs in per_table.items():
+                self._tables[tname].load_arrays(arrs)
+            self._ledger.load_state(snap.ledger)
+            after = snap.lsn
+        last = max(after, _wal.repair(self.wal_dir))
+        for rec in _wal.replay(self.wal_dir, after_lsn=after):
+            self._apply_record(rec)
+            if _monitor._ENABLED:
+                _monitor.count("ps.wal.records_replayed")
+        self._wal = _wal.WalWriter(self.wal_dir, start_lsn=last + 1)
+        self.applied_lsn = last
+
+    def _apply_record(self, rec: "_wal.Record"):
+        """Apply one WAL record to the in-memory tables (recovery replay
+        AND the standby's replication tail). Seq-stamped records go
+        through the ledger: a delta that both reached the snapshot and
+        survived in the log applies exactly once. A record whose apply
+        raised live (e.g. decay on a dense table) raised BEFORE it was
+        acked, so apply errors here are skipped, deterministically on
+        every replica."""
+        if rec.seq >= 0 and rec.client:
+            with self._seq_lock:
+                if not self._ledger.record(rec.client, rec.seq):
+                    return False
+        return self._apply_payload(rec)
+
+    def _apply_payload(self, rec: "_wal.Record") -> bool:
+        """Decode + apply one record's payload, WITHOUT the ledger check
+        (callers own dedup). Exception-tolerant by contract — see
+        `_apply_record`. True = applied."""
+        try:
+            if rec.rtype in (_wal.R_ADD_SPARSE, _wal.R_ADD_DENSE):
+                # idempotent on replay/handback: re-registering must NOT
+                # clobber a live table with a fresh one
+                if rec.table not in self._tables:
+                    kind = ("sparse" if rec.rtype == _wal.R_ADD_SPARSE
+                            else "dense")
+                    self._install_table(rec.table, kind,
+                                        json.loads(rec.payload.decode()))
+            elif rec.rtype == _wal.R_PUSH_SPARSE:
+                ids, grads = _wal.unpack_push_sparse(rec.payload)
+                self._tables[rec.table].push(ids, grads)
+            elif rec.rtype == _wal.R_PUSH_DENSE:
+                tbl = self._tables[rec.table]
+                self._tables[rec.table].push(
+                    _wal.unpack_push_dense(rec.payload).reshape(tbl.w.shape))
+            elif rec.rtype == _wal.R_SHOW_CLICK:
+                ids, shows, clicks = _wal.unpack_show_click(rec.payload)
+                self._tables[rec.table].push_show_click(ids, shows, clicks)
+            elif rec.rtype == _wal.R_DECAY:
+                self._tables[rec.table].decay()
+            elif rec.rtype == _wal.R_SHRINK:
+                self._tables[rec.table].shrink()
+        except (KeyError, ValueError, AttributeError, TypeError) as e:
+            import warnings
+            warnings.warn(f"ps wal replay: skipping lsn {rec.lsn} "
+                          f"({type(e).__name__}: {e})")
+            return False
+        return True
+
+    def _commit(self, rtype: int, name: str, client: Optional[str],
+                seq: Optional[int], payload_fn: Callable[[], bytes],
+                apply_fn: Callable[[], object]):
+        """The one mutating-request path: dedup -> WAL append -> apply,
+        atomically w.r.t. snapshot collection (`_wal_lock`). Returns the
+        apply result, or None for a deduplicated retry. Without a WAL the
+        dedup + apply semantics are unchanged from PR 3."""
+        if self._wal is None:
+            if seq is not None and client:
+                with self._seq_lock:
+                    if not self._ledger.record(client, seq):
+                        return None
+            return apply_fn()
+        with self._wal_lock:
+            if seq is not None and client:
+                with self._seq_lock:
+                    if not self._ledger.record(client, seq):
+                        return None
+            lsn = self._wal.append(rtype, name, client or "",
+                                   -1 if seq is None else seq, payload_fn())
+            out = apply_fn()
+            self.applied_lsn = lsn
+            self._commits_since_snap += 1
+        self._maybe_autosnapshot()
+        return out
+
+    def _maybe_autosnapshot(self):
+        if not self._snap_every or self._commits_since_snap < self._snap_every:
+            return
+        try:
+            self.snapshot()
+        except _wal.PsSnapshotUnsupportedError:
+            # a graph table is registered: auto-compaction cannot cover
+            # it, and a serving-path push must never error for that
+            if not self._snap_skip_warned:
+                self._snap_skip_warned = True
+                import warnings
+                warnings.warn("ps: auto-snapshot skipped — a graph table "
+                              "has no snapshot representation")
+            self._commits_since_snap = 0
+
+    def collect_state(self):
+        """Frozen (lsn, ledger, cfgs, arrays) under the commit lock —
+        the payload for snapshot() and CMD_FETCH_STATE."""
+        with self._wal_lock:
+            for name, tbl in self._tables.items():
+                if name not in self._cfgs:
+                    raise _wal.PsSnapshotUnsupportedError(
+                        f"ps: table {name!r} ({type(tbl).__name__}) has no "
+                        "snapshot representation")
+            with self._seq_lock:
+                ledger = self._ledger.state()
+            arrays = {}
+            for name, tbl in self._tables.items():
+                for field, arr in tbl.snapshot_arrays().items():
+                    arrays[f"{name}::{field}"] = arr
+            lsn = self.applied_lsn if self._wal is not None else 0
+            self._commits_since_snap = 0
+            return lsn, ledger, dict(self._cfgs), arrays
+
+    def snapshot(self) -> int:
+        """Compact the WAL into one crash-atomic generation; returns the
+        new version. Raises PsSnapshotUnsupportedError when a registered
+        table (graph) has no snapshot representation — never silent loss."""
+        if self.wal_dir is None:
+            raise ValueError("ps: snapshot() needs a wal_dir")
+        with self._snap_lock:
+            lsn, ledger, cfgs, arrays = self.collect_state()
+            version = _wal.save_snapshot(self.wal_dir, lsn, ledger,
+                                         cfgs, arrays)
+            self._wal.sync()
+            # drop segments every durable consumer is past: the FALLBACK
+            # generation (previous manifest lsn) and every standby ack
+            floor = min([lsn] + list(self._repl_acks.values()))
+            prev = _wal._read_json(
+                os.path.join(self.wal_dir, _wal._MANIFEST) + ".bak")
+            if prev:
+                floor = min(floor, int(prev.get("lsn", 0)))
+            _wal.gc_segments(self.wal_dir, floor + 1)
+            return version
+
+    # ---- table registration ----
+
+    def _install_table(self, name, kind, cfg):
+        _tname(name)  # validate against the wire limit at registration
+        if kind == "sparse":
+            self._tables[name] = SparseTable(**cfg)
+        elif kind == "dense":
+            cfg = dict(cfg)
+            shape = tuple(cfg.pop("shape"))
+            self._tables[name] = DenseTable(shape, **cfg)
+        else:
+            raise ValueError(f"ps: unknown table kind {kind!r}")
+        self._cfgs[name] = (kind, cfg if kind == "sparse"
+                            else dict(cfg, shape=list(shape)))
+        return self._tables[name]
+
+    def _log_add(self, rtype, name, cfg):
+        if self._wal is not None:
+            payload = json.dumps(cfg).encode()
+            with self._wal_lock:
+                self.applied_lsn = self._wal.append(rtype, name, "", -1,
+                                                    payload)
 
     def add_sparse_table(self, name, dim, **kw):
-        _tname(name)  # validate against the wire limit at registration
-        self._tables[name] = SparseTable(dim, **kw)
-        return self._tables[name]
+        cfg = dict(kw, dim=dim)
+        tbl = self._install_table(name, "sparse", cfg)
+        self._log_add(_wal.R_ADD_SPARSE, name, cfg)
+        return tbl
 
     def add_dense_table(self, name, shape, **kw):
-        _tname(name)
-        self._tables[name] = DenseTable(shape, **kw)
-        return self._tables[name]
+        cfg = dict(kw, shape=list(np.atleast_1d(np.asarray(shape)).tolist())
+                   if not np.isscalar(shape) else [int(shape)])
+        tbl = self._install_table(name, "dense", cfg)
+        self._log_add(_wal.R_ADD_DENSE, name, self._cfgs[name][1])
+        return tbl
 
     def add_graph_table(self, name, **kw):
         from .graph_table import GraphTable
         _tname(name)
+        # graph tables are read-only server-side state built from their
+        # edge files: deliberately OUTSIDE the WAL/snapshot plane, and
+        # snapshot() raises typed for them rather than dropping state
         self._tables[name] = GraphTable(**kw)
         return self._tables[name]
 
@@ -145,7 +384,8 @@ class PsServer:
         return self._tables[name]
 
     def run(self, block=False):
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="ps-serve")
         self._thread.start()
         if block:
             self._thread.join()
@@ -161,7 +401,7 @@ class PsServer:
             except OSError:
                 return
             threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="ps-handler").start()
 
     def _barrier(self, n_participants: int):
         with self._barrier_cond:
@@ -184,6 +424,7 @@ class PsServer:
 
     def _handle(self, conn):
         client_id: Optional[str] = None   # set by CMD_HELLO, per connection
+        self._conns.add(conn)
         try:
             while True:
                 hdr = _recv_exact(conn, _HDR.size)
@@ -208,12 +449,25 @@ class PsServer:
                 # error reply leaves the stream in sync for the next request
                 ids = grads = None
                 req_seq = None
+                repl_args = blob = None
                 if cmd == CMD_PUSH_SPARSE_SEQ:
                     (req_seq,) = _LEN.unpack(_recv_exact(conn, 8))
                     cmd = CMD_PUSH_SPARSE
                 elif cmd == CMD_PUSH_DENSE_SEQ:
                     (req_seq,) = _LEN.unpack(_recv_exact(conn, 8))
                     cmd = CMD_PUSH_DENSE
+                elif cmd == CMD_PUSH_SHOW_CLICK_SEQ:
+                    (req_seq,) = _LEN.unpack(_recv_exact(conn, 8))
+                    cmd = CMD_PUSH_SHOW_CLICK
+                if cmd == CMD_REPLICATE:
+                    repl_args = _LEN.unpack(_recv_exact(conn, 8)) \
+                        + _LEN.unpack(_recv_exact(conn, 8))
+                elif cmd == CMD_HANDBACK:
+                    (blen,) = _LEN.unpack(_recv_exact(conn, 8))
+                    if not 0 <= blen <= 4 * _MAX_PAYLOAD_ELEMS:
+                        _send_err(conn, f"ps: implausible handback {blen}")
+                        return
+                    blob = _recv_exact(conn, blen)
                 if cmd == CMD_PULL_SPARSE:
                     ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
                 elif cmd == CMD_PUSH_SPARSE:
@@ -246,20 +500,22 @@ class PsServer:
                         client_id = name
                         conn.sendall(_ST_OK)
                         continue
-                    if req_seq is not None:
-                        if client_id is None:
-                            raise PsError(
-                                "ps: sequenced push before CMD_HELLO")
-                        with self._seq_lock:
-                            duplicate = req_seq <= self._applied_seq.get(
-                                client_id, 0)
-                            if not duplicate:
-                                self._applied_seq[client_id] = req_seq
-                        if duplicate:
-                            # a retry of an already-applied push: ACK
-                            # without touching the table (at-most-once)
-                            conn.sendall(_ST_OK)
-                            continue
+                    if req_seq is not None and client_id is None:
+                        raise PsError("ps: sequenced push before CMD_HELLO")
+                    if cmd == CMD_REPLICATE:
+                        self._serve_replicate(conn, name, *repl_args)
+                        continue
+                    if cmd == CMD_HA_STATUS:
+                        doc = json.dumps(self.ha_status()).encode()
+                        conn.sendall(_ST_OK + _LEN.pack(len(doc)) + doc)
+                        continue
+                    if cmd == CMD_HANDBACK:
+                        applied = self._serve_handback(blob)
+                        conn.sendall(_ST_OK + _LEN.pack(applied))
+                        continue
+                    if cmd == CMD_FETCH_STATE:
+                        self._serve_fetch_state(conn)
+                        continue
                     if cmd == CMD_ADD_SPARSE:
                         (lr, istd, seed, opt, ctr, b1, b2, eps, sdec, ccoef,
                          dth, ttl) = _SPARSE_CFG.unpack(cfg_raw)
@@ -300,7 +556,10 @@ class PsServer:
                         rows = tbl.pull(ids)
                         conn.sendall(_ST_OK + rows.astype(np.float32).tobytes())
                     elif cmd == CMD_PUSH_SPARSE:
-                        tbl.push(ids, grads)
+                        self._commit(
+                            _wal.R_PUSH_SPARSE, name, client_id, req_seq,
+                            lambda: _wal.pack_push_sparse(ids, grads),
+                            lambda: tbl.push(ids, grads))
                         conn.sendall(_ST_OK)
                     elif cmd == CMD_PULL_DENSE:
                         w = tbl.pull().astype(np.float32)
@@ -312,16 +571,28 @@ class PsServer:
                                      + _LEN.pack(lo) + _LEN.pack(total)
                                      + w.tobytes())
                     elif cmd == CMD_PUSH_DENSE:
-                        tbl.push(grads.reshape(tbl.w.shape))
+                        self._commit(
+                            _wal.R_PUSH_DENSE, name, client_id, req_seq,
+                            lambda: _wal.pack_push_dense(grads),
+                            lambda: tbl.push(grads.reshape(tbl.w.shape)))
                         conn.sendall(_ST_OK)
                     elif cmd == CMD_PUSH_SHOW_CLICK:
-                        tbl.push_show_click(ids, grads[:n], grads[n:])
+                        self._commit(
+                            _wal.R_SHOW_CLICK, name, client_id, req_seq,
+                            lambda: _wal.pack_show_click(
+                                ids, grads[:n], grads[n:]),
+                            lambda: tbl.push_show_click(
+                                ids, grads[:n], grads[n:]))
                         conn.sendall(_ST_OK)
                     elif cmd == CMD_DECAY:
-                        tbl.decay()
+                        # decay/shrink carry no client seq: durable but
+                        # at-least-once across a handback (documented)
+                        self._commit(_wal.R_DECAY, name, None, None,
+                                     lambda: b"", tbl.decay)
                         conn.sendall(_ST_OK)
                     elif cmd == CMD_SHRINK:
-                        evicted = tbl.shrink()
+                        evicted = self._commit(_wal.R_SHRINK, name, None,
+                                               None, lambda: b"", tbl.shrink)
                         conn.sendall(_ST_OK + _LEN.pack(int(evicted)))
                     elif cmd == CMD_SAMPLE_NEIGHBORS:
                         nb, w = tbl.sample_neighbors(ids, int(dim))
@@ -345,14 +616,143 @@ class PsServer:
         finally:
             conn.close()
 
+    # ---- HA verbs (server side; driven by ha.HaPsNode + PsClient) ----
+
+    def _serve_replicate(self, conn, standby_id: str, after_lsn: int,
+                         max_records: int):
+        """Stream WAL records with lsn > after_lsn to a tailing standby.
+        The request watermark doubles as the standby's ack — segment GC
+        and the bounded-staleness guarantee key off it. Reads happen
+        under the commit lock so a record mid-append is never torn."""
+        if self._wal is None:
+            raise PsError("ps: replication needs a wal_dir")
+        if standby_id:
+            self._repl_acks[standby_id] = int(after_lsn)
+        with self._wal_lock:
+            recs = _wal.replay(self.wal_dir, after_lsn=int(after_lsn),
+                               max_records=int(max_records) or None,
+                               count_fallback=False)
+            blob = b"".join(_wal.encode_record(r) for r in recs)
+        conn.sendall(_ST_OK + _LEN.pack(len(recs)) + _LEN.pack(len(blob))
+                     + blob)
+
+    def ha_status(self) -> dict:
+        return {"role": self.ha_role, "applied_lsn": self.applied_lsn,
+                "handback_floor": self._handback_floor,
+                "acks": dict(self._repl_acks),
+                "wal": self.wal_dir is not None}
+
+    def _serve_handback(self, blob: bytes) -> int:
+        """A recovered ex-primary hands over WAL records the replication
+        tail never saw (lsn > our handback floor). Each is committed as a
+        FRESH record in our own stream; the seq ledger drops anything the
+        client base already re-pushed after failover — exactly-once
+        either way the race lands."""
+        applied = 0
+        for rec in _wal.decode_stream(blob):
+            if rec.lsn <= self._handback_floor:
+                continue
+            if (rec.rtype in (_wal.R_ADD_SPARSE, _wal.R_ADD_DENSE)
+                    and rec.table in self._tables):
+                continue   # already registered: no duplicate WAL record
+            out = self._commit(rec.rtype, rec.table, rec.client or None,
+                               rec.seq if rec.seq >= 0 else None,
+                               lambda: rec.payload,
+                               lambda: self._apply_payload(rec))
+            if out:
+                applied += 1
+        if applied and _monitor._ENABLED:
+            _monitor.count("ps.handback.records", applied)
+        return applied
+
+    def _serve_fetch_state(self, conn):
+        """Full-state bootstrap for a rejoining standby: frozen meta
+        (lsn + ledger + table configs) and an npz blob of every array."""
+        import io
+        lsn, ledger, cfgs, arrays = self.collect_state()
+        meta = json.dumps({"lsn": lsn, "ledger": ledger,
+                           "tables": cfgs}).encode()
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        blob = buf.getvalue()
+        conn.sendall(_ST_OK + _LEN.pack(len(meta)) + meta
+                     + _LEN.pack(len(blob)) + blob)
+
+    def apply_replicated(self, rec: "_wal.Record"):
+        """Standby-side: persist one replicated record under its ORIGINAL
+        lsn (both WALs carry the identical stream), then apply through
+        the same ledger/dedup discipline as the primary."""
+        with self._wal_lock:
+            if self._wal is not None:
+                self._wal.append_record(rec)
+            self._apply_record(rec)
+            self.applied_lsn = rec.lsn
+            self._commits_since_snap += 1
+        if _monitor._ENABLED:
+            _monitor.count("ps.replication.records")
+        self._maybe_autosnapshot()
+
+    def reset_state(self):
+        """Drop every table, the ledger, and the local WAL directory —
+        the rejoin flow calls this after handback, right before anchoring
+        on the new primary's `install_state` payload."""
+        with self._wal_lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
+            self._tables.clear()
+            self._cfgs.clear()
+            with self._seq_lock:
+                self._ledger = _wal.SeqLedger()
+            self.applied_lsn = 0
+            if self.wal_dir is not None:
+                _wal.wipe(self.wal_dir)
+
+    def install_state(self, meta: dict, blob: bytes):
+        """Install a `_serve_fetch_state` payload and anchor the local
+        durability chain on it: the state becomes snapshot generation 1
+        at the primary's lsn, and the WAL writer opens at lsn + 1."""
+        import io
+        npz = np.load(io.BytesIO(blob))
+        arrays = {k: npz[k] for k in npz.files}
+        with self._wal_lock:
+            for name, kc in meta["tables"].items():
+                self._install_table(name, kc[0], kc[1])
+            per_table: Dict[str, dict] = {}
+            for key, arr in arrays.items():
+                tname, field = key.split("::", 1)
+                per_table.setdefault(tname, {})[field] = arr
+            for tname, arrs in per_table.items():
+                self._tables[tname].load_arrays(arrs)
+            with self._seq_lock:
+                self._ledger.load_state(meta["ledger"])
+            lsn = int(meta["lsn"])
+            if self.wal_dir is not None:
+                _wal.save_snapshot(self.wal_dir, lsn, meta["ledger"],
+                                   {n: (kc[0], kc[1]) for n, kc in
+                                    meta["tables"].items()}, arrays)
+                self._wal = _wal.WalWriter(self.wal_dir, start_lsn=lsn + 1)
+            self.applied_lsn = lsn
+
     def stop(self):
         self._stop.set()
         try:
             self._sock.close()
         except OSError:
             pass
+        # unblock handler threads parked in recv_exact — their sockets
+        # are owned here so tests can assert nothing leaks
+        for c in list(self._conns):
+            try:
+                c.close()
+            except OSError:
+                pass
         if self._thread is not None:
             self._thread.join(timeout=2)
+        with self._wal_lock:
+            if self._wal is not None:
+                self._wal.close()
+        self._closed = True
 
 
 _CLIENT_SEQ = [0]
@@ -387,10 +787,21 @@ class PsClient:
     TimeoutError (feeding the retry loop) instead of hanging the caller.
     """
 
-    def __init__(self, endpoints: Sequence[str],
+    def __init__(self, endpoints: Optional[Sequence[str]] = None,
                  max_retries: Optional[int] = None,
                  backoff_ms: Optional[float] = None,
-                 call_timeout: Optional[float] = None):
+                 call_timeout: Optional[float] = None,
+                 resolver: Optional[Callable[[], Sequence[str]]] = None):
+        # `resolver` re-reads the current endpoint set (HA: the
+        # rendezvous store's primary records) — consulted once up front
+        # when `endpoints` is omitted, and again inside the retry loop
+        # after every transport failure, so a failed-over primary is
+        # picked up WITHIN the original per-call deadline
+        self._resolver = resolver
+        if endpoints is None:
+            if resolver is None:
+                raise ValueError("PsClient needs endpoints or a resolver")
+            endpoints = resolver()
         self.endpoints = list(endpoints)
         self.max_retries = int(_flags.flag("ps_rpc_max_retries")
                                if max_retries is None else max_retries)
@@ -439,6 +850,27 @@ class PsClient:
         return (time.monotonic() + self.call_timeout
                 if self.call_timeout else None)
 
+    def _refresh_endpoints(self) -> bool:
+        """Re-resolve the endpoint set after a transport failure. The
+        shard count must be stable (ids route by `id % n_servers`);
+        per-server push seqs are KEPT — the standby replicated the
+        primary's ledger, so in-flight retries stay exactly-once."""
+        if self._resolver is None:
+            return False
+        try:
+            new = list(self._resolver())
+        except Exception:
+            return False
+        if not new or new == self.endpoints or len(new) != len(self.endpoints):
+            return False
+        for i in range(len(self._socks)):
+            self._drop(i)
+        self.endpoints = new
+        self._legacy = [False] * len(new)
+        if _monitor._ENABLED:
+            _monitor.count("ps.failovers")
+        return True
+
     def _retry_rpc(self, attempt_fn, op: str = "call"):
         """Run one RPC attempt; on a transport failure (OSError family —
         includes injected resets and recv deadlines) back off and retry.
@@ -455,7 +887,13 @@ class PsClient:
         delay = self.backoff_s
         last: Optional[BaseException] = None
         try:
-            for attempt in range(self.max_retries + 1):
+            # with a resolver the retry budget is the CALL DEADLINE, not a
+            # fixed count: failover (lease expiry + standby promotion) can
+            # take several backoff rounds, and the contract is reaching
+            # the new primary within the original per-call deadline
+            overall = self._deadline() if self._resolver is not None else None
+            attempt = 0
+            while True:
                 if attempt:
                     if _monitor._ENABLED:
                         _monitor.count("ps.retries")
@@ -469,6 +907,13 @@ class PsClient:
                     raise
                 except OSError as e:
                     last = e
+                    self._refresh_endpoints()
+                attempt += 1
+                if overall is not None:
+                    if time.monotonic() >= overall:
+                        break
+                elif attempt > self.max_retries:
+                    break
             raise last
         except BaseException as e:
             # idempotent: only fires when the success path did not end it
@@ -582,16 +1027,26 @@ class PsClient:
                 self._locks[s].release()
         return out
 
-    def push_sparse(self, table: str, ids, grads):
+    def _call_seqs(self, shards, _seqs):
+        """One seq per involved server for the WHOLE call: every retry
+        resends the same seq, so the server applies it at most once. The
+        optional `_seqs` box lets a caller that re-issues the call later
+        (Communicator requeue after failover) REUSE the original seqs —
+        the ledger then drops whatever the dead primary already shipped."""
+        seqs = _seqs if _seqs is not None else {}
+        for s, _ in shards:
+            if s not in seqs:
+                seqs[s] = self._next_push_seq(s)
+        return seqs
+
+    def push_sparse(self, table: str, ids, grads, _seqs=None):
         ids = np.asarray(ids, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
         shards = self._shard_sel(ids)
         for s, sel in shards:
             self._locks[s].acquire()
         try:
-            # one seq per involved server for the WHOLE call: every retry
-            # resends the same seq, so the server applies it at most once
-            seqs = {s: self._next_push_seq(s) for s, _ in shards}
+            seqs = self._call_seqs(shards, _seqs)
 
             def attempt():
                 deadline = self._deadline()
@@ -673,7 +1128,7 @@ class PsClient:
                                     for s in range(n_srv)]
         return np.concatenate([parts[s] for s in ordered])
 
-    def push_dense(self, table: str, grad):
+    def push_dense(self, table: str, grad, _seqs=None):
         g = np.asarray(grad, np.float32).reshape(-1)
         ranges = self._dense_sizes.get(table)
         if ranges is None:
@@ -689,7 +1144,7 @@ class PsClient:
         for s, _ in shards:
             self._locks[s].acquire()
         try:
-            seqs = {s: self._next_push_seq(s) for s, _ in shards}
+            seqs = self._call_seqs(shards, _seqs)
 
             def attempt():
                 deadline = self._deadline()
@@ -712,8 +1167,10 @@ class PsClient:
                 self._locks[s].release()
 
     # -- CTR accessor ops (ctr_accessor.cc role over the wire) --
-    def push_show_click(self, table: str, ids, shows, clicks):
-        """Bump per-row show/click statistics on the owning servers."""
+    def push_show_click(self, table: str, ids, shows, clicks, _seqs=None):
+        """Bump per-row show/click statistics on the owning servers.
+        Sequenced like the gradient pushes (CMD_PUSH_SHOW_CLICK_SEQ): a
+        counter bump retried across a failover lands exactly once."""
         ids = np.asarray(ids, np.int64).reshape(-1)
         shows = np.asarray(shows, np.float32).reshape(-1)
         clicks = np.asarray(clicks, np.float32).reshape(-1)
@@ -721,12 +1178,22 @@ class PsClient:
         for s, sel in shards:
             self._locks[s].acquire()
         try:
+            seqs = self._call_seqs(shards, _seqs)
+
             def attempt():
                 deadline = self._deadline()
-                self._send_all(shards, lambda s, sel: (
-                    _HDR.pack(CMD_PUSH_SHOW_CLICK, _tname(table), len(sel), 0)
-                    + ids[sel].tobytes() + shows[sel].tobytes()
-                    + clicks[sel].tobytes()))
+
+                def payload(s, sel):
+                    body = (ids[sel].tobytes() + shows[sel].tobytes()
+                            + clicks[sel].tobytes())
+                    if self._ensure_seq(s):
+                        return (_HDR.pack(CMD_PUSH_SHOW_CLICK_SEQ,
+                                          _tname(table), len(sel), 0)
+                                + _LEN.pack(seqs[s]) + body)
+                    return (_HDR.pack(CMD_PUSH_SHOW_CLICK, _tname(table),
+                                      len(sel), 0) + body)
+
+                self._send_all(shards, payload)
                 self._recv_all(shards, None, deadline)
 
             self._retry_rpc(attempt, op="push_show_click")
@@ -891,14 +1358,70 @@ class PsClient:
             self._drop(i)
 
 
+# ---- single-endpoint HA RPCs (driven by ha.HaPsNode over its own
+#      socket; service.py owns the wire structs) ----
+
+def ha_connect(endpoint: str, timeout: Optional[float] = None):
+    host, port = endpoint.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=timeout or 120)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def rpc_replicate(sock, after_lsn: int, max_records: int = 0,
+                  standby_id: str = "", deadline=None):
+    """Fetch WAL records with lsn > after_lsn; `after_lsn` is also the
+    caller's ack watermark. Returns a list of wal.Record."""
+    sock.sendall(_HDR.pack(CMD_REPLICATE, _tname(standby_id), 0, 0)
+                 + _LEN.pack(int(after_lsn)) + _LEN.pack(int(max_records)))
+    _check_status(sock, deadline)
+    (_n,) = _LEN.unpack(_recv_exact(sock, 8, deadline))
+    (blen,) = _LEN.unpack(_recv_exact(sock, 8, deadline))
+    return _wal.decode_stream(_recv_exact(sock, blen, deadline))
+
+
+def rpc_ha_status(sock, deadline=None) -> dict:
+    sock.sendall(_HDR.pack(CMD_HA_STATUS, _tname(""), 0, 0))
+    _check_status(sock, deadline)
+    (ln,) = _LEN.unpack(_recv_exact(sock, 8, deadline))
+    return json.loads(_recv_exact(sock, ln, deadline).decode())
+
+
+def rpc_handback(sock, records, deadline=None) -> int:
+    blob = b"".join(_wal.encode_record(r) for r in records)
+    sock.sendall(_HDR.pack(CMD_HANDBACK, _tname(""), 0, 0)
+                 + _LEN.pack(len(blob)) + blob)
+    _check_status(sock, deadline)
+    (applied,) = _LEN.unpack(_recv_exact(sock, 8, deadline))
+    return applied
+
+
+def rpc_fetch_state(sock, deadline=None):
+    sock.sendall(_HDR.pack(CMD_FETCH_STATE, _tname(""), 0, 0))
+    _check_status(sock, deadline)
+    (mlen,) = _LEN.unpack(_recv_exact(sock, 8, deadline))
+    meta = json.loads(_recv_exact(sock, mlen, deadline).decode())
+    (blen,) = _LEN.unpack(_recv_exact(sock, 8, deadline))
+    return meta, _recv_exact(sock, blen, deadline)
+
+
 class Communicator:
     """Async grad sender (communicator.cc role): push_sparse calls are
     queued and flushed by a background thread, overlapping server updates
     with the trainer's next step; `flush()`/`barrier()` give the sync
-    points the reference exposes."""
+    points the reference exposes.
+
+    Failover behavior: a TRANSPORT failure no longer poisons the worker —
+    the in-flight batch is re-enqueued (bounded by
+    `FLAGS_ps_communicator_max_requeues`) and retried with its ORIGINAL
+    per-server seqs, so whatever the dying primary already applied and
+    replicated is dropped by the survivor's ledger, not double-applied.
+    Server-reported PsErrors (application failures) still fail the
+    worker permanently."""
 
     def __init__(self, client: PsClient, max_queue=64):
         self.client = client
+        import collections
         import queue as q
         self._q = q.Queue(maxsize=max_queue)
         # pending counts enqueued-but-not-yet-applied items; a Condition
@@ -907,23 +1430,42 @@ class Communicator:
         self._pending = 0
         self._cond = threading.Condition()
         self._error: Optional[BaseException] = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._max_requeues = int(_flags.flag("ps_communicator_max_requeues"))
+        # requeued batches live in a worker-local deque, NOT back in the
+        # bounded queue: the worker blocking on its own full queue would
+        # deadlock against the producers it is supposed to drain
+        self._retry = collections.deque()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ps-communicator")
         self._thread.start()
 
     def _run(self):
         while True:
-            item = self._q.get()
+            item = self._retry.popleft() if self._retry else self._q.get()
             if item is None:
                 return
-            kind, table, a, b = item
+            kind, table, a, b, seqs, tries = item
             try:
                 if self._error is None:
                     if kind == "sparse":
-                        self.client.push_sparse(table, a, b)
+                        self.client.push_sparse(table, a, b, _seqs=seqs)
                     else:
-                        self.client.push_dense(table, a)
-            except BaseException as e:  # surface on next flush/push
+                        self.client.push_dense(table, a, _seqs=seqs)
+            except PsError as e:  # application failure: permanent
                 self._error = e
+            except BaseException as e:
+                if tries < self._max_requeues:
+                    # count the requeue into pending BEFORE the finally
+                    # block decrements this attempt, so a concurrent
+                    # flush() can never observe a false zero
+                    with self._cond:
+                        self._pending += 1
+                    self._retry.append((kind, table, a, b, seqs, tries + 1))
+                    if _monitor._ENABLED:
+                        _monitor.count("ps.communicator.requeues")
+                    time.sleep(self.client.backoff_s)
+                else:
+                    self._error = e
             finally:
                 with self._cond:
                     self._pending -= 1
@@ -943,10 +1485,11 @@ class Communicator:
         self._q.put(item)
 
     def push_sparse_async(self, table, ids, grads):
-        self._put(("sparse", table, np.asarray(ids), np.asarray(grads)))
+        self._put(("sparse", table, np.asarray(ids), np.asarray(grads),
+                   {}, 0))
 
     def push_dense_async(self, table, grad):
-        self._put(("dense", table, np.asarray(grad), None))
+        self._put(("dense", table, np.asarray(grad), None, {}, 0))
 
     def flush(self, timeout=30.0):
         with self._cond:

@@ -183,6 +183,25 @@ class DenseTable:
     def state(self):
         return {"w": self.w, "slots": self._slots}
 
+    def snapshot_arrays(self):
+        """Durable state as flat arrays (see SparseTable.snapshot_arrays)."""
+        with self._lock:
+            out = {"w": self.w.copy()}
+            for sname, arr in self._slots.items():
+                out["slot_" + sname] = np.array(arr)
+        return out
+
+    def load_arrays(self, data):
+        names = getattr(data, "files", None)
+        if names is None:
+            names = list(data.keys())
+        with self._lock:
+            self.w[...] = data["w"]
+            for f in names:
+                if f.startswith("slot_"):
+                    # keep 0-d slots 0-d (adam's step counter "t")
+                    self._slots[f[5:]] = np.array(data[f], np.float32)
+
 
 class SparseTable:
     """id -> embedding-row hash table with lazy row init and per-row
@@ -198,7 +217,7 @@ class SparseTable:
         self._rule = _RULES[optimizer](lr=lr, beta1=beta1, beta2=beta2,
                                        eps=eps)
         self._init_std = init_std
-        self._rng = np.random.default_rng(seed)
+        self._seed = seed
         # accessor="ctr": per-row show/click stats + decay/shrink eviction
         if accessor not in (None, "ctr"):
             raise TypeError(f"unknown accessor {accessor!r}")
@@ -212,7 +231,12 @@ class SparseTable:
     def _row(self, key: int) -> np.ndarray:
         r = self._rows.get(key)
         if r is None:
-            r = self._rng.normal(0, self._init_std, self.dim).astype(np.float32)
+            # keyed (seed, id) stream, NOT a shared table RNG: lazy init
+            # must not depend on first-touch ORDER, or a replica replaying
+            # the same deltas in a different interleaving (WAL replay,
+            # standby tail) would diverge from the primary
+            rng = np.random.default_rng((self._seed, key & 0x7FFFFFFFFFFFFFFF))
+            r = rng.normal(0, self._init_std, self.dim).astype(np.float32)
             self._rows[key] = r
             self._slots[key] = self._rule.slots(self.dim)
             if self._accessor is not None:
@@ -296,9 +320,11 @@ class SparseTable:
         for k in self._rows:
             yield k, self._rows[k], self._slots[k], self._stats.get(k)
 
-    def save(self, path):
-        # rows, per-row optimizer slots AND accessor stats round-trip
-        # (reference sparse tables persist accessor state with embeddings)
+    def snapshot_arrays(self):
+        """Complete durable state as flat arrays — rows, per-row optimizer
+        slots AND accessor stats round-trip (reference sparse tables
+        persist accessor state with embeddings). Shared by `save` and the
+        PS snapshot plane (`wal.save_snapshot`)."""
         with self._lock:
             items = list(self._iter_all_rows())
             keys = np.asarray([k for k, *_ in items], np.int64)
@@ -311,15 +337,24 @@ class SparseTable:
                     else np.zeros((0, self.dim), np.float32)
             if self._accessor is not None:
                 for f in self._STAT_FIELDS:
+                    # float64: stats are Python floats in memory, and the
+                    # durability contract is a BIT-EXACT round-trip
                     slot_arrays["stat_" + f] = np.asarray(
                         [(st or self._accessor.fresh())[f]
-                         for _, _, _, st in items], np.float32)
-        np.savez(path, keys=keys, vals=vals, **slot_arrays)
+                         for _, _, _, st in items], np.float64)
+        return dict(keys=keys, vals=vals, **slot_arrays)
 
-    def load(self, path):
-        data = np.load(path if path.endswith(".npz") else path + ".npz")
-        snames = [f[5:] for f in data.files if f.startswith("slot_")]
-        has_stats = "stat_show" in data.files
+    def save(self, path):
+        np.savez(path, **self.snapshot_arrays())
+
+    def load_arrays(self, data):
+        """Install state from a `snapshot_arrays`-shaped mapping (a dict
+        of arrays or an open npz)."""
+        names = getattr(data, "files", None)
+        if names is None:
+            names = list(data.keys())
+        snames = [f[5:] for f in names if f.startswith("slot_")]
+        has_stats = "stat_show" in names
         # decompress each npz member ONCE; store per-row copies so a row
         # update can't pin the whole backing array
         keys, vals = data["keys"], data["vals"]
@@ -337,6 +372,10 @@ class SparseTable:
                         {f: float(stat_data[f][i]) for f in self._STAT_FIELDS}
                         if stat_data is not None else self._accessor.fresh())
                 self._on_load_row(k)
+
+    def load(self, path):
+        self.load_arrays(
+            np.load(path if path.endswith(".npz") else path + ".npz"))
 
     def _on_load_row(self, key):
         """Hook: SSD tier registers loaded rows in its LRU and spills."""

@@ -17,7 +17,13 @@ between a guard checkpoint's payload and its commit record) and
 fleet serving tier (`serving/fleet.py`) adds the replica-pool seams:
 `router.dispatch` (before each routed send — `conn_reset` drives the
 failover drills), `replica.register` (rendezvous with the fleet store)
-and `replica.drain` (the graceful-drain path).
+and `replica.drain` (the graceful-drain path). The PS durability plane
+(`distributed/ps/wal.py`) adds the storage seams: `ps.wal.write` (torn
+WAL append, via `mangle()` — recovery truncates to the intact prefix
+and counts `ps.wal.fallbacks`) and `ps.snapshot.commit` (crash point
+between a snapshot's payload write and its manifest commit — recovery
+detects the orphaned newer payload and falls back to the previous
+generation plus WAL replay).
 
 Spec grammar (`FLAGS_fault_inject`, also `register()`/`inject()`):
 

@@ -26,6 +26,29 @@ from .. import faults as _faults
 from .. import monitor as _monitor
 
 
+class PrefixStore:
+    """Namespace adapter so one TCPStore hosts many planes: every key a
+    consumer writes (ElasticManager leases, join tickets, the PS HA
+    primary record) lands under its own prefix. Grew up in the serving
+    fleet; promoted here because the PS HA plane shares it."""
+
+    def __init__(self, store, prefix: str):
+        self._store = store
+        self._prefix = prefix
+
+    def set(self, key, value):
+        return self._store.set(self._prefix + key, value)
+
+    def get(self, key):
+        return self._store.get(self._prefix + key)
+
+    def add(self, key, amount):
+        return self._store.add(self._prefix + key, amount)
+
+    def wait(self, keys, timeout=None):
+        return self._store.wait([self._prefix + k for k in keys], timeout)
+
+
 class ElasticManager:
     """Lease-based membership over a TCPStore (manager.py:130 role)."""
 

@@ -430,26 +430,9 @@ class ReplicaAgent:
         return self.server.port
 
 
-class _PrefixStore:
-    """Namespace adapter so one TCPStore hosts many planes: every key the
-    ElasticManager writes (`lease:{rank}`, join tickets) lands under the
-    fleet's prefix."""
-
-    def __init__(self, store, prefix: str):
-        self._store = store
-        self._prefix = prefix
-
-    def set(self, key, value):
-        return self._store.set(self._prefix + key, value)
-
-    def get(self, key):
-        return self._store.get(self._prefix + key)
-
-    def add(self, key, amount):
-        return self._store.add(self._prefix + key, amount)
-
-    def wait(self, keys, timeout=None):
-        return self._store.wait([self._prefix + k for k in keys], timeout)
+# promoted to parallel/elastic.py (the PS HA plane shares it); the
+# underscore alias keeps this module's call sites and pickles stable
+from ..parallel.elastic import PrefixStore as _PrefixStore  # noqa: E402
 
 
 # ---- router side ------------------------------------------------------------

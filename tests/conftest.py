@@ -188,3 +188,47 @@ def _no_fleet_leak():
         f"{[type(o).__name__ for o in leaked]}")
     assert len(after := fleet_threads()) <= before, (
         f"fleet/elastic thread(s) leaked out of the test: {after}")
+
+
+@pytest.fixture(autouse=True)
+def _no_ps_leak():
+    """A PS server, HA node, or WAL writer leaking out of a test keeps
+    accept/replication/communicator threads (and an open WAL segment)
+    alive under every later test. Assert the PS plane is quiescent after
+    EVERY test, reaping leftovers so one offender cannot cascade."""
+    import threading
+    import time
+    from paddle_tpu.distributed.ps import ha as _ps_ha
+    from paddle_tpu.distributed.ps import service as _ps_service
+    from paddle_tpu.distributed.ps import wal as _ps_wal
+
+    def ps_threads():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive() and t.name in
+                ("ps-serve", "ps-handler", "ps-repl-tail",
+                 "ps-communicator")]
+
+    before = len(ps_threads())
+    yield
+    leaked = [n for n in list(_ps_ha._LIVE)
+              if not getattr(n, "_closed", True)]
+    leaked += [s for s in list(_ps_service._LIVE)
+               if not getattr(s, "_closed", True)
+               and not s._stop.is_set()]
+    leaked += [w for w in list(_ps_wal._LIVE_WRITERS) if not w.closed]
+    for obj in leaked:
+        try:
+            obj.stop() if hasattr(obj, "stop") else obj.close()
+        except Exception:
+            pass
+    for _ in range(20):  # reaped threads need a beat to exit
+        after = ps_threads()
+        if len(after) <= before:
+            break
+        time.sleep(0.1)
+    assert not leaked, (
+        f"{len(leaked)} PS object(s) leaked out of the test "
+        f"(server.stop()/node.stop()/writer.close() never reached): "
+        f"{[type(o).__name__ for o in leaked]}")
+    assert len(after := ps_threads()) <= before, (
+        f"PS thread(s) leaked out of the test: {after}")

@@ -1,0 +1,42 @@
+"""PS HA node runner (executed by test_ps_ha.py's chaos soak).
+
+Joins a PS HA group as ONE HaPsNode in a real child process: connects to
+the parent's TCPStore, claims primary or bootstraps as standby, serves
+until killed (SIGKILL is the point of the drill) or until the parent
+writes a line on stdin for a graceful exit. Publishes
+`node_id role host port` through the port file once started.
+
+argv: [store_host, store_port, group_name, wal_dir, port_file]
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+store_host = sys.argv[1]
+store_port = int(sys.argv[2])
+group_name = sys.argv[3]
+wal_dir = sys.argv[4]
+port_file = sys.argv[5]
+
+from paddle_tpu._native import TCPStore  # noqa: E402
+from paddle_tpu.core import flags as _flags  # noqa: E402
+from paddle_tpu.distributed.ps.ha import HaPsNode  # noqa: E402
+
+_flags.set_flags({"ps_ha_heartbeat_s": 0.15, "ps_ha_lease_ttl_s": 0.6,
+                  "ps_replication_interval_ms": 10.0})
+
+store = TCPStore(store_host, store_port, is_master=False)
+node = HaPsNode(store, name=group_name, wal_dir=wal_dir).start()
+
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    f.write(f"{node.node_id} {node.role} {node.server.host} "
+            f"{node.server.port}")
+os.rename(tmp, port_file)   # atomic: the parent never reads a half-write
+
+sys.stdin.readline()        # parent says "exit gracefully" (or SIGKILLs us)
+node.stop()

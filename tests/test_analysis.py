@@ -815,7 +815,12 @@ class TestSelfLint:
              os.path.join(PKG, "serving", "fleet.py"),
              # continuous-batching LLM plane (ISSUE 14): the decode loop
              # dispatches every step — no host syncs beyond the tokens
-             os.path.join(PKG, "serving", "llm.py")],
+             os.path.join(PKG, "serving", "llm.py"),
+             # PS durability + HA plane (ISSUE 15): every sequenced push
+             # crosses the WAL commit path; the replication tail runs
+             # beside training
+             os.path.join(PKG, "distributed", "ps", "wal.py"),
+             os.path.join(PKG, "distributed", "ps", "ha.py")],
             all_functions=True)
         assert n_files > 25
         assert findings == [], "\n".join(f.format() for f in findings)

@@ -189,9 +189,16 @@ class TestService:
     def test_communicator_surfaces_push_errors(self, cluster):
         servers, client = cluster
         comm = Communicator(client)
-        comm.push_sparse_async("no_such_table", [1], np.ones((1, 4), np.float32))
-        with pytest.raises((RuntimeError, TimeoutError)):
-            comm.flush(timeout=10)
+        try:
+            comm.push_sparse_async("no_such_table", [1],
+                                   np.ones((1, 4), np.float32))
+            with pytest.raises((RuntimeError, TimeoutError)):
+                comm.flush(timeout=10)
+        finally:
+            try:
+                comm.stop()     # re-raises the recorded push error
+            except (RuntimeError, TimeoutError):
+                pass
 
 
 class TestCtrEndToEnd:

@@ -1,0 +1,343 @@
+"""PS durability plane: segmented WAL + crash-atomic snapshots +
+restart recovery (distributed/ps/wal.py + PsServer(wal_dir=...)).
+
+The contract under test: every sequenced mutation is WAL-framed before
+it is applied; a restart = newest intact snapshot + WAL replay, dedup'd
+by a seq ledger that itself survives the restart (trainer retries stay
+exactly-once across a crash); torn WAL tails and a crash between a
+snapshot's payload and its manifest FALL BACK (counting
+`ps.wal.fallbacks`), never error.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import faults, monitor
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.distributed.ps import (Communicator, PsClient, PsServer,
+                                       PsSnapshotUnsupportedError, SeqLedger)
+from paddle_tpu.distributed.ps import wal as _wal
+
+
+@pytest.fixture(autouse=True)
+def _monitor_on():
+    """Fallback/replay counters are the observable contract — assert
+    through the monitor plane, reset around every test."""
+    paddle.set_flags({"FLAGS_monitor": True})
+    monitor.reset()
+    yield
+    paddle.set_flags({"FLAGS_monitor": False})
+    monitor.reset()
+
+
+def _counters():
+    return monitor.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# WAL primitives
+# ---------------------------------------------------------------------------
+
+class TestWalPrimitives:
+    def test_record_roundtrip_and_replay(self, tmp_path):
+        d = str(tmp_path)
+        w = _wal.WalWriter(d)
+        ids = np.array([3, 9], np.int64)
+        grads = np.ones((2, 4), np.float32)
+        lsn = w.append(_wal.R_PUSH_SPARSE, "emb", "c1", 7,
+                       _wal.pack_push_sparse(ids, grads))
+        assert lsn == 1 and w.last_lsn == 1
+        w.close()
+        recs = _wal.replay(d)
+        assert [r.lsn for r in recs] == [1]
+        r = recs[0]
+        assert (r.rtype, r.table, r.client, r.seq) == (
+            _wal.R_PUSH_SPARSE, "emb", "c1", 7)
+        rids, rgrads = _wal.unpack_push_sparse(r.payload)
+        np.testing.assert_array_equal(rids, ids)
+        np.testing.assert_array_equal(rgrads, grads)
+
+    def test_replay_stops_at_corrupt_record(self, tmp_path):
+        d = str(tmp_path)
+        w = _wal.WalWriter(d)
+        for seq in (1, 2, 3):
+            w.append(_wal.R_PUSH_DENSE, "fc", "c", seq,
+                     _wal.pack_push_dense(np.ones(4, np.float32)))
+        w.close()
+        (start, path), = _wal._seg_files(d)
+        with open(path, "r+b") as f:      # flip one payload byte of rec 2
+            f.seek(os.path.getsize(path) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        recs = _wal.replay(d)
+        assert [r.lsn for r in recs] == [1]   # intact prefix only
+        assert _counters().get("ps.wal.fallbacks", 0) >= 1
+
+    def test_segment_rollover_and_gc(self, tmp_path):
+        d = str(tmp_path)
+        w = _wal.WalWriter(d, segment_bytes=256)
+        for seq in range(1, 11):
+            w.append(_wal.R_PUSH_DENSE, "fc", "c", seq,
+                     _wal.pack_push_dense(np.ones(8, np.float32)))
+        w.close()
+        assert len(_wal._seg_files(d)) > 1
+        assert [r.lsn for r in _wal.replay(d)] == list(range(1, 11))
+        assert [r.lsn for r in _wal.replay(d, after_lsn=7)] == [8, 9, 10]
+        removed = _wal.gc_segments(d, below_lsn=8)
+        assert removed                     # fully-covered segments dropped
+        assert [r.lsn for r in _wal.replay(d, after_lsn=7)] == [8, 9, 10]
+
+    def test_seq_ledger_out_of_order_exactly_once(self):
+        led = SeqLedger()
+        assert led.record("c", 2) and led.record("c", 1)
+        assert not led.record("c", 2)          # duplicate dropped
+        assert led.record("c", 4)              # gap: extras hold it
+        assert led.state()["c"] == {"floor": 2, "extra": [4]}
+        assert led.record("c", 3)              # gap fills -> compacts
+        assert led.state()["c"] == {"floor": 4, "extra": []}
+        led2 = SeqLedger()
+        led2.load_state(led.state())
+        assert not led2.record("c", 3)         # survives a state round-trip
+        assert led2.record("c", 5)
+
+
+# ---------------------------------------------------------------------------
+# snapshot + restart recovery
+# ---------------------------------------------------------------------------
+
+def _start(wal_dir, tables=True):
+    s = PsServer("127.0.0.1", 0, wal_dir=wal_dir)
+    s.run()
+    c = PsClient([f"127.0.0.1:{s.port}"])
+    if tables:
+        c.create_sparse_table("emb", 4, optimizer="adagrad", lr=0.5, seed=3)
+        c.create_dense_table("fc", 6, optimizer="adam", lr=0.1)
+        c.register_sparse_dim("emb", 4)
+    return s, c
+
+
+class TestSnapshotRecovery:
+    def test_restart_replays_snapshot_plus_wal_suffix(self, tmp_path):
+        d = str(tmp_path)
+        s, c = _start(d)
+        ids = np.array([1, 5, 9], np.int64)
+        c.push_sparse("emb", ids, np.ones((3, 4), np.float32))
+        c.push_dense("fc", np.ones(6, np.float32))
+        s.snapshot()
+        c.push_sparse("emb", ids, np.full((3, 4), 2.0, np.float32))
+        c.push_dense("fc", np.ones(6, np.float32))
+        want_sparse = c.pull_sparse("emb", ids).copy()
+        want_dense = c.pull_dense("fc").copy()
+        c.close()
+        s.stop()
+
+        s2 = PsServer("127.0.0.1", 0, wal_dir=d)   # cold restart
+        s2.run()
+        c2 = PsClient([f"127.0.0.1:{s2.port}"])
+        c2.register_sparse_dim("emb", 4)
+        try:
+            # adagrad g2 slots + adam moments came back too: the restored
+            # trajectory continues, not a fresh first step
+            np.testing.assert_array_equal(
+                c2.pull_sparse("emb", ids), want_sparse)
+            np.testing.assert_array_equal(c2.pull_dense("fc"), want_dense)
+            assert _counters().get("ps.wal.records_replayed", 0) >= 2
+        finally:
+            c2.close()
+            s2.stop()
+
+    def test_client_retry_stays_exactly_once_across_restart(self, tmp_path):
+        """A push acked by the dying server must NOT double-apply when
+        the trainer retries it (same seqs) against the restarted one."""
+        d = str(tmp_path)
+        s, c = _start(d)
+        base = c.pull_sparse("emb", [42]).copy()
+        box = {}
+        c.push_sparse("emb", [42], np.ones((1, 4), np.float32), _seqs=box)
+        want = c.pull_sparse("emb", [42]).copy()
+        port = s.port
+        s.stop()
+
+        s2 = PsServer("127.0.0.1", port, wal_dir=d)   # same endpoint
+        s2.run()
+        try:
+            # the SAME client retries with its ORIGINAL seqs (the _seqs
+            # box): the recovered ledger drops the duplicate
+            c.push_sparse("emb", [42], np.ones((1, 4), np.float32),
+                          _seqs=box)
+            got = c.pull_sparse("emb", [42])
+            np.testing.assert_array_equal(got, want)
+            assert not np.allclose(got, base)      # applied exactly once
+        finally:
+            c.close()
+            s2.stop()
+
+    def test_ctr_stats_ttl_decay_shrink_survive_bitexact(self, tmp_path):
+        """show/click counters, the decay clock, and shrink outcomes must
+        round-trip snapshot -> restart -> replay BIT-exactly: a drifted
+        CTR score changes which rows a later shrink deletes."""
+        d = str(tmp_path)
+        s = PsServer("127.0.0.1", 0, wal_dir=d)
+        s.run()
+        c = PsClient([f"127.0.0.1:{s.port}"])
+        c.create_sparse_table("ctr", 4, optimizer="sgd", lr=0.5,
+                              accessor="ctr", delete_threshold=0.5,
+                              ttl_days=30.0)
+        c.register_sparse_dim("ctr", 4)
+        ids = np.array([1, 2, 3], np.int64)
+        c.pull_sparse("ctr", ids)
+        c.push_show_click("ctr", ids, [5.0, 1.0, 3.0], [2.0, 0.0, 1.0])
+        c.decay("ctr")
+        s.snapshot()
+        c.push_show_click("ctr", [1, 2], [2.0, 1.0], [1.0, 0.0])
+        c.decay("ctr")                     # WAL suffix: replayed on restart
+        deleted = c.shrink("ctr")
+        want = {int(k): s.table("ctr").row_stat(int(k)) for k in ids}
+        want_rows = c.pull_sparse("ctr", ids).copy()
+        c.close()
+        s.stop()
+
+        s2 = PsServer("127.0.0.1", 0, wal_dir=d)
+        s2.run()
+        try:
+            t2 = s2.table("ctr")
+            for k in ids:
+                assert t2.row_stat(int(k)) == want[int(k)]   # bit-exact
+            c2 = PsClient([f"127.0.0.1:{s2.port}"])
+            c2.register_sparse_dim("ctr", 4)
+            np.testing.assert_array_equal(
+                c2.pull_sparse("ctr", ids), want_rows)
+            assert c2.shrink("ctr") == 0   # replayed shrink already pruned
+            c2.close()
+        finally:
+            s2.stop()
+        assert deleted >= 0
+
+    def test_graph_table_snapshot_raises_typed(self, tmp_path):
+        s = PsServer("127.0.0.1", 0, wal_dir=str(tmp_path))
+        s.add_sparse_table("emb", dim=4)
+        g = s.add_graph_table("graph")
+        g.add_edges([1, 2], [2, 3])
+        s.run()
+        try:
+            with pytest.raises(PsSnapshotUnsupportedError):
+                s.snapshot()
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault sites: ps.wal.write (torn) + ps.snapshot.commit (crash point)
+# ---------------------------------------------------------------------------
+
+class TestDurabilityFaultSites:
+    def test_torn_wal_tail_falls_back_to_intact_prefix(self, tmp_path):
+        d = str(tmp_path)
+        s, c = _start(d)
+        ids = np.array([1, 5], np.int64)
+        c.push_sparse("emb", ids, np.ones((2, 4), np.float32))
+        want = c.pull_sparse("emb", ids).copy()
+        with faults.inject("ps.wal.write:torn:times=1"):
+            c.push_sparse("emb", ids, np.ones((2, 4), np.float32))
+        c.close()
+        s.stop()
+
+        s2 = PsServer("127.0.0.1", 0, wal_dir=d)   # never an error
+        s2.run()
+        c2 = PsClient([f"127.0.0.1:{s2.port}"])
+        c2.register_sparse_dim("emb", 4)
+        try:
+            # recovery truncated the torn record: state is the intact
+            # prefix (the designed fallback window), counted as such
+            np.testing.assert_array_equal(c2.pull_sparse("emb", ids), want)
+            assert _counters().get("ps.wal.fallbacks", 0) >= 1
+        finally:
+            c2.close()
+            s2.stop()
+
+    def test_crash_between_snapshot_payload_and_manifest(self, tmp_path):
+        d = str(tmp_path)
+        s, c = _start(d)
+        ids = np.array([2, 7], np.int64)
+        c.push_sparse("emb", ids, np.ones((2, 4), np.float32))
+        s.snapshot()                                   # good generation v1
+        c.push_sparse("emb", ids, np.ones((2, 4), np.float32))
+        want = c.pull_sparse("emb", ids).copy()
+        with faults.inject("ps.snapshot.commit:error:times=1"):
+            with pytest.raises(faults.InjectedFault):  # the simulated crash
+                s.snapshot()                           # v2 payload, no manifest
+        c.close()
+        s.stop()
+
+        s2 = PsServer("127.0.0.1", 0, wal_dir=d)
+        s2.run()
+        c2 = PsClient([f"127.0.0.1:{s2.port}"])
+        c2.register_sparse_dim("emb", 4)
+        try:
+            # the orphaned v2 payload is detected, v1 + full WAL replay
+            # reconstructs the exact pre-crash state
+            np.testing.assert_array_equal(c2.pull_sparse("emb", ids), want)
+            assert _counters().get("ps.wal.fallbacks", 0) >= 1
+        finally:
+            c2.close()
+            s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Communicator failover: transport errors requeue, bounded
+# ---------------------------------------------------------------------------
+
+class TestCommunicatorFailover:
+    def test_transport_error_requeues_and_applies_once(self):
+        s = PsServer()
+        s.add_sparse_table("emb", dim=4, lr=0.5)
+        s.run()
+        client = PsClient([f"127.0.0.1:{s.port}"], max_retries=1,
+                          backoff_ms=5.0)
+        client.register_sparse_dim("emb", 4)
+        comm = Communicator(client)
+        try:
+            base = client.pull_sparse("emb", [8]).copy()
+            # 3 resets > the client's retry budget: the push FAILS at the
+            # client layer and must be re-enqueued, not poison the worker
+            with faults.inject("ps.rpc.send:conn_reset:times=3"):
+                comm.push_sparse_async("emb", [8],
+                                       np.ones((1, 4), np.float32))
+                comm.flush(timeout=30.0)
+            got = client.pull_sparse("emb", [8])
+            np.testing.assert_allclose(got, base - 0.5, rtol=1e-6)
+            assert _counters().get("ps.communicator.requeues", 0) >= 1
+        finally:
+            comm.stop()
+            client.close()
+            s.stop()
+
+    def test_requeue_budget_exhaustion_is_permanent(self):
+        _flags.set_flags({"ps_communicator_max_requeues": 1})
+        try:
+            s = PsServer()
+            s.add_sparse_table("emb", dim=4, lr=0.5)
+            s.run()
+            client = PsClient([f"127.0.0.1:{s.port}"], max_retries=0,
+                              backoff_ms=1.0)
+            client.register_sparse_dim("emb", 4)
+            comm = Communicator(client)
+            try:
+                with faults.inject("ps.rpc.send:conn_reset"):  # unbounded
+                    comm.push_sparse_async("emb", [8],
+                                           np.ones((1, 4), np.float32))
+                    with pytest.raises(RuntimeError) as ei:
+                        comm.flush(timeout=30.0)
+                    assert isinstance(ei.value.__cause__, OSError)
+            finally:
+                try:
+                    comm.stop()     # re-raises the recorded push error
+                except RuntimeError:
+                    pass
+                client.close()
+                s.stop()
+        finally:
+            _flags.set_flags({"ps_communicator_max_requeues": 3})

@@ -1,0 +1,304 @@
+"""PS high availability: warm-standby replication + lease failover
+(distributed/ps/ha.py over the WAL plane).
+
+The contract under test: a standby tails the primary's delta stream and
+converges bit-exactly; a trainer's PsClient fails over to the promoted
+standby WITHIN its original per-call deadline; in-flight pushes replay
+idempotently off the replicated seq ledger (exactly-once across the
+kill); staleness after promotion is bounded by the acked replication
+watermark; a killed primary restarts from its WAL and REJOINS as the
+new standby. The slow-tier soak SIGKILLs a real primary process
+mid-training under injected connection resets and audits the full table
+against a fault-free oracle — zero lost, zero double-applied.
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import faults, monitor
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.distributed.ps import Communicator
+from paddle_tpu.distributed.ps import ha as psha
+from paddle_tpu.distributed.ps.table import SparseTable
+
+
+@pytest.fixture(autouse=True)
+def _monitor_on():
+    paddle.set_flags({"FLAGS_monitor": True})
+    monitor.reset()
+    yield
+    paddle.set_flags({"FLAGS_monitor": False})
+    monitor.reset()
+
+
+@pytest.fixture(autouse=True)
+def ha_flags():
+    """Tight lease/replication clocks so failover drills finish fast."""
+    keep = {k: _flags.flag(k) for k in
+            ("ps_ha_lease_ttl_s", "ps_ha_heartbeat_s",
+             "ps_replication_interval_ms", "ps_rpc_backoff_ms")}
+    _flags.set_flags({"ps_ha_lease_ttl_s": 0.6, "ps_ha_heartbeat_s": 0.15,
+                      "ps_replication_interval_ms": 10.0,
+                      "ps_rpc_backoff_ms": 20.0})
+    yield
+    _flags.set_flags(keep)
+
+
+class DictStore:
+    """In-memory TCPStore stand-in (set/get/add contract incl. the
+    native add-counter namespace) — in-process HA drills need no real
+    rendezvous server."""
+
+    def __init__(self):
+        self._kv = {}
+        self._counters = {}
+        self._lock = threading.Lock()
+
+    def set(self, k, v):
+        with self._lock:
+            self._kv[k] = v.encode() if isinstance(v, str) else bytes(v)
+
+    def get(self, k):
+        with self._lock:
+            if k not in self._kv:
+                raise KeyError(k)
+            return self._kv[k]
+
+    def add(self, k, n):
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0) + n
+            return self._counters[k]
+
+
+def _kill_node(node):
+    """Simulated process death: serve loop, heartbeat, and replication
+    stop abruptly — no deregistration, no drain."""
+    node._loop_stop.set()
+    node._es.stop()
+    node.server.stop()
+    node._closed = True
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    store = DictStore()
+    primary = psha.HaPsNode(store, wal_dir=str(tmp_path / "a")).start()
+    standby = psha.HaPsNode(store, wal_dir=str(tmp_path / "b")).start()
+    client = psha.connect(store)
+    yield store, primary, standby, client
+    client.close()
+    for n in (primary, standby):
+        if not n._closed:
+            n.stop()
+
+
+class TestReplication:
+    def test_roles_and_convergence(self, pair):
+        store, primary, standby, client = pair
+        assert primary.role == "primary" and standby.role == "standby"
+        client.create_sparse_table("emb", 4, optimizer="adagrad", lr=0.5,
+                                   seed=3)
+        client.register_sparse_dim("emb", 4)
+        ids = np.array([1, 5, 9], np.int64)
+        client.push_sparse("emb", ids, np.ones((3, 4), np.float32))
+        _wait(lambda: standby.server.applied_lsn == primary.server.applied_lsn,
+              msg="standby tail convergence")
+        # bit-exact: tables AND optimizer slots rode the delta stream
+        np.testing.assert_array_equal(
+            standby.server.table("emb").pull(ids),
+            primary.server.table("emb").pull(ids))
+        # the primary records the standby's acked watermark on the tail's
+        # NEXT poll (the ack rides the following CMD_REPLICATE request)
+        _wait(lambda: primary.server._repl_acks.get(str(standby.node_id),
+                                                    0) >= 1,
+              msg="replication ack watermark")
+        assert monitor.snapshot()["counters"]["ps.replication.records"] >= 2
+
+    def test_failover_within_call_deadline_and_bounded_staleness(self, pair):
+        store, primary, standby, client = pair
+        client.create_sparse_table("emb", 4, optimizer="sgd", lr=0.5,
+                                   seed=3)
+        client.register_sparse_dim("emb", 4)
+        ids = np.array([1, 2], np.int64)
+        for _ in range(5):
+            client.push_sparse("emb", ids, np.ones((2, 4), np.float32))
+        _wait(lambda: standby.server.applied_lsn == primary.server.applied_lsn,
+              msg="standby tail convergence")
+        before = client.pull_sparse("emb", ids).copy()
+        acked = primary.server._repl_acks.get(str(standby.node_id), 0)
+        _kill_node(primary)
+
+        t0 = time.monotonic()
+        client.push_sparse("emb", ids, np.ones((2, 4), np.float32))
+        took = time.monotonic() - t0
+        # within the ORIGINAL per-call deadline — and in practice within
+        # a few lease TTLs, not the full 120 s budget
+        assert took < float(_flags.flag("ps_rpc_call_timeout_s"))
+        assert took < 10.0, f"failover took {took:.1f}s"
+        assert standby.role == "primary"
+        # bounded staleness: the survivor serves nothing older than the
+        # watermark it acked while the dead primary could still observe it
+        assert standby.server.applied_lsn >= acked
+        got = client.pull_sparse("emb", ids)
+        np.testing.assert_array_equal(got, before - 0.5)
+        c = monitor.snapshot()["counters"]
+        assert c.get("ps.failovers", 0) >= 1
+        assert c.get("ps.promotions", 0) == 1
+
+    def test_inflight_push_replays_idempotently_across_failover(self, pair):
+        """A push ACKED by the dying primary and already replicated must
+        be dropped by the survivor's ledger when the trainer's retry
+        re-sends it with the original seqs."""
+        store, primary, standby, client = pair
+        client.create_sparse_table("emb", 4, optimizer="sgd", lr=0.5,
+                                   seed=3)
+        client.register_sparse_dim("emb", 4)
+        box = {}
+        client.push_sparse("emb", [7], np.ones((1, 4), np.float32),
+                           _seqs=box)
+        _wait(lambda: standby.server.applied_lsn == primary.server.applied_lsn,
+              msg="standby tail convergence")
+        want = client.pull_sparse("emb", [7]).copy()
+        _kill_node(primary)
+        # the retry half of an in-flight push: same client, same seqs
+        client.push_sparse("emb", [7], np.ones((1, 4), np.float32),
+                           _seqs=box)
+        assert standby.role == "primary"
+        np.testing.assert_array_equal(client.pull_sparse("emb", [7]), want)
+
+    def test_ex_primary_rejoins_as_standby(self, pair, tmp_path):
+        store, primary, standby, client = pair
+        client.create_sparse_table("emb", 4, optimizer="sgd", lr=0.5,
+                                   seed=3)
+        client.register_sparse_dim("emb", 4)
+        client.push_sparse("emb", [1], np.ones((1, 4), np.float32))
+        _wait(lambda: standby.server.applied_lsn == primary.server.applied_lsn,
+              msg="standby tail convergence")
+        _kill_node(primary)
+        client.push_sparse("emb", [1], np.ones((1, 4), np.float32))
+        assert standby.role == "primary"
+        want = client.pull_sparse("emb", [1]).copy()
+
+        # the dead primary restarts from its own WAL dir and REJOINS
+        rejoined = psha.HaPsNode(store, wal_dir=str(tmp_path / "a")).start()
+        try:
+            assert rejoined.role == "standby"
+            _wait(lambda: (rejoined.server.applied_lsn
+                           == standby.server.applied_lsn),
+                  msg="rejoined standby convergence")
+            np.testing.assert_array_equal(
+                rejoined.server.table("emb").pull([1]), want)
+        finally:
+            rejoined.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos soak (slow tier): SIGKILL the primary PROCESS mid-training under
+# injected resets; audit the surviving table against a fault-free oracle
+# ---------------------------------------------------------------------------
+
+def _spawn_node(store, group, wal_dir, tmp_path, tag):
+    port_file = str(tmp_path / f"ps-node-{tag}.port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "ps_ha_runner.py"),
+         store.host, str(store.port), group, wal_dir, port_file],
+        stdin=subprocess.PIPE, env=env)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(port_file):
+        assert proc.poll() is None, "ps node runner died during startup"
+        assert time.monotonic() < deadline, "ps node never started"
+        time.sleep(0.05)
+    node_id, role, host, port = open(port_file).read().split()
+    os.remove(port_file)     # a respawn with the same tag re-publishes
+    return proc, int(node_id), role, host, int(port)
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_sigkill_primary_midtraining_zero_lost_zero_doubled(
+            self, tmp_path):
+        from paddle_tpu._native import TCPStore
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        group = "soak"
+        wal_a = str(tmp_path / "wal-a")
+        wal_b = str(tmp_path / "wal-b")
+        proc_a, _, role_a, _, _ = _spawn_node(store, group, wal_a,
+                                              tmp_path, "a")
+        assert role_a == "primary"
+        proc_b, _, role_b, _, _ = _spawn_node(store, group, wal_b,
+                                              tmp_path, "b")
+        assert role_b == "standby"
+
+        client = psha.connect(store, group, backoff_ms=20.0)
+        comm = Communicator(client)
+        dim, lr, seed = 8, 0.1, 5
+        ids = np.arange(32, dtype=np.int64)
+        client.create_sparse_table("emb", dim, optimizer="sgd", lr=lr,
+                                   seed=seed)
+        client.register_sparse_dim("emb", dim)
+        client.pull_sparse("emb", ids)        # materialize every row
+        oracle = SparseTable(dim=dim, optimizer="sgd", lr=lr, seed=seed)
+        oracle.pull(ids)
+
+        steps, kill_at = 40, 12
+        rng = np.random.default_rng(17)
+        try:
+            with faults.inject("ps.rpc.send:conn_reset:p=0.05:seed=9"):
+                for k in range(steps):
+                    # |g| >= 0.5: a lost or doubled push moves every
+                    # audited value well past the audit tolerance
+                    g = np.where(rng.random((len(ids), dim)) < 0.5,
+                                 -1.0, 1.0).astype(np.float32) * 0.5
+                    comm.push_sparse_async("emb", ids, g)
+                    oracle.push(ids, g)
+                    if k == kill_at:
+                        os.kill(proc_a.pid, signal.SIGKILL)
+                        proc_a.wait(timeout=10)
+                    time.sleep(0.02)      # stream, don't batch
+                comm.flush(timeout=120.0)
+        finally:
+            comm.stop()
+
+        # the killed primary restarts from its WAL and rejoins as the
+        # new standby (handing back anything replication never saw)
+        proc_a2, _, role_a2, _, _ = _spawn_node(store, group, wal_a,
+                                                tmp_path, "a")
+        assert role_a2 == "standby"
+        time.sleep(1.0)                   # let handback + tail settle
+
+        # full-table audit vs the fault-free oracle: row-for-row equal
+        # within float32 accumulation-order noise — zero lost pushes,
+        # zero double-applied retries
+        got = client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(got, oracle.pull(ids), atol=1e-4)
+        c = monitor.snapshot()["counters"]
+        assert c.get("ps.failovers", 0) >= 1
+
+        client.close()
+        for p in (proc_b, proc_a2):
+            p.stdin.write(b"\n")
+            p.stdin.flush()
+        for p in (proc_b, proc_a2):
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
