@@ -911,6 +911,124 @@ def bench_serving_slo(backend):
     return out
 
 
+def bench_telemetry(backend):
+    """Fleet-telemetry tax A/B (obs/telemetry.py): the same train-step
+    loop and serving burst with the exporter off vs on — on means a live
+    TelemetryCollector plus a TelemetryExporter shipping delta counters,
+    mergeable sketches, and events every FLAGS_telemetry_interval_s. The
+    exporter's hot-path contract (event() appends to a deque; every
+    socket op lives on the export thread) targets <=2% tax on both the
+    train samples/s and the serving p99.
+
+    Knob: BENCH_TELEMETRY=ab|off (default ab runs both arms)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.monitor as monitor
+    import paddle_tpu.nn as nn
+    from paddle_tpu import models
+    from paddle_tpu._native import TCPStore
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.obs import telemetry as _telemetry
+    from paddle_tpu.serving import engine as _eng
+
+    if os.environ.get("BENCH_TELEMETRY", "ab").lower() == "off":
+        return {"skipped": "BENCH_TELEMETRY=off"}
+
+    batch, seqlen = (32, 128) if backend == "tpu" else (8, 64)
+    n_steps = 30 if backend == "tpu" else 6
+    n_req = 400 if backend == "tpu" else 200
+
+    paddle.seed(0)
+    base = models.ernie_base(hidden_dropout_prob=0.0) \
+        if backend == "tpu" else \
+        models.ErnieModel(vocab_size=1024, hidden_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=512, hidden_dropout_prob=0.0)
+    net = models.ErnieForPretraining(base)
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(logits, nsp_logits, ids, nsp):
+        v = logits.shape[-1]
+        return ce(logits.reshape([-1, v]), ids.reshape([-1])) \
+            + ce(nsp_logits, nsp)
+
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-4)
+    step = TrainStep(net, loss_fn, opt, amp_dtype="bfloat16",
+                     n_model_inputs=1)
+    vocab = base.embeddings.word_embeddings.weight.shape[0]
+    ids = paddle.to_tensor(np.random.randint(
+        0, vocab, (batch, seqlen)).astype(np.int32))
+    nsp = paddle.to_tensor(np.random.randint(
+        0, 2, (batch,)).astype(np.int32))
+    _sync(step(ids, ids, nsp)._value)   # compile outside both arms
+
+    def one_arm(on):
+        _flags.set_flags({"monitor": True, "telemetry": on})
+        store = col = exp = None
+        if on:
+            store = TCPStore("127.0.0.1", 0, is_master=True)
+            col = _telemetry.TelemetryCollector(
+                store, fleet="bench").start()
+            exp = _telemetry.TelemetryExporter(
+                store, source="bench-0", role="replica",
+                fleet="bench").start()
+        try:
+            sps = 0.0
+            for _ in range(3):                # best-of: dodge CPU noise
+                t0 = time.perf_counter()
+                loss = None
+                for _ in range(n_steps):
+                    loss = step(ids, ids, nsp)
+                _sync(loss._value)
+                sps = max(sps,
+                          batch * n_steps / (time.perf_counter() - t0))
+
+            eng = _eng.ServingEngine(lambda arrays: arrays).start()
+            x = np.random.rand(1, 16).astype("float32")
+            p99s = []
+            try:
+                for _ in range(20):           # warm the bucket executable
+                    eng.submit([x]).result(timeout=10)
+                for _ in range(3):            # median p99: the tail of a
+                    lat = []                  # short burst is noisy
+                    for i in range(n_req):
+                        t1 = time.perf_counter()
+                        eng.submit([x]).result(timeout=10)
+                        lat.append(time.perf_counter() - t1)
+                        if on and i % 25 == 0:   # realistic event cadence
+                            exp.event("rollout", seq=i)
+                    p99s.append(float(np.quantile(lat, 0.99)))
+            finally:
+                eng.stop()
+            p99_us = float(np.median(p99s)) * 1e6
+            pushes = exp.pushes if on else 0
+        finally:
+            if exp is not None:
+                exp.stop()
+            if col is not None:
+                col.stop()
+            _flags.set_flags({"monitor": False, "telemetry": False})
+            monitor.reset()
+        return sps, p99_us, pushes
+
+    sps_off, p99_off, _ = one_arm(False)
+    sps_on, p99_on, pushes = one_arm(True)
+    return {
+        "train_steps_per_arm": n_steps,
+        "requests_per_arm": n_req,
+        "pushes_on_arm": pushes,
+        "train_sps_off": round(sps_off, 2),
+        "train_sps_on": round(sps_on, 2),
+        "train_tax_pct": round((sps_off - sps_on) / sps_off * 100, 2)
+        if sps_off else None,
+        "serving_p99_us_off": round(p99_off, 1),
+        "serving_p99_us_on": round(p99_on, 1),
+        "serving_p99_tax_pct": round((p99_on - p99_off) / p99_off * 100, 2)
+        if p99_off else None,
+    }
+
+
 def bench_ps_durability(backend):
     """PS durability tax A/B: sequenced sparse-push throughput with the
     WAL off vs on (FLAGS_ps_wal_dir), plus the recovery path timed —
@@ -1100,6 +1218,7 @@ def main():
                     ("ernie10b_layer", bench_ernie10b_layer),
                     ("allreduce_smoke", bench_allreduce),
                     ("serving_slo", bench_serving_slo),
+                    ("telemetry", bench_telemetry),
                     ("ps_durability", bench_ps_durability),
                     ("llm", bench_llm),
                     ("warm_start", bench_warm_start)):

@@ -344,6 +344,33 @@ define_flag("slo_shed_burn", 0.0,
             "new requests as overloaded before the budget burns; "
             "0 = never shed on burn")
 
+# ---- fleet telemetry plane (obs/telemetry.py) -----------------------------
+define_flag("telemetry", False,
+            "fleet telemetry plane (obs/telemetry.py): processes run a "
+            "TelemetryExporter pushing delta-compressed counters, "
+            "mergeable DDSketch histograms, and immediate events to the "
+            "TelemetryCollector found via TCPStore rendezvous; off = "
+            "zero telemetry threads/sockets")
+define_flag("telemetry_interval_s", 0.25,
+            "telemetry: exporter metric-push period in seconds (events "
+            "push immediately regardless)")
+define_flag("telemetry_buffer", 256,
+            "telemetry: exporter's bounded drop-oldest event buffer — a "
+            "dead collector costs at most this many queued events "
+            "(telemetry.dropped counts the overflow), never serving "
+            "throughput")
+define_flag("telemetry_ring", 256,
+            "telemetry: collector's per-(source, metric) time-series "
+            "ring length and its fleet event-ring length")
+define_flag("telemetry_death_after_s", 1.5,
+            "telemetry: collector declares a silent source dead after "
+            "this many seconds without a push (socket EOF on SIGKILL is "
+            "the fast path; this reaper catches wedged-not-dead)")
+define_flag("telemetry_incident_min_interval_s", 30.0,
+            "telemetry: minimum spacing between correlated-incident "
+            "fan-outs — a crash loop yields one fleet-wide dump set per "
+            "window, not a dump storm")
+
 # ---- executable plane (core/executable.py + core/compile_cache.py) --------
 define_flag("compile_cache_dir", "",
             "persistent on-disk executable cache (core/compile_cache.py): "

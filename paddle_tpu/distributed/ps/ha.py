@@ -170,11 +170,17 @@ class HaPsNode:
             return self._become_standby(cur)
         self.role = self.server.ha_role = "primary"
         self._primary_rec = None
+        from ...obs import telemetry as _telemetry
+        _telemetry.emit("role_change", role="primary", node=self.node_id,
+                        epoch=self.epoch)
         self._write_status(force=True)
 
     def _become_standby(self, rec: dict):
         self.role = self.server.ha_role = "standby"
         self._primary_rec = rec
+        from ...obs import telemetry as _telemetry
+        _telemetry.emit("role_change", role="standby", node=self.node_id,
+                        primary=rec.get("rank"))
         endpoint = f"{rec['host']}:{rec['port']}"
         sk = ha_connect(endpoint)
         try:

@@ -68,7 +68,9 @@ __all__ = [
     "count", "gauge_set", "observe", "log_event", "record_op",
     "record_collective", "record_retrace", "record_span",
     "span", "snapshot", "report", "reset",
-    "export_json", "prometheus_text", "export_prometheus",
+    "mergeable_snapshot", "merge_snapshots",
+    "export_json", "prometheus_text", "prometheus_text_multi",
+    "export_prometheus",
 ]
 
 # Hot-path gate: instrumented sites read this module attribute directly.
@@ -236,6 +238,77 @@ class Histogram:
         with self._lock:
             return {q: self._quantile_locked(q) for q in qs}
 
+    # -- mergeable form (the fleet telemetry plane ships these) --
+
+    def sketch_payload(self) -> Dict[str, Any]:
+        """JSON-able mergeable form: the raw log-bins plus the running
+        aggregates. `merge()` on the receiving side reconstructs EXACT
+        fleet-wide quantiles (bin-wise sums preserve the <=1% bound —
+        averaging per-source p99s would not)."""
+        with self._lock:
+            return {
+                "bins": {str(i): c for i, c in self._sketch.items()},
+                "zero": self._sketch_zero,
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max,
+                "buckets": list(self.buckets),
+                "bucket_counts": list(self.bucket_counts),
+            }
+
+    @classmethod
+    def from_payload(cls, name: str,
+                     payload: Dict[str, Any]) -> "Histogram":
+        h = cls(name, buckets=tuple(payload.get("buckets")
+                                    or _DEFAULT_BUCKETS))
+        h.merge(payload)
+        return h
+
+    def merge(self, other) -> "Histogram":
+        """Fold another histogram (or a `sketch_payload()` dict) into this
+        one. Sketches merge exactly: per-bin counts add, so the merged
+        quantiles carry the same <=1% relative-error bound as a single
+        sketch fed the pooled observations. Returns self."""
+        if isinstance(other, Histogram):
+            other = other.sketch_payload()
+        cnt = int(other.get("count", 0))
+        obuckets = tuple(other.get("buckets") or ())
+        ocounts = list(other.get("bucket_counts") or ())
+        with self._lock:
+            if cnt:
+                self.count += cnt
+                self.sum += float(other.get("sum", 0.0))
+                omin = other.get("min")
+                if omin is not None and float(omin) < self.min:
+                    self.min = float(omin)
+                omax = float(other.get("max", 0.0))
+                if omax > self.max:
+                    self.max = omax
+            if obuckets == self.buckets and len(ocounts) == len(self.buckets):
+                for i, c in enumerate(ocounts):
+                    self.bucket_counts[i] += int(c)
+            elif ocounts:
+                # boundary mismatch: re-bucket the other side's per-bucket
+                # deltas at their upper bounds (cumulative stays monotone;
+                # the sketch below keeps the accurate quantiles)
+                prev = 0
+                for ub, c in zip(obuckets, ocounts):
+                    delta = int(c) - prev
+                    prev = int(c)
+                    if delta <= 0:
+                        continue
+                    for i, mine in enumerate(self.buckets):
+                        if ub <= mine:
+                            self.bucket_counts[i] += delta
+            self._sketch_zero += int(other.get("zero", 0))
+            for idx, c in (other.get("bins") or {}).items():
+                i = int(idx)
+                self._sketch[i] = self._sketch.get(i, 0) + int(c)
+            while len(self._sketch) > _SKETCH_MAX_BINS:
+                self._collapse_locked()
+        return self
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             cnt = self.count
@@ -324,6 +397,21 @@ class StatRegistry:
             "gauges": gauges,
             "histograms": {n: h.stats() for n, h in hists},
             "events": events,
+        }
+
+    def mergeable_snapshot(self) -> Dict[str, Any]:
+        """Like snapshot(), but histograms come as `sketch_payload()` dicts
+        so the receiving side can `merge_snapshots()` them into true
+        fleet-wide quantiles (a stats() dict cannot be merged — its
+        quantiles are already collapsed)."""
+        with self._lock:
+            counters = {n: c.get() for n, c in self._counters.items()}
+            gauges = {n: g.get() for n, g in self._gauges.items()}
+            hists = list(self._histograms.items())
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {n: h.sketch_payload() for n, h in hists},
         }
 
     def reset(self) -> None:
@@ -475,6 +563,41 @@ def snapshot() -> Dict[str, Any]:
     return _REGISTRY.snapshot()
 
 
+def mergeable_snapshot() -> Dict[str, Any]:
+    """snapshot() with histograms in `Histogram.sketch_payload()` form —
+    the shape `merge_snapshots()` consumes and the telemetry exporter
+    ships over the wire."""
+    return _REGISTRY.mergeable_snapshot()
+
+
+def merge_snapshots(snaps) -> Dict[str, Any]:
+    """Fold mergeable snapshots (see `mergeable_snapshot()`) from several
+    sources into one fleet-wide view: counters and gauges SUM (a fleet
+    queue depth is the sum of per-replica depths), histograms merge
+    bin-wise into `Histogram` objects whose quantiles keep the sketch's
+    <=1% relative-error bound — the one aggregation averaging per-source
+    p99s can never give you."""
+    counters: Dict[str, Any] = {}
+    gauges: Dict[str, Any] = {}
+    hists: Dict[str, Histogram] = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for name, v in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + v
+        for name, v in (snap.get("gauges") or {}).items():
+            gauges[name] = gauges.get(name, 0) + v
+        for name, payload in (snap.get("histograms") or {}).items():
+            if not isinstance(payload, dict) or "bins" not in payload:
+                continue   # stats()-shaped entry: not mergeable, skip
+            h = hists.get(name)
+            if h is None:
+                hists[name] = Histogram.from_payload(name, payload)
+            else:
+                h.merge(payload)
+    return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+
 def events() -> List[Dict[str, Any]]:
     return _REGISTRY.events()
 
@@ -602,6 +725,98 @@ def export_prometheus(path: str) -> str:
     return path
 
 
+def _prom_escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def prometheus_text_multi(per_source: Dict[str, Dict[str, Any]]) -> str:
+    """ONE fleet-wide Prometheus scrape over many sources' snapshots
+    (snapshot()- or mergeable_snapshot()-shaped, keyed by source name).
+
+    The multi-source fix: the same metric from N sources becomes N samples
+    of ONE family distinguished by a `source` label — NOT N name-mangled
+    `_dup` families (the single-process `_prom_uniq` collision rule stays
+    for sanitization collisions WITHIN a source, where no label can help).
+
+    Histograms additionally emit a fleet-wide `<name>_q` summary family
+    (no source label): quantiles of the bin-wise MERGED sketch, the true
+    fleet p50/p95/p99 that per-source quantiles cannot be averaged into.
+    Requires mergeable (sketch_payload) histogram entries; stats()-shaped
+    entries still export their per-source bucket family."""
+    # family order: union of names, counters then gauges then histograms,
+    # one TYPE line per family with every source's sample under it
+    names: Dict[str, List[str]] = {"counters": [], "gauges": [],
+                                   "histograms": []}
+    for kind in names:
+        seen_names = set()
+        for snap in per_source.values():
+            seen_names.update((snap.get(kind) or {}).keys())
+        names[kind] = sorted(seen_names)
+    # sanitization collisions within the union get the _dup suffix once,
+    # consistently across sources (same raw name -> same family)
+    seen: Dict[str, int] = {}
+    fam: Dict[Tuple[str, str], str] = {}
+    for kind in ("counters", "gauges", "histograms"):
+        for name in names[kind]:
+            fam[(kind, name)] = _prom_uniq(_prom_name(name), seen)
+    lines: List[str] = []
+    sources = sorted(per_source)
+    for name in names["counters"]:
+        pn = fam[("counters", name)]
+        lines.append(f"# TYPE {pn} counter")
+        for src in sources:
+            vals = per_source[src].get("counters") or {}
+            if name in vals:
+                lines.append(f'{pn}{{source="{_prom_escape(src)}"}} '
+                             f"{vals[name]}")
+    for name in names["gauges"]:
+        pn = fam[("gauges", name)]
+        lines.append(f"# TYPE {pn} gauge")
+        for src in sources:
+            vals = per_source[src].get("gauges") or {}
+            if name in vals:
+                lines.append(f'{pn}{{source="{_prom_escape(src)}"}} '
+                             f"{vals[name]}")
+    merged_q: List[Tuple[str, Histogram]] = []
+    for name in names["histograms"]:
+        pn = fam[("histograms", name)]
+        lines.append(f"# TYPE {pn} histogram")
+        merged: Optional[Histogram] = None
+        for src in sources:
+            entry = (per_source[src].get("histograms") or {}).get(name)
+            if entry is None:
+                continue
+            if isinstance(entry, Histogram):
+                entry = entry.sketch_payload()
+            lab = f'source="{_prom_escape(src)}"'
+            if "bins" in entry:       # mergeable form
+                buckets = dict(zip(entry.get("buckets") or (),
+                                   entry.get("bucket_counts") or ()))
+                count, total = entry.get("count", 0), entry.get("sum", 0.0)
+                if merged is None:
+                    merged = Histogram.from_payload(name, entry)
+                else:
+                    merged.merge(entry)
+            else:                     # stats() form: no merged quantiles
+                buckets = entry.get("buckets") or {}
+                count, total = entry.get("count", 0), entry.get("sum", 0.0)
+            for ub, c in buckets.items():
+                lines.append(f'{pn}_bucket{{le="{ub}",{lab}}} {c}')
+            lines.append(f'{pn}_bucket{{le="+Inf",{lab}}} {count}')
+            lines.append(f"{pn}_sum{{{lab}}} {total}")
+            lines.append(f"{pn}_count{{{lab}}} {count}")
+        if merged is not None:
+            merged_q.append((pn, merged))
+    for pn, merged in merged_q:
+        qn = _prom_uniq(pn + "_q", seen)
+        lines.append(f"# TYPE {qn} summary")
+        for q in (0.5, 0.95, 0.99):
+            lines.append(f'{qn}{{quantile="{q}"}} {merged.quantile(q)}')
+        lines.append(f"{qn}_sum {merged.sum}")
+        lines.append(f"{qn}_count {merged.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 # ---- CLI: the CI-artifact inspection tool ----------------------------------
 # `python -m paddle_tpu.monitor show|diff|trace ...` — pretty-print a
 # snapshot JSON (or flight-recorder dump), diff two snapshots (what did
@@ -623,6 +838,10 @@ def _render_flight_dump(doc: Dict[str, Any]) -> str:
              f"rank {doc.get('rank')}  pid {doc.get('pid')}",
              "-" * 78,
              f"in-flight phase: {doc.get('inflight_phase')!r}"]
+    # schema /4 correlated-incident identity (absent in /1–/3 dumps)
+    if doc.get("incident_id") or doc.get("source"):
+        lines.insert(3, f"incident: {doc.get('incident_id') or '-'}  "
+                        f"source: {doc.get('source') or '-'}")
     steps = doc.get("steps", [])
     open_step = doc.get("open_step")
     lines.append(f"step records: {len(steps)}"
@@ -908,8 +1127,9 @@ def _main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     p_show = sub.add_parser(
         "show", help="pretty-print a monitor snapshot JSON or a "
-                     "flight-recorder dump")
-    p_show.add_argument("path")
+                     "flight-recorder dump; multiple paths render a "
+                     "correlated-incident group (sorted by source)")
+    p_show.add_argument("path", nargs="+")
     p_diff = sub.add_parser(
         "diff", help="diff two monitor snapshot JSONs (b - a)")
     p_diff.add_argument("a")
@@ -952,6 +1172,16 @@ def _main(argv=None) -> int:
                          help="override the size cap for --gc")
     p_cache.add_argument("--verify", action="store_true",
                          help="CRC-check every entry and prune corrupt ones")
+    p_top = sub.add_parser(
+        "top", help="live fleet table from a TelemetryCollector: per-source "
+                    "qps / queue / p99 / burn / HBM / role, stragglers "
+                    "highlighted (obs/telemetry.py)")
+    p_top.add_argument("addr", help="collector HOST:PORT (the address it "
+                                    "published in the TCPStore)")
+    p_top.add_argument("-n", "--iterations", type=int, default=1,
+                       help="refresh N times (default 1: one-shot)")
+    p_top.add_argument("--interval", type=float, default=1.0,
+                       help="seconds between refreshes")
     p_ps = sub.add_parser(
         "ps", help="render a parameter-server durability directory "
                    "(distributed/ps/wal.py): snapshot generations, WAL "
@@ -959,6 +1189,15 @@ def _main(argv=None) -> int:
                    "watermark")
     p_ps.add_argument("dir", help="a PsServer wal_dir (FLAGS_ps_wal_dir)")
     args = p.parse_args(argv)
+    if args.cmd == "top":
+        from .obs import telemetry as _telemetry
+        host, _, port = args.addr.rpartition(":")
+        for i in range(max(1, args.iterations)):
+            if i:
+                time.sleep(args.interval)
+            doc = _telemetry.query_collector(host or "127.0.0.1", int(port))
+            print(_telemetry.render_top(doc))
+        return 0
     if args.cmd == "ps":
         return _ps_main(args)
     if args.cmd == "cache":
@@ -968,11 +1207,21 @@ def _main(argv=None) -> int:
     if args.cmd == "slo":
         return _slo_main(args)
     if args.cmd == "show":
-        doc = _load_artifact(args.path)
-        if _is_flight_dump(doc):
-            print(_render_flight_dump(doc))
-        else:
-            print(render_snapshot(doc, title_right=f"({args.path})"))
+        docs = [(pth, _load_artifact(pth)) for pth in args.path]
+        if len(docs) > 1:
+            # incident-group rendering: sort by source so the same fleet
+            # reads the same top-to-bottom every time
+            docs.sort(key=lambda pd: str(pd[1].get("source") or pd[0]))
+            ids = {d.get("incident_id") for _, d in docs
+                   if d.get("incident_id")}
+            if len(ids) == 1:
+                print(f"correlated incident {ids.pop()} "
+                      f"({len(docs)} dumps):")
+        for pth, doc in docs:
+            if _is_flight_dump(doc):
+                print(_render_flight_dump(doc))
+            else:
+                print(render_snapshot(doc, title_right=f"({pth})"))
         return 0
     if args.cmd == "diff":
         print(_diff_snapshots(_load_artifact(args.a),

@@ -41,6 +41,7 @@ from .merge import (gather_timelines, merge_timelines,  # noqa: F401
 from .recorder import (DUMP_SCHEMA, FlightRecorder,  # noqa: F401
                        dump_to_chrome_events)
 from .timeline import NULL_CTX, PHASES, StepTimeline  # noqa: F401
+from . import telemetry  # noqa: F401  (push-based fleet telemetry plane)
 
 __all__ = [
     "StepTimeline", "FlightRecorder", "PHASES", "DUMP_SCHEMA",
@@ -52,7 +53,7 @@ __all__ = [
     "straggler_report", "slim_records", "executable_cost",
     "attributed_mfu", "roofline_gap", "dump_to_chrome_events",
     "memory", "census", "top_buffers", "executable_memory",
-    "maybe_dump_oom", "trace", "slo",
+    "maybe_dump_oom", "trace", "slo", "telemetry",
 ]
 
 # ---- gates + singletons ----------------------------------------------------
@@ -196,10 +197,13 @@ def record_collective(name: str, nbytes: int) -> None:
 
 
 def dump(path: Optional[str] = None, reason: str = "manual",
-         extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+         extra: Optional[Dict[str, Any]] = None,
+         incident_id: Optional[str] = None,
+         source: Optional[str] = None) -> Optional[str]:
     """Dump the flight recorder (even if the flag is off — an explicit call
     is an explicit request; the rings are just emptier)."""
-    return recorder().dump(path=path, reason=reason, extra=extra)
+    return recorder().dump(path=path, reason=reason, extra=extra,
+                           incident_id=incident_id, source=source)
 
 
 # ---- automatic dump triggers ------------------------------------------------

@@ -16,7 +16,24 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 __all__ = ["gather_timelines", "merge_timelines", "straggler_report",
-           "slim_records"]
+           "slim_records", "skew_over_median"]
+
+
+def skew_over_median(values: Dict[Any, float]):
+    """The straggler rule shared by the pod-timeline merge and the fleet
+    `monitor top` table: the member with the largest value, the group
+    median, and worst/median skew (inf when the median is 0 but the worst
+    is not — one member doing ALL the waiting). Returns
+    (worst_key, worst_value, median, skew); (None, 0, 0, 0) when empty."""
+    if not values:
+        return None, 0.0, 0.0, 0.0
+    worst = max(values, key=lambda k: values[k])
+    vals = sorted(values.values())
+    median = vals[len(vals) // 2] if len(vals) % 2 else \
+        0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
+    skew = (values[worst] / median) if median > 0 else \
+        (0.0 if values[worst] == 0 else float("inf"))
+    return worst, values[worst], median, skew
 
 
 def slim_records(records) -> List[Dict[str, Any]]:
@@ -74,16 +91,12 @@ def merge_timelines(per_rank: Dict[int, List[Dict]]) -> Dict[str, Any]:
     for name in phase_names:
         means = {r: ranks[r]["phases"].get(name, {}).get("mean", 0.0)
                  for r in ranks}
-        worst = max(means, key=lambda r: means[r])
-        vals = sorted(means.values())
-        median = vals[len(vals) // 2] if len(vals) % 2 else \
-            0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
+        worst, worst_mean, median, skew = skew_over_median(means)
         stragglers[name] = {
             "rank": worst,
-            "mean": means[worst],
+            "mean": worst_mean,
             "group_median": median,
-            "skew": (means[worst] / median) if median > 0 else
-                    (0.0 if means[worst] == 0 else float("inf")),
+            "skew": skew,
         }
     wall_means = {r: ranks[r]["wall_mean"] for r in ranks}
     slowest = max(wall_means, key=lambda r: wall_means[r]) if wall_means \

@@ -35,9 +35,12 @@ __all__ = ["FlightRecorder", "DUMP_SCHEMA", "dump_to_chrome_events"]
 
 # /2 added the "memory" section: the mem-census ring + per-phase HBM peaks
 # (obs/memory.py). /3 adds "traces" (the tail-sampled request-trace rings,
-# obs/trace.py) and "slo" (error-budget burn, obs/slo.py). `monitor show`
+# obs/trace.py) and "slo" (error-budget burn, obs/slo.py). /4 adds the
+# OPTIONAL correlated-incident identity: "incident_id" (shared by every
+# fleet member's dump of one incident, obs/telemetry.py fan-out) and
+# "source" (the dumping process's telemetry source name). `monitor show`
 # renders every version — an older dump is simply one without the section.
-DUMP_SCHEMA = "paddle_tpu.flight_recorder/3"
+DUMP_SCHEMA = "paddle_tpu.flight_recorder/4"
 
 _COLLECTIVE_RING = 256
 _EVENT_RING = 128
@@ -101,9 +104,14 @@ class FlightRecorder:
             return False
 
     def dump(self, path: Optional[str] = None, reason: str = "manual",
-             extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+             extra: Optional[Dict[str, Any]] = None,
+             incident_id: Optional[str] = None,
+             source: Optional[str] = None) -> Optional[str]:
         """Write the black box as one JSON artifact. Returns the path, or
-        None when an automatic (path-less) dump was rate-limited."""
+        None when an automatic (path-less) dump was rate-limited.
+        `incident_id`/`source` stamp a correlated fleet incident (/4):
+        the telemetry fan-out passes an explicit per-incident path, so a
+        whole-fleet dump is never suppressed by the per-reason limiter."""
         auto = path is None
         if auto and self._rate_limited(reason):
             return None
@@ -113,7 +121,8 @@ class FlightRecorder:
             path = os.path.join(
                 d, f"flightrec_{int(time.time() * 1000)}_{reason}"
                    f"_p{os.getpid()}.json")
-        payload = self.payload(reason=reason, extra=extra)
+        payload = self.payload(reason=reason, extra=extra,
+                               incident_id=incident_id, source=source)
         os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
                     exist_ok=True)
         tmp = path + ".tmp"
@@ -126,10 +135,16 @@ class FlightRecorder:
         if _monitor._ENABLED:
             _monitor.count("obs.dumps")
             _monitor.log_event("obs.dump", reason=reason, path=path)
+        from . import telemetry as _telemetry
+        if _telemetry._DEFAULT is not None:
+            _telemetry.emit("dump", reason=reason, path=path,
+                            incident_id=incident_id, source=source)
         return path
 
     def payload(self, reason: str = "manual",
-                extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+                extra: Optional[Dict[str, Any]] = None,
+                incident_id: Optional[str] = None,
+                source: Optional[str] = None) -> Dict[str, Any]:
         from .. import monitor as _monitor
         tl = self.timeline
         with self._lock:
@@ -153,6 +168,10 @@ class FlightRecorder:
                         "gauges": snap["gauges"],
                         "events": snap["events"][-32:]},
         }
+        if incident_id is not None:
+            out["incident_id"] = incident_id
+        if source is not None:
+            out["source"] = source
         from . import memory as _memory
         out["memory"] = {"census": _memory.census_ring(),
                          "phase_peaks": _memory.phase_peaks()}

@@ -252,6 +252,7 @@ class ReplicaAgent:
             is not None else int(budget_mb * (1 << 20))
         self.tenants: Dict[str, ModelTenant] = {}
         self._elastic: Optional[ElasticManager] = None
+        self._exporter = None   # TelemetryExporter under FLAGS_telemetry
         self._closed = False
         srv = _server_mod()
         self.server = srv.PredictorServer(
@@ -293,6 +294,12 @@ class ReplicaAgent:
             lease_ttl=float(_flags.flag("fleet_lease_ttl_s")),
             heartbeat_interval=float(_flags.flag("fleet_heartbeat_s")))
         self._elastic.register()
+        if _flags.flag("telemetry"):
+            from ..obs import telemetry as _telemetry
+            self._exporter = _telemetry.TelemetryExporter(
+                self.store, source=f"replica-{self.replica_id}",
+                role="replica", fleet=self.fleet,
+                meta={"replica_id": self.replica_id}).start()
         _obs.record_event("fleet.replica_register",
                           replica=self.replica_id, port=self.server.port)
         return self
@@ -315,6 +322,10 @@ class ReplicaAgent:
         if _faults._ENABLED:
             _faults.check("replica.drain")
         self._deregister()
+        if self._exporter is not None:
+            # push-fed fast path: the router learns of the drain from the
+            # collector relay, not the next poll sweep
+            self._exporter.event("drain", replica_id=self.replica_id)
         _obs.record_event("fleet.replica_drain", replica=self.replica_id)
 
     def drain(self) -> dict:
@@ -331,6 +342,9 @@ class ReplicaAgent:
         else:
             self._deregister()
             self.server.stop(drain=False)
+        if self._exporter is not None:
+            self._exporter.stop()   # final flush ships the drain event
+            self._exporter = None
         for t in self.tenants.values():
             t.engine.stop(drain=drain)
 
@@ -583,6 +597,42 @@ class FleetRouter:
                 _monitor.count("fleet.replicas_lost")
             _obs.record_event("fleet.replica_dead", replica=rank,
                               via="lease")
+            from ..obs import telemetry as _telemetry
+            _telemetry.emit("lease_expiry", replica_id=rank)
+
+    # -- telemetry fast path --
+    def attach_telemetry(self, collector) -> "FleetRouter":
+        """Subscribe to a TelemetryCollector's event relay: a pushed
+        death/drain marks the replica dead the moment the collector's
+        connection reader sees EOF (<1s after a SIGKILL), instead of
+        waiting out the lease TTL or the next 'PDHQ' poll sweep — both
+        of which keep running as fallback."""
+        collector.subscribe(self._on_telemetry_event)
+        return self
+
+    def _on_telemetry_event(self, ev: Dict[str, Any]) -> None:
+        kind = ev.get("kind")
+        if kind not in ("death", "drain"):
+            return
+        detail = ev.get("detail") or {}
+        rid = detail.get("replica_id")
+        if rid is None:
+            return
+        with self._lock:
+            h = self.replicas.get(int(rid))
+        if h is None:
+            return
+        if kind == "drain":
+            if not h.draining:
+                h.draining = True
+                h.close_pool()
+            return
+        if h.healthy:
+            h.mark_dead()
+            if _monitor._ENABLED:
+                _monitor.count("fleet.replicas_lost")
+            _obs.record_event("fleet.replica_dead", replica=int(rid),
+                              via="telemetry")
 
     def refresh(self) -> None:
         """One membership + health sweep (the fleet-health thread calls
@@ -866,6 +916,9 @@ class FleetRouter:
                 _monitor.count("fleet.rollbacks")
             _obs.record_event("fleet.rollout_rollback", model=model,
                               version=version, burn=burn)
+            from ..obs import telemetry as _telemetry
+            _telemetry.emit("rollout", model=model, version=version,
+                            burn=burn, promoted=False)
             return RolloutResult(model, version, canary_h.replica_id,
                                  promoted=False, rolled_back=True,
                                  canary_burn=burn, probed=probed)
@@ -880,6 +933,9 @@ class FleetRouter:
             _monitor.count("fleet.promotions")
         _obs.record_event("fleet.rollout_promote", model=model,
                           version=version, burn=burn)
+        from ..obs import telemetry as _telemetry
+        _telemetry.emit("rollout", model=model, version=version,
+                        burn=burn, promoted=True)
         return RolloutResult(model, version, canary_h.replica_id,
                              promoted=True, rolled_back=False,
                              canary_burn=burn, probed=probed)

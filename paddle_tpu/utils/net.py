@@ -65,6 +65,43 @@ STREAM_REQ_MAGIC = 0x50445351  # 'PDSQ'
 #   non-incremental caller can ignore 'PDST' frames it already read),
 #   or STATUS_ERROR/OVERLOADED/DEADLINE + message.
 STREAM_MAGIC = 0x50445354  # 'PDST'
+# Fleet-telemetry frames (obs/telemetry.py). Unlike the serving frames
+# above these carry a CRC: telemetry crosses process boundaries under
+# churn (exporters reconnect mid-write after a collector SIGKILL), and a
+# half-written frame must be detected and dropped, never half-parsed.
+#
+# 'PDTM' — telemetry push (exporter -> collector): CRC frame whose JSON
+#   body is {"op": hello|metrics|events|query, ...}.
+PDTM_MAGIC = 0x5044544D  # 'PDTM'
+# 'PDTA' — telemetry ack (collector -> exporter): CRC frame whose JSON
+#   body is {"ok": bool, "commands": [...]} — the ack doubles as the
+#   collector's command channel (correlated incident dump fan-out).
+PDTA_MAGIC = 0x50445441  # 'PDTA'
+
+
+def send_crc_frame(sock, magic: int, payload: bytes) -> None:
+    """Send `magic + crc32(payload) + len + payload` (all u32 LE)."""
+    import zlib
+    sock.sendall(struct.pack("<III", magic, zlib.crc32(payload),
+                             len(payload)) + payload)
+
+
+def recv_crc_frame(sock, expect_magic: int,
+                   deadline: float | None = None) -> bytes:
+    """Read one CRC frame; verify magic and checksum. Raises ValueError
+    on either mismatch (caller drops the connection — a telemetry stream
+    is resynchronized by reconnecting, not by scanning for a magic)."""
+    import zlib
+    magic, crc, n = struct.unpack("<III", recv_exact(sock, 12, deadline))
+    if magic != expect_magic:
+        raise ValueError(f"crc frame: magic 0x{magic:08X} != "
+                         f"expected 0x{expect_magic:08X}")
+    if n > (64 << 20):
+        raise ValueError(f"crc frame: implausible length {n}")
+    payload = recv_exact(sock, n, deadline)
+    if zlib.crc32(payload) != crc:
+        raise ValueError("crc frame: checksum mismatch")
+    return payload
 
 
 def send_trace_frame(sock, ctx) -> None:

@@ -191,6 +191,46 @@ def _no_fleet_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_telemetry_leak():
+    """A leaked exporter keeps pushing this process's metrics (and holds
+    the module-default slot) under every later test; a leaked collector
+    keeps its accept/conn/reap threads and the rendezvous record alive.
+    Assert the telemetry plane is quiescent after EVERY test, reaping
+    leftovers so one offender cannot cascade."""
+    import threading
+    import time
+    from paddle_tpu.obs import telemetry as _telemetry
+
+    def telemetry_threads():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("telemetry-")]
+
+    before = len(telemetry_threads())
+    yield
+    leaked = [obj for obj in list(_telemetry._LIVE)
+              if getattr(obj, "_thread", None) is not None
+              or getattr(obj, "_listener", None) is not None]
+    for obj in leaked:
+        try:
+            obj.stop()
+        except Exception:
+            pass
+    if _telemetry._DEFAULT is not None:
+        _telemetry._DEFAULT = None
+    for _ in range(20):  # reaped threads need a beat to exit
+        after = telemetry_threads()
+        if len(after) <= before:
+            break
+        time.sleep(0.1)
+    assert not leaked, (
+        f"{len(leaked)} telemetry object(s) leaked out of the test "
+        f"(exporter.stop()/collector.stop() never reached): "
+        f"{[type(o).__name__ for o in leaked]}")
+    assert len(after := telemetry_threads()) <= before, (
+        f"telemetry thread(s) leaked out of the test: {after}")
+
+
+@pytest.fixture(autouse=True)
 def _no_ps_leak():
     """A PS server, HA node, or WAL writer leaking out of a test keeps
     accept/replication/communicator threads (and an open WAL segment)

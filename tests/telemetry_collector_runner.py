@@ -1,0 +1,37 @@
+"""Telemetry collector runner (executed by test_telemetry.py's chaos
+drill B).
+
+Runs ONE TelemetryCollector in a real child process: connects to the
+parent's TCPStore, publishes the rendezvous record, ingests pushes until
+killed (SIGKILL is the point of the drill) or until the parent writes a
+line on stdin for a graceful exit. Publishes `host port` through the
+port file once listening.
+
+argv: [store_host, store_port, fleet_name, port_file]
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+store_host = sys.argv[1]
+store_port = int(sys.argv[2])
+fleet_name = sys.argv[3]
+port_file = sys.argv[4]
+
+from paddle_tpu._native import TCPStore  # noqa: E402
+from paddle_tpu.obs import telemetry  # noqa: E402
+
+store = TCPStore(store_host, store_port, is_master=False)
+collector = telemetry.TelemetryCollector(store, fleet=fleet_name).start()
+
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    f.write(f"{collector.host} {collector.port}")
+os.rename(tmp, port_file)  # atomic: the parent never reads a half-write
+
+sys.stdin.readline()       # parent says "exit gracefully" (or SIGKILLs us)
+collector.stop()

@@ -292,7 +292,7 @@ class TestOOMForensics:
         assert "[flight recorder:" in str(errs[0])
         assert getattr(errs[1], "dump_path", None) is None  # rate-limited
         doc = _latest_dump(errs[0])
-        assert doc["schema"] == "paddle_tpu.flight_recorder/3"
+        assert doc["schema"] == "paddle_tpu.flight_recorder/4"
         assert doc["reason"] == "oom"
         mem = doc["extra"]["memory"]
         top = mem["top_buffers"]
@@ -367,15 +367,43 @@ class TestOOMForensics:
             hoard.clear()
 
 
-# ---- dump schema v3 + v1/v2 back-compat -------------------------------------
+# ---- dump schema v4 + v1/v2/v3 back-compat ----------------------------------
 
 class TestDumpSchema:
-    def test_v3_dump_always_carries_memory_section(self, with_mem, tmp_path):
+    def test_v4_dump_always_carries_memory_section(self, with_mem, tmp_path):
         path = obs.dump(str(tmp_path / "manual.json"), reason="manual")
         doc = json.load(open(path))
-        assert doc["schema"] == "paddle_tpu.flight_recorder/3"
+        assert doc["schema"] == "paddle_tpu.flight_recorder/4"
         assert "census" in doc["memory"] and "phase_peaks" in doc["memory"]
         assert "traces" in doc and "slo" in doc   # v3 sections always present
+        # /4 incident fields are OPTIONAL: absent on a plain local dump
+        assert "incident_id" not in doc and "source" not in doc
+
+    def test_v4_incident_fields_round_trip(self, with_mem, tmp_path):
+        from paddle_tpu.monitor import _render_flight_dump
+        path = obs.dump(str(tmp_path / "inc.json"), reason="desync",
+                        incident_id="inc-deadbeef", source="replica-3")
+        doc = json.load(open(path))
+        assert doc["incident_id"] == "inc-deadbeef"
+        assert doc["source"] == "replica-3"
+        text = _render_flight_dump(doc)
+        assert "inc-deadbeef" in text and "replica-3" in text
+
+    def test_v3_fixture_still_renders(self, capsys):
+        """Back-compat gate: a checked-in /3 artifact (traces + slo, no
+        incident fields) must render through `show`, `mem`, and `slo` —
+        generated by the pre-/4 code before the schema bump."""
+        from paddle_tpu.monitor import _main, _is_flight_dump
+        path = os.path.join(FIXTURES, "flightrec_v3.json")
+        doc = json.load(open(path))
+        assert doc["schema"] == "paddle_tpu.flight_recorder/3"
+        assert _is_flight_dump(doc)
+        assert _main(["show", path]) == 0
+        assert _main(["mem", path]) == 0
+        assert _main(["slo", path]) == 0
+        out = capsys.readouterr().out
+        assert "flight recorder dump" in out
+        assert "incident:" not in out   # the /4 line stays absent on /3
 
     def test_v1_fixture_still_renders(self):
         """Back-compat gate: a checked-in /1 artifact (no memory section)

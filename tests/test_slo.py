@@ -170,6 +170,74 @@ class TestPrometheusConformance:
         assert "paddle_tpu_a_b" in fams
         assert "paddle_tpu_a_b_dup1" in fams
 
+    def test_multi_source_scrape_one_family_source_labeled(self,
+                                                           monitored):
+        """The fleet scrape (monitor.prometheus_text_multi): N sources'
+        samples land in ONE family under `source=` labels — never N
+        name-mangled `_dup` families — and the merged-sketch `_q` summary
+        carries the TRUE fleet quantiles. Same parse-back audit as the
+        single-process test above."""
+        rng = np.random.RandomState(1)
+        per_source, pooled = {}, []
+        for i, src in enumerate(["replica-0", "replica-1", "ps-0"]):
+            h = monitor.Histogram("req.dur")
+            xs = rng.lognormal(-5.0 + i, 0.5, 400)
+            pooled.append(xs)
+            for v in xs:
+                h.observe(float(v))
+            per_source[src] = {
+                "counters": {"req.total": 100 * (i + 1), "a.b": 1,
+                             "a-b": 2},
+                "gauges": {"queue.depth": float(i)},
+                "histograms": {"req.dur": h.sketch_payload()}}
+        fams = _parse_prometheus(monitor.prometheus_text_multi(per_source))
+
+        c = fams["paddle_tpu_req_total"]
+        assert c["type"] == "counter"
+        assert {lb["source"] for _, lb, _ in c["samples"]} == \
+            {"replica-0", "replica-1", "ps-0"}
+        assert sum(v for _, _, v in c["samples"]) == 600
+        assert c["samples"] == sorted(c["samples"],
+                                      key=lambda s: s[1]["source"])
+        # sanitization collisions WITHIN the union still get _dup — the
+        # suffix is assigned once, so each family has all 3 sources
+        assert len(fams["paddle_tpu_a_b"]["samples"]) == 3
+        assert len(fams["paddle_tpu_a_b_dup1"]["samples"]) == 3
+
+        # per-source histogram families stay conforming: cumulative
+        # monotone buckets with le="+Inf" == that source's _count
+        h = fams["paddle_tpu_req_dur"]
+        assert h["type"] == "histogram"
+        for src in per_source:
+            buckets = [v for n, lb, v in h["samples"]
+                       if n == "paddle_tpu_req_dur_bucket"
+                       and lb["source"] == src]
+            assert buckets == sorted(buckets)
+            assert buckets[-1] == 400
+            assert [v for n, lb, v in h["samples"]
+                    if n == "paddle_tpu_req_dur_count"
+                    and lb.get("source") == src] == [400]
+
+        # the merged `_q` summary is fleet-wide: NO source label, and its
+        # p99 matches the pooled-raw-sample oracle within the sketch bound
+        s = fams["paddle_tpu_req_dur_q"]
+        assert s["type"] == "summary"
+        assert all("source" not in lb for _, lb, _ in s["samples"])
+        qs = {lb["quantile"]: v for n, lb, v in s["samples"]
+              if "quantile" in lb}
+        true = float(np.quantile(np.concatenate(pooled), 0.99))
+        assert abs(qs["0.99"] - true) / true <= 0.011
+        assert [v for n, _, v in s["samples"]
+                if n == "paddle_tpu_req_dur_q_count"] == [1200]
+
+    def test_multi_source_label_values_escaped(self, monitored):
+        txt = monitor.prometheus_text_multi(
+            {'we"ird\\host': {"counters": {"x": 1}}})
+        assert '\\"' in txt and "\\\\" in txt
+        fams = _parse_prometheus(txt)   # the escape keeps it parseable
+        assert fams["paddle_tpu_x"]["samples"][0][1]["source"] == \
+            'we\\"ird\\\\host'
+
     def test_slo_gauges_exported(self, slo_plane):
         slo.record_request(0.010)
         slo.record_request(0.200)        # over the 50ms objective

@@ -1,0 +1,765 @@
+"""Fleet telemetry plane (obs/telemetry.py): mergeable DDSketch
+histograms vs the pooled-raw-sample oracle, the exporter/collector wire
+plane (delta counters, immediate events, CRC framing, fault site), the
+fleet-wide scrape + `monitor top` table, alert rules, correlated
+incident fan-out — and the chaos drills: SIGKILL a replica (push beats
+polling <1s, exactly-once per ledger audit) and SIGKILL the collector
+mid-burst (buffer-and-drop, zero serving errors, resume on restart)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import faults, monitor, obs
+from paddle_tpu._native import TCPStore
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.guard.errors import RankDesyncError
+from paddle_tpu.obs import telemetry
+from paddle_tpu.serving import EngineConfig, FleetRouter, ReplicaAgent
+from paddle_tpu.utils import net as _net
+
+CFG = dict(max_batch_size=8, batch_timeout_ms=1.0, warmup_on_start=False)
+
+FAST_TELEMETRY = {"telemetry": True, "telemetry_interval_s": 0.05}
+
+
+@pytest.fixture()
+def telemetry_flags():
+    before = {k: _flags.flag(k) for k in FAST_TELEMETRY}
+    _flags.set_flags(FAST_TELEMETRY)
+    yield
+    _flags.set_flags(before)
+
+
+@pytest.fixture()
+def monitored():
+    monitor.reset()
+    _flags.set_flags({"monitor": True})
+    yield monitor
+    _flags.set_flags({"monitor": False})
+    monitor.reset()
+
+
+def _store():
+    return TCPStore("127.0.0.1", 0, is_master=True)
+
+
+def _wait(pred, timeout=5.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: mergeable sketches vs the pooled-raw oracle
+# ---------------------------------------------------------------------------
+
+class TestHistogramMerge:
+    @pytest.mark.parametrize("dist", ["lognormal", "exponential", "mixed"])
+    def test_merged_quantiles_match_pooled_oracle(self, dist):
+        """3+ sources, p50/p95/p99 of the bin-wise merge within the
+        sketch's <=1% relative error of numpy on the POOLED samples —
+        the bound a mean-of-p99s aggregation cannot meet."""
+        rng = np.random.default_rng(7)
+        if dist == "lognormal":
+            streams = [rng.lognormal(m, s, 4000)
+                       for m, s in ((0.0, 1.0), (0.5, 0.7), (1.0, 0.4))]
+        elif dist == "exponential":
+            streams = [rng.exponential(sc, 4000) for sc in (0.5, 2.0, 8.0)]
+        else:   # a straggler replica: one stream 10x slower
+            streams = [rng.lognormal(0.0, 0.5, 4000),
+                       rng.lognormal(0.0, 0.5, 4000),
+                       rng.lognormal(np.log(10.0), 0.5, 4000),
+                       rng.exponential(1.0, 4000)]
+        hists = []
+        for i, xs in enumerate(streams):
+            h = monitor.Histogram(f"lat{i}")
+            for x in xs:
+                h.observe(float(x))
+            hists.append(h)
+        merged = monitor.Histogram("fleet")
+        merged.merge(hists[0])                       # Histogram form
+        for h in hists[1:]:
+            merged.merge(h.sketch_payload())         # wire payload form
+        pooled = np.concatenate(streams)
+        assert merged.count == len(pooled)
+        assert merged.sum == pytest.approx(float(pooled.sum()))
+        for q in (0.5, 0.95, 0.99):
+            true = float(np.quantile(pooled, q))
+            est = merged.quantile(q)
+            assert abs(est - true) / true <= 0.011, (
+                f"{dist} q={q}: est {est} vs oracle {true}")
+
+    def test_mean_of_p99s_is_not_the_fleet_p99(self):
+        """The motivating counterexample: averaging per-source p99s is
+        wrong by construction; the merge is not."""
+        rng = np.random.default_rng(1)
+        fast = rng.lognormal(0.0, 0.2, 5000)
+        slow = rng.lognormal(np.log(50.0), 0.2, 500)   # 10% of traffic
+        h_fast, h_slow = monitor.Histogram("f"), monitor.Histogram("s")
+        for x in fast:
+            h_fast.observe(float(x))
+        for x in slow:
+            h_slow.observe(float(x))
+        pooled_p99 = float(np.quantile(np.concatenate([fast, slow]), 0.99))
+        averaged = 0.5 * (h_fast.quantile(0.99) + h_slow.quantile(0.99))
+        merged = monitor.Histogram("m").merge(h_fast).merge(h_slow)
+        assert abs(merged.quantile(0.99) - pooled_p99) / pooled_p99 <= 0.011
+        assert abs(averaged - pooled_p99) / pooled_p99 > 0.3
+
+    def test_merge_preserves_min_max_and_explicit_buckets(self):
+        a, b = monitor.Histogram("a"), monitor.Histogram("b")
+        for x in (0.002, 0.04):
+            a.observe(x)
+        for x in (0.5, 7.0):
+            b.observe(x)
+        a.merge(b)
+        assert a.count == 4
+        assert a.min == pytest.approx(0.002)
+        assert a.max == pytest.approx(7.0)
+        st = a.stats()
+        assert sum(st["buckets"].values()) >= 3  # finite-bucket tallies add
+
+    def test_merge_snapshots_sums_counters_gauges_and_merges_hists(self):
+        rng = np.random.default_rng(3)
+        snaps = []
+        pooled = []
+        for i in range(3):
+            h = monitor.Histogram("serving.e2e_latency")
+            xs = rng.exponential(1.0 + i, 1000)
+            pooled.append(xs)
+            for x in xs:
+                h.observe(float(x))
+            snaps.append({"counters": {"reqs": 10 * (i + 1)},
+                          "gauges": {"queue": i},
+                          "histograms": {"serving.e2e_latency":
+                                         h.sketch_payload()}})
+        fleet = monitor.merge_snapshots(snaps)
+        assert fleet["counters"]["reqs"] == 60
+        assert fleet["gauges"]["queue"] == 3      # fleet depth = sum
+        m = fleet["histograms"]["serving.e2e_latency"]
+        true = float(np.quantile(np.concatenate(pooled), 0.99))
+        assert abs(m.quantile(0.99) - true) / true <= 0.011
+        # garbage and stats()-shaped entries are skipped, not fatal
+        fleet2 = monitor.merge_snapshots(
+            snaps + [None, {"histograms": {"serving.e2e_latency":
+                                           {"count": 5, "p99": 1.0}}}])
+        assert fleet2["histograms"]["serving.e2e_latency"].count == m.count
+
+
+# ---------------------------------------------------------------------------
+# CRC framing
+# ---------------------------------------------------------------------------
+
+class TestCrcFraming:
+    def test_roundtrip_and_corruption_detection(self):
+        import socket as _socket
+        a, b = _socket.socketpair()
+        try:
+            _net.send_crc_frame(a, _net.PDTM_MAGIC, b'{"op":"hello"}')
+            body = _net.recv_crc_frame(b, _net.PDTM_MAGIC)
+            assert json.loads(body) == {"op": "hello"}
+            # wrong magic is rejected before the body is read
+            _net.send_crc_frame(a, _net.PDTA_MAGIC, b"{}")
+            with pytest.raises(ValueError, match="magic"):
+                _net.recv_crc_frame(b, _net.PDTM_MAGIC)
+        finally:
+            a.close()
+            b.close()
+
+    def test_crc_mismatch_raises(self):
+        import socket as _socket
+        import struct
+        a, b = _socket.socketpair()
+        try:
+            payload = b'{"op":"metrics"}'
+            a.sendall(struct.pack("<III", _net.PDTM_MAGIC, 12345,
+                                  len(payload)) + payload)
+            with pytest.raises(ValueError, match="checksum"):
+                _net.recv_crc_frame(b, _net.PDTM_MAGIC)
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# exporter <-> collector wire plane (in-process)
+# ---------------------------------------------------------------------------
+
+class TestWirePlane:
+    def test_metrics_flow_delta_compressed_with_reconnect_resync(
+            self, telemetry_flags, monitored):
+        store = _store()
+        col = telemetry.TelemetryCollector(store, fleet="wp").start()
+        exp = telemetry.TelemetryExporter(
+            store, source="replica-0", role="replica", fleet="wp",
+            meta={"replica_id": 0}).start()
+        try:
+            monitor.count("reqs", 5)
+            monitor.observe("serving.e2e_latency", 0.02)
+            assert _wait(lambda: col.sources.get("replica-0", {})
+                         .get("counters", {}).get("reqs") == 5)
+            monitor.count("reqs", 2)   # ships as a DELTA of 2
+            assert _wait(lambda: col.sources["replica-0"]
+                         ["counters"]["reqs"] == 7)
+            # kill the socket: the exporter reconnects and resyncs with a
+            # FULL snapshot, so absolute counts survive the delta reset
+            exp._sock.close()
+            monitor.count("reqs", 1)
+            assert _wait(lambda: col.sources["replica-0"]
+                         ["counters"]["reqs"] == 8)
+            assert exp.reconnects >= 1
+            hist = col.sources["replica-0"]["histograms"][
+                "serving.e2e_latency"]
+            assert hist["count"] == 1 and "bins" in hist
+        finally:
+            exp.stop()
+            col.stop()
+
+    def test_events_push_immediately_not_on_the_metric_tick(
+            self, monitored):
+        _flags.set_flags({"telemetry": True, "telemetry_interval_s": 30.0})
+        store = _store()
+        col = telemetry.TelemetryCollector(store, fleet="ev").start()
+        exp = telemetry.TelemetryExporter(
+            store, source="ps-0", role="ps", fleet="ev").start()
+        try:
+            # force the first connection (the wake also flushes metrics)
+            exp.event("role_change", role="primary")
+            t0 = time.monotonic()
+            assert _wait(lambda: any(e["kind"] == "role_change"
+                                     for e in col.events), timeout=5.0)
+            assert time.monotonic() - t0 < 5.0   # not the 30s tick
+            ev = [e for e in col.events if e["kind"] == "role_change"][0]
+            assert ev["source"] == "ps-0"
+            assert ev["detail"] == {"role": "primary"}
+        finally:
+            exp.stop()
+            col.stop()
+            _flags.set_flags({"telemetry": False,
+                              "telemetry_interval_s": 0.25})
+
+    def test_buffer_drops_oldest_and_counts_when_collector_absent(
+            self, monitored):
+        _flags.set_flags({"telemetry": True, "telemetry_interval_s": 0.05,
+                          "telemetry_buffer": 4})
+        store = _store()   # NO collector published: discovery fails
+        exp = telemetry.TelemetryExporter(
+            store, source="replica-0", fleet="void").start()
+        try:
+            for i in range(10):
+                exp.event("drain", seq=i)
+
+            def newest_kept():
+                with exp._lock:
+                    seqs = [e["detail"]["seq"] for e in exp._events]
+                return seqs == [6, 7, 8, 9]   # oldest dropped
+
+            # the export thread may hold a drained batch mid-retry; settle
+            assert _wait(newest_kept, timeout=5.0)
+            assert exp.dropped >= 6   # 10 fired, 4 kept, each loss counted
+            assert monitor.snapshot()["counters"]["telemetry.dropped"] >= 6
+        finally:
+            exp.stop()
+            _flags.set_flags({"telemetry": False,
+                              "telemetry_interval_s": 0.25,
+                              "telemetry_buffer": 256})
+
+    def test_push_fault_site_buffers_instead_of_raising(
+            self, telemetry_flags, monitored):
+        store = _store()
+        col = telemetry.TelemetryCollector(store, fleet="ft").start()
+        exp = telemetry.TelemetryExporter(
+            store, source="replica-0", fleet="ft").start()
+        try:
+            assert _wait(lambda: "replica-0" in col.sources)
+            with faults.inject("telemetry.push:error"):
+                exp.event("drain", replica_id=0)
+                time.sleep(0.3)   # every push fails at the fault site
+                assert not any(e["kind"] == "drain" for e in col.events)
+            # fault lifted: the buffered event drains on the next tick
+            assert _wait(lambda: any(e["kind"] == "drain"
+                                     for e in col.events))
+        finally:
+            exp.stop()
+            col.stop()
+
+    def test_reaper_declares_wedged_source_dead(self, monitored):
+        _flags.set_flags({"telemetry": True, "telemetry_interval_s": 0.05,
+                          "telemetry_death_after_s": 0.4})
+        store = _store()
+        col = telemetry.TelemetryCollector(store, fleet="rp").start()
+        exp = telemetry.TelemetryExporter(
+            store, source="replica-0", fleet="rp",
+            meta={"replica_id": 0}).start()
+        try:
+            assert _wait(lambda: "replica-0" in col.sources)
+            # wedge: the process stops pushing but its socket stays OPEN
+            # — no EOF fast path, no graceful bye; only the reaper's
+            # silence backstop can declare this death
+            exp.interval_s = 3600.0
+            assert _wait(lambda: any(e["kind"] == "death"
+                                     for e in col.events), timeout=5.0)
+            assert col.sources["replica-0"]["alive"] is False
+        finally:
+            exp.stop()
+            col.stop()
+            _flags.set_flags({"telemetry": False,
+                              "telemetry_interval_s": 0.25,
+                              "telemetry_death_after_s": 1.5})
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide scrape / top table / alert rules
+# ---------------------------------------------------------------------------
+
+def _three_source_collector(store, fleet="scr"):
+    col = telemetry.TelemetryCollector(store, fleet=fleet).start()
+    rng = np.random.default_rng(5)
+    pooled = []
+    for i in range(3):
+        scale = 10.0 if i == 2 else 1.0   # source 2 is the straggler
+        xs = rng.lognormal(np.log(0.01 * scale), 0.3, 2000)
+        pooled.append(xs)
+        h = monitor.Histogram("serving.e2e_latency")
+        for x in xs:
+            h.observe(float(x))
+        snap = {"counters": {"serving.requests": 100 * (i + 1)},
+                "gauges": {"serving.queue_depth": i,
+                           "slo.burn.2s": 0.1, "slo.burn.10s": 0.05,
+                           "mem.live_bytes": (i + 1) * 1e6},
+                "histograms": {"serving.e2e_latency": h.sketch_payload()}}
+        col._on_hello(f"replica-{i}", i + 1,
+                      {"role": "replica", "pid": 1000 + i,
+                       "meta": {"replica_id": i}})
+        col._on_metrics(f"replica-{i}", dict(snap, full=True))
+    return col, np.concatenate(pooled)
+
+
+class TestCollectorReadSide:
+    def test_one_scrape_all_sources_plus_merged_quantiles(self,
+                                                          monitored):
+        store = _store()
+        col, pooled = _three_source_collector(store)
+        try:
+            txt = col.scrape()
+            for i in range(3):
+                assert f'source="replica-{i}"' in txt
+            # ONE family per metric — never _dup name-mangling across
+            # sources
+            assert txt.count("# TYPE paddle_tpu_serving_requests counter") \
+                == 1
+            assert "_dup" not in txt
+            # the merged-sketch summary family carries the TRUE fleet p99
+            q99 = [ln for ln in txt.splitlines()
+                   if ln.startswith('paddle_tpu_serving_e2e_latency_q'
+                                    '{quantile="0.99"}')]
+            assert len(q99) == 1
+            est = float(q99[0].split()[-1])
+            true = float(np.quantile(pooled, 0.99))
+            assert abs(est - true) / true <= 0.011
+        finally:
+            col.stop()
+
+    def test_top_table_highlights_straggler_and_serves_query_verb(
+            self, monitored):
+        store = _store()
+        col, _ = _three_source_collector(store)
+        try:
+            rows = col.fleet_table()
+            assert [r["source"] for r in rows] == [
+                "replica-0", "replica-1", "replica-2"]
+            assert [r["straggler"] for r in rows] == [False, False, True]
+            assert rows[1]["queue"] == 1
+            assert rows[2]["p99_s"] > 5 * rows[0]["p99_s"]
+            assert rows[0]["burn"] == pytest.approx(0.1)   # shortest window
+            doc = telemetry.query_collector(col.host, col.port)
+            text = telemetry.render_top(doc)
+            assert "replica-2" in text and "*straggler*" in text
+            assert "3 sources, 3 alive" in text
+        finally:
+            col.stop()
+
+    def test_threshold_and_multiwindow_burn_rules_fire_on_transition(
+            self, monitored):
+        store = _store()
+        col = telemetry.TelemetryCollector(store, fleet="al").start()
+        try:
+            col.add_rule("deep_queue", "serving.queue_depth", 10.0)
+            col._on_hello("replica-0", 1, {"role": "replica", "pid": 1,
+                                           "meta": {}})
+            calm = {"full": True, "counters": {},
+                    "gauges": {"serving.queue_depth": 2,
+                               "slo.burn.2s": 0.2, "slo.burn.10s": 0.1},
+                    "histograms": {}}
+            col._on_metrics("replica-0", calm)
+            assert col.alerts() == []
+            # one window hot is a blip, not a sustained burn
+            col._on_metrics("replica-0", dict(
+                calm, gauges={"serving.queue_depth": 2,
+                              "slo.burn.2s": 5.0, "slo.burn.10s": 0.1}))
+            assert not any(a["rule"] == "slo_burn" for a in col.alerts())
+            # EVERY window hot + the queue over threshold: both rules fire
+            col._on_metrics("replica-0", dict(
+                calm, gauges={"serving.queue_depth": 50,
+                              "slo.burn.2s": 5.0, "slo.burn.10s": 2.0}))
+            names = sorted(a["rule"] for a in col.alerts())
+            assert names == ["deep_queue", "slo_burn"]
+            fired = [e for e in col.events if e["kind"] == "alert"]
+            assert len(fired) == 2   # one event per TRANSITION
+            col._on_metrics("replica-0", dict(
+                calm, gauges={"serving.queue_depth": 50,
+                              "slo.burn.2s": 6.0, "slo.burn.10s": 2.5}))
+            assert len([e for e in col.events
+                        if e["kind"] == "alert"]) == 2   # no re-fire
+            col._on_metrics("replica-0", calm)
+            assert col.alerts() == []                    # cleared
+        finally:
+            col.stop()
+
+
+# ---------------------------------------------------------------------------
+# correlated incident: one error, time-aligned dumps fleet-wide
+# ---------------------------------------------------------------------------
+
+class TestCorrelatedIncident:
+    def test_rank_desync_yields_fleet_dumps_sharing_one_incident_id(
+            self, telemetry_flags, monitored, tmp_path, capsys):
+        _flags.set_flags({"obs_flight_recorder": True,
+                          "obs_dump_dir": str(tmp_path)})
+        obs.reset()
+        store = _store()
+        col = telemetry.TelemetryCollector(store, fleet="inc").start()
+        exps = [telemetry.TelemetryExporter(
+            store, source=f"replica-{i}", fleet="inc",
+            meta={"replica_id": i}).start() for i in range(3)]
+        try:
+            assert _wait(lambda: len(col.sources) == 3)
+            # the desync fires on "replica-0" (the default exporter):
+            # its registered trigger dumps locally, the dump event
+            # reaches the collector, and the collector fans out
+            err = RankDesyncError(step=7, offenders=[1],
+                                  fingerprints={0: "a", 1: "b"})
+            assert obs.dump_on_error(err) is not None
+            assert _wait(lambda: len(col.incidents) == 1)
+            iid = next(iter(col.incidents))
+            assert _wait(lambda: len(col.incidents[iid]["dumps"]) == 3,
+                         timeout=10.0)
+            inc = col.incidents[iid]
+            assert sorted(d["source"] for d in inc["dumps"]) == [
+                "replica-0", "replica-1", "replica-2"]
+            docs = [json.load(open(d["path"])) for d in inc["dumps"]]
+            assert {d["incident_id"] for d in docs} == {iid}
+            assert all(d["schema"] == "paddle_tpu.flight_recorder/4"
+                       for d in docs)
+            # a second error inside the rate-limit window does NOT storm
+            obs.recorder()._last_dump.clear()   # un-rate-limit the LOCAL dump
+            err2 = RankDesyncError(step=8, offenders=[2],
+                                   fingerprints={0: "a", 2: "c"})
+            obs.dump_on_error(err2)
+            time.sleep(0.3)
+            assert len(col.incidents) == 1
+            # `monitor show a b c` renders the group under one header
+            from paddle_tpu.monitor import _main
+            assert _main(["show"] + [d["path"] for d in inc["dumps"]]) == 0
+            out = capsys.readouterr().out
+            assert f"correlated incident {iid} (3 dumps):" in out
+            assert out.count("flight recorder dump") == 3
+            assert out.count(iid) == 4   # header + one line per dump
+        finally:
+            for e in exps:
+                e.stop()
+            col.stop()
+            _flags.set_flags({"obs_flight_recorder": False,
+                              "obs_dump_dir": "flight_recorder"})
+            obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# router integration: the push-fed fast path (in-process)
+# ---------------------------------------------------------------------------
+
+class TestRouterFastPath:
+    def test_drain_event_marks_replica_draining_via_push(
+            self, telemetry_flags, monitored):
+        _flags.set_flags({"fleet_health_interval_s": 30.0,
+                          "fleet_lease_ttl_s": 30.0,
+                          "fleet_heartbeat_s": 0.2})
+        store = _store()
+        col = telemetry.TelemetryCollector(store, fleet="fp").start()
+        agent = ReplicaAgent(lambda x: x * 2.0, store, fleet="fp",
+                             engine_config=EngineConfig(**CFG)).start()
+        router = FleetRouter(store, fleet="fp")
+        try:
+            router.refresh()    # discover; NO poll loop, NO lease watcher
+            router.attach_telemetry(col)
+            assert router.replicas[agent.replica_id].healthy
+            agent.stop(drain=True)
+            # only the collector relay can deliver this within 30s
+            assert _wait(lambda: router.replicas[agent.replica_id].draining,
+                         timeout=5.0)
+        finally:
+            router.close()
+            agent.stop(drain=False)
+            col.stop()
+            _flags.set_flags({"fleet_health_interval_s": 0.5,
+                              "fleet_lease_ttl_s": 2.0,
+                              "fleet_heartbeat_s": 0.5})
+
+
+# ---------------------------------------------------------------------------
+# chaos drills (slow tier): child processes, real SIGKILL
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(store, fleet, tmp_path, tag, replica_id=None):
+    port_file = str(tmp_path / f"replica-{tag}.port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", FLAGS_monitor="1",
+               FLAGS_telemetry="1", FLAGS_telemetry_interval_s="0.05")
+    env.pop("XLA_FLAGS", None)
+    if replica_id is not None:
+        env["FLEET_REPLICA_ID"] = str(replica_id)
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__),
+                      "fleet_replica_runner.py"),
+         store.host, str(store.port), fleet, port_file],
+        stdin=subprocess.PIPE, env=env)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(port_file):
+        assert proc.poll() is None, "replica runner died during startup"
+        assert time.monotonic() < deadline, "replica never registered"
+        time.sleep(0.05)
+    rid, host, port = open(port_file).read().split()
+    return proc, int(rid), host, int(port)
+
+
+def _spawn_collector(store, fleet, tmp_path, tag):
+    port_file = str(tmp_path / f"collector-{tag}.port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FLAGS_telemetry_ring="256")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__),
+                      "telemetry_collector_runner.py"),
+         store.host, str(store.port), fleet, port_file],
+        stdin=subprocess.PIPE, env=env)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(port_file):
+        assert proc.poll() is None, "collector runner died during startup"
+        assert time.monotonic() < deadline, "collector never published"
+        time.sleep(0.05)
+    host, port = open(port_file).read().split()
+    return proc, host, int(port)
+
+
+def _reap(procs):
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.stdin.write(b"done\n")
+                p.stdin.flush()
+                p.wait(timeout=30)
+            except Exception:
+                p.kill()
+                p.wait(timeout=10)
+
+
+@pytest.mark.slow
+class TestChaosDrillA:
+    def test_sigkill_replica_push_beats_polling_exactly_once(
+            self, tmp_path, monitored):
+        """Drill A: SIGKILL a replica. The router has NO health loop and
+        a 30s lease TTL — only the collector's EOF-relayed death event
+        can explain sub-second detection. Then the same under load:
+        failover exactly-once per the ledger audit."""
+        _flags.set_flags({"telemetry": True, "telemetry_interval_s": 0.05,
+                          "fleet_health_interval_s": 30.0,
+                          "fleet_lease_ttl_s": 30.0})
+        store = _store()
+        fleet = "chaosA"
+        col = telemetry.TelemetryCollector(store, fleet=fleet).start()
+        procs = [_spawn_replica(store, fleet, tmp_path, i)
+                 for i in range(3)]
+        router = FleetRouter(store, fleet=fleet)
+        deaths = []
+        col.subscribe(lambda ev: deaths.append((ev, time.monotonic()))
+                      if ev["kind"] == "death" else None)
+        stop_burst = threading.Event()
+        outcomes, lock = [], threading.Lock()
+
+        def client_thread(i):
+            k = 0
+            while not stop_burst.is_set():
+                k += 1
+                try:
+                    st, _ = router.run(
+                        [np.full((1, 4), float(i * 100 + k), np.float32)],
+                        deadline_ms=8000)
+                    with lock:
+                        outcomes.append(st)
+                except Exception as e:
+                    with lock:
+                        outcomes.append(repr(e))
+        try:
+            router.refresh()   # discover replicas; no poll/lease watchers
+            router.attach_telemetry(col)
+            assert _wait(lambda: len(col.sources) == 3, timeout=20.0)
+            assert sorted(router.replicas) == [0, 1, 2]
+
+            # -- phase 1: push latency, idle (nothing else can mark dead)
+            victim_proc, victim_id = procs[0][0], procs[0][1]
+            killed_at = time.monotonic()
+            os.kill(victim_proc.pid, signal.SIGKILL)
+            assert _wait(
+                lambda: not router.replicas[victim_id].healthy,
+                timeout=5.0)
+            detect_s = time.monotonic() - killed_at
+            assert detect_s < 1.0, (
+                f"push-fed death took {detect_s:.2f}s "
+                f"(polling baseline: 30s interval / 30s lease)")
+            push = [d for d, _ in deaths
+                    if (d["detail"] or {}).get("replica_id") == victim_id]
+            assert push, "death was not collector-relayed"
+
+            # -- phase 2: SIGKILL under load, exactly-once failover
+            ts = [threading.Thread(target=client_thread, args=(i,))
+                  for i in range(4)]
+            [t.start() for t in ts]
+            time.sleep(0.7)           # burst established
+            victim2_proc, victim2_id = procs[1][0], procs[1][1]
+            killed2_at = time.monotonic()
+            os.kill(victim2_proc.pid, signal.SIGKILL)
+            assert _wait(
+                lambda: not router.replicas[victim2_id].healthy,
+                timeout=5.0)
+            assert time.monotonic() - killed2_at < 2.0
+            time.sleep(0.7)           # keep bursting through failover
+            stop_burst.set()
+            [t.join(timeout=30) for t in ts]
+            assert not any(t.is_alive() for t in ts)
+            n = len(outcomes)
+            assert n > 30, f"burst too small to mean anything: {n}"
+            bad = [o for o in outcomes if o != 0]
+            assert len(bad) / n <= 0.02, f"error rate {len(bad)}/{n}"
+            a = router.ledger.audit()
+            assert a["lost"] == 0 and a["open"] == 0, a
+            assert a["settled"] + a["rejected"] == a["issued"], a
+        finally:
+            stop_burst.set()
+            router.close()
+            col.stop()
+            _reap([p[0] for p in procs])
+            _flags.set_flags({"telemetry": False,
+                              "telemetry_interval_s": 0.25,
+                              "fleet_health_interval_s": 0.5,
+                              "fleet_lease_ttl_s": 2.0})
+
+
+@pytest.mark.slow
+class TestChaosDrillB:
+    def test_sigkill_collector_midburst_costs_telemetry_not_serving(
+            self, tmp_path, monitored):
+        """Drill B: SIGKILL the collector mid-burst. Serving sees ZERO
+        errors attributable to telemetry; exporters buffer-and-drop with
+        `telemetry.dropped` counted; a restarted collector resumes
+        ingesting (rediscovered through the store)."""
+        _flags.set_flags({"telemetry": True, "telemetry_interval_s": 0.05,
+                          "telemetry_buffer": 4,
+                          "fleet_health_interval_s": 0.2,
+                          "fleet_heartbeat_s": 0.2,
+                          "fleet_lease_ttl_s": 1.0})
+        store = _store()
+        fleet = "chaosB"
+        col_proc, col_host, col_port = _spawn_collector(
+            store, fleet, tmp_path, "first")
+        col2_proc = None
+        agents = [ReplicaAgent(lambda x: x * 2.0, store, fleet=fleet,
+                               engine_config=EngineConfig(**CFG)).start()
+                  for _ in range(2)]
+        router = FleetRouter(store, fleet=fleet).start()
+        stop_burst = threading.Event()
+        outcomes, lock = [], threading.Lock()
+
+        def client_thread(i):
+            k = 0
+            while not stop_burst.is_set():
+                k += 1
+                try:
+                    st, _ = router.run(
+                        [np.full((1, 4), float(i * 100 + k), np.float32)],
+                        deadline_ms=8000)
+                    with lock:
+                        outcomes.append(st)
+                except Exception as e:
+                    with lock:
+                        outcomes.append(repr(e))
+        try:
+            exps = [a._exporter for a in agents]
+            assert all(e is not None for e in exps)
+            assert _wait(lambda: all(e.pushes > 0 for e in exps),
+                         timeout=20.0)
+            ts = [threading.Thread(target=client_thread, args=(i,))
+                  for i in range(4)]
+            [t.start() for t in ts]
+            time.sleep(0.5)            # burst established
+            served_before = len(outcomes)
+            os.kill(col_proc.pid, signal.SIGKILL)
+            col_proc.wait(timeout=10)
+            # collector dead: overflow the tiny event buffers
+            for i in range(12):
+                for e in exps:
+                    e.event("drain", seq=i)
+            time.sleep(1.0)            # burst continues, pushes fail
+            assert sum(e.dropped for e in exps) > 0
+            assert monitor.snapshot()["counters"]["telemetry.dropped"] > 0
+            with lock:
+                assert len(outcomes) > served_before + 20, (
+                    "serving throughput stalled while the collector "
+                    "was dead")
+            # restart: exporters rediscover the NEW record and resume
+            col2_proc, col2_host, col2_port = _spawn_collector(
+                store, fleet, tmp_path, "second")
+            pushes_at_restart = [e.pushes for e in exps]
+            assert _wait(lambda: all(
+                e.pushes > p + 2
+                for e, p in zip(exps, pushes_at_restart)), timeout=20.0)
+            assert _wait(lambda: len(
+                telemetry.query_collector(col2_host, col2_port)
+                .get("sources") or []) == 2, timeout=20.0)
+            stop_burst.set()
+            [t.join(timeout=30) for t in ts]
+            assert not any(t.is_alive() for t in ts)
+            # -- the drill's contract: telemetry died, serving did not --
+            n = len(outcomes)
+            assert n > 50, f"burst too small to mean anything: {n}"
+            # status 2 is overload backpressure (an answer, not an
+            # error); anything else during the outage is a violation
+            bad = [o for o in outcomes if o not in (0, 2)]
+            assert bad == [], f"serving errors during collector outage: " \
+                              f"{bad[:5]} ({len(bad)}/{n})"
+            assert outcomes.count(0) > n // 2
+            a = router.ledger.audit()
+            assert a["lost"] == 0 and a["open"] == 0, a
+            assert a["settled"] + a["rejected"] == a["issued"], a
+        finally:
+            stop_burst.set()
+            router.close()
+            for ag in agents:
+                ag.stop(drain=False)
+            _reap([p for p in (col_proc, col2_proc) if p is not None])
+            _flags.set_flags({"telemetry": False,
+                              "telemetry_interval_s": 0.25,
+                              "telemetry_buffer": 256,
+                              "fleet_health_interval_s": 0.5,
+                              "fleet_heartbeat_s": 0.5,
+                              "fleet_lease_ttl_s": 2.0})
