@@ -1029,6 +1029,174 @@ def bench_telemetry(backend):
     }
 
 
+def bench_autoscale(backend):
+    """Elastic-autoscaler drill + decision-loop tax (serving/autoscaler.py).
+
+    Drill: one warm in-process replica, a client burst saturates its
+    queue, the sense->decide->act loop grows the pool —
+    time_to_first_new_replica_ms is spike-start -> the new replica
+    HEALTHY (spawn + register + first probe), recovery_window_ms is
+    spike-end -> the sensed signal back under every scale-out threshold.
+
+    Tax A/B: the same serving burst with the tick loop off vs on against
+    a PINNED pool (min==max: every tick senses, decides `hold`,
+    publishes — the full loop minus actuation). decision_loop_tax_pct
+    compares serving p99; the acceptance target is <=1%.
+
+    Knob: BENCH_AUTOSCALE=ab|off (default ab runs both)."""
+    import threading
+
+    import paddle_tpu.monitor as monitor
+    from paddle_tpu._native import TCPStore
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.obs import telemetry as _telemetry
+    from paddle_tpu.serving import (Autoscaler, EngineConfig, FleetRouter,
+                                    ReplicaAgent, ReplicaPool, ScalePolicy)
+
+    if os.environ.get("BENCH_AUTOSCALE", "ab").lower() == "off":
+        return {"skipped": "BENCH_AUTOSCALE=off"}
+
+    saved = {k: _flags.flag(k) for k in
+             ("monitor", "telemetry", "telemetry_interval_s",
+              "serving_queue_depth", "fleet_heartbeat_s",
+              "fleet_lease_ttl_s", "fleet_health_interval_s")}
+    _flags.set_flags({"monitor": True, "telemetry": True,
+                      "telemetry_interval_s": 0.05,
+                      "serving_queue_depth": 4,
+                      "fleet_heartbeat_s": 0.1, "fleet_lease_ttl_s": 0.4,
+                      "fleet_health_interval_s": 0.1})
+    x = np.full((1, 8), 1.0, np.float32)
+
+    def spawn_fn(store, model_s):
+        def handler(a):
+            time.sleep(model_s)
+            return a * 2.0
+        def spawn():
+            agent = ReplicaAgent(
+                handler, store, fleet="bench-as",
+                engine_config=EngineConfig(max_batch_size=8,
+                                           batch_timeout_ms=1.0,
+                                           warmup_on_start=False))
+            try:
+                return agent.start()
+            except BaseException:
+                agent.stop(drain=False)
+                raise
+        return spawn
+
+    def plane(model_s):
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        col = _telemetry.TelemetryCollector(store, fleet="bench-as").start()
+        router = FleetRouter(store, fleet="bench-as").start()
+        pool = ReplicaPool(router, spawn_fn(store, model_s),
+                           spawn_timeout_s=60.0)
+        return store, col, router, pool
+
+    out = {}
+    try:
+        # ---- drill: spike -> grow -> recover --------------------------
+        store, col, router, pool = plane(0.003)
+        policy = ScalePolicy(burn_high=1e9, burn_low=0.0,
+                             queue_high=0.5, queue_low=0.2,
+                             min_replicas=1, max_replicas=3,
+                             cooldown_s=0.5, idle_after_s=30.0,
+                             zero_after_s=3600.0, step=1)
+        auto = Autoscaler(col, pool, policy=policy, interval_s=0.1,
+                          queue_capacity=4)
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    router.run([x], deadline_ms=8000)
+                except Exception:
+                    pass
+
+        try:
+            auto.start()
+            deadline = time.monotonic() + 60
+            while pool.actual() < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            threads = [threading.Thread(target=client) for _ in range(8)]
+            spike_at = time.monotonic()
+            [t.start() for t in threads]
+            while pool.actual() < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            t_first = time.monotonic() - spike_at
+            stop.set()
+            [t.join() for t in threads]
+            calm_at = time.monotonic()
+            recovery = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                sig = auto._sense()
+                if sig["queue_frac"] < policy.queue_low \
+                        and sig["pending"] == 0:
+                    recovery = time.monotonic() - calm_at
+                    break
+                time.sleep(0.02)
+            out["grew_to"] = pool.actual()
+            out["time_to_first_new_replica_ms"] = round(t_first * 1e3, 1)
+            out["recovery_window_ms"] = (round(recovery * 1e3, 1)
+                                         if recovery is not None else None)
+            out["decisions"] = auto.ledger.snapshot()["counts"]
+        finally:
+            stop.set()
+            auto.close(stop_pool=True)
+            router.close()
+            col.stop()
+
+        # ---- tax A/B: pinned pool, loop off vs on ---------------------
+        n_req = 400 if backend == "tpu" else 200
+
+        def one_arm(loop_on):
+            store, col, router, pool = plane(0.0)
+            auto = None
+            try:
+                # bootstrap the single replica through the pool either
+                # way, so both arms serve through an identical stack
+                pool.scale_out(1)
+                if loop_on:
+                    auto = Autoscaler(
+                        col, pool,
+                        policy=ScalePolicy(min_replicas=1, max_replicas=1,
+                                           cooldown_s=0.5),
+                        interval_s=0.05, queue_capacity=4)
+                    auto.start()
+                for _ in range(20):                       # warm the path
+                    router.run([x], deadline_ms=8000)
+                p99s = []
+                for _ in range(3):                # median p99: short-burst
+                    lat = []                      # tails are noisy on CPU
+                    for _ in range(n_req):
+                        t1 = time.perf_counter()
+                        router.run([x], deadline_ms=8000)
+                        lat.append(time.perf_counter() - t1)
+                    p99s.append(float(np.quantile(lat, 0.99)))
+                ticks = auto.ticks if auto is not None else 0
+                return float(np.median(p99s)) * 1e6, ticks
+            finally:
+                if auto is not None:
+                    auto.close(stop_pool=False)
+                pool.stop_all()
+                router.close()
+                col.stop()
+
+        p99_off, _ = one_arm(False)
+        p99_on, ticks = one_arm(True)
+        out["requests_per_arm"] = n_req
+        out["ticks_on_arm"] = ticks
+        out["serving_p99_us_off"] = round(p99_off, 1)
+        out["serving_p99_us_on"] = round(p99_on, 1)
+        out["decision_loop_tax_pct"] = (
+            round((p99_on - p99_off) / p99_off * 100, 2)
+            if p99_off else None)
+    finally:
+        _flags.set_flags(saved)
+        monitor.reset()
+    return out
+
+
 def bench_ps_durability(backend):
     """PS durability tax A/B: sequenced sparse-push throughput with the
     WAL off vs on (FLAGS_ps_wal_dir), plus the recovery path timed —
@@ -1219,6 +1387,7 @@ def main():
                     ("allreduce_smoke", bench_allreduce),
                     ("serving_slo", bench_serving_slo),
                     ("telemetry", bench_telemetry),
+                    ("autoscale", bench_autoscale),
                     ("ps_durability", bench_ps_durability),
                     ("llm", bench_llm),
                     ("warm_start", bench_warm_start)):

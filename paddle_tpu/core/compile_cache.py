@@ -47,6 +47,7 @@ from . import flags as _flags
 __all__ = [
     "enabled", "cache_dir", "cache_key", "topology_fingerprint",
     "lookup", "store", "entries", "gc", "verify", "stats", "reset_stats",
+    "warm_start_report",
 ]
 
 _SCHEMA = 1
@@ -382,3 +383,21 @@ def stats() -> Dict[str, int]:
 def reset_stats() -> None:
     global hits, misses, fallbacks, stores, evictions, export_skips
     hits = misses = fallbacks = stores = evictions = export_skips = 0
+
+
+def warm_start_report() -> Dict[str, Any]:
+    """One-call warm-start verdict for a freshly spawned process: cache
+    activity plus the `trace_compile` ledger counter (core/executable.py
+    counts every traced build there). `warm` is the autoscaler's
+    acceptance bit — the process served with ZERO traced compiles and at
+    least one cache hit, i.e. scale-out actually exploited the
+    persistent cache instead of paying cold compiles."""
+    compiles = 0
+    if _monitor._ENABLED:
+        compiles = int(
+            _monitor.snapshot()["counters"].get("trace_compile", 0))
+    s = stats()
+    return {"enabled": enabled(), "dir": cache_dir(),
+            "trace_compile": compiles,
+            "warm": bool(enabled() and compiles == 0 and s["hits"] > 0),
+            **s}

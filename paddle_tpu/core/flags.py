@@ -371,6 +371,66 @@ define_flag("telemetry_incident_min_interval_s", 30.0,
             "fan-outs — a crash loop yields one fleet-wide dump set per "
             "window, not a dump storm")
 
+# ---- SLO-driven autoscaler (serving/autoscaler.py) ------------------------
+define_flag("autoscaler_interval_s", 0.5,
+            "autoscaler: control-loop tick period — each tick senses the "
+            "collector's fleet signal (worst shortest-window burn + queue "
+            "fraction), asks the policy for a decision, and actuates it")
+define_flag("autoscaler_burn_high", 1.0,
+            "autoscaler policy: scale OUT when the worst replica's "
+            "shortest-window SLO burn exceeds this (1.0 = consuming the "
+            "error budget exactly as provisioned)")
+define_flag("autoscaler_burn_low", 0.25,
+            "autoscaler policy: burn must be at or below this for the "
+            "idle clock to run (scale-in hysteresis band: the gap to "
+            "autoscaler_burn_high is where nothing happens)")
+define_flag("autoscaler_queue_high", 0.8,
+            "autoscaler policy: scale OUT when the fleet queue fraction "
+            "(queued work / aggregate queue capacity) exceeds this")
+define_flag("autoscaler_queue_low", 0.2,
+            "autoscaler policy: queue fraction must be at or below this "
+            "for the idle clock to run (scale-in hysteresis band)")
+define_flag("autoscaler_cooldown_s", 5.0,
+            "autoscaler policy: minimum spacing between scale actions in "
+            "the SAME direction — flapping traffic cannot thrash the "
+            "pool faster than one step per cooldown")
+define_flag("autoscaler_idle_after_s", 10.0,
+            "autoscaler policy: the fleet must stay calm (burn and queue "
+            "below the low thresholds) this long before ONE replica is "
+            "drained; the clock restarts after each scale-in")
+define_flag("autoscaler_zero_after_s", 60.0,
+            "autoscaler policy: with autoscaler_min_replicas=0, a fleet "
+            "calm this long scales TO ZERO (drains every replica); idle "
+            "tenants are evicted at the same threshold under the "
+            "FLAGS_fleet_hbm_budget_mb LRU when autoscaler_tenant_idle_s "
+            "is unset")
+define_flag("autoscaler_min_replicas", 1,
+            "autoscaler policy: floor of the replica pool (0 allows "
+            "scale-to-zero)")
+define_flag("autoscaler_max_replicas", 0,
+            "autoscaler policy: ceiling of the replica pool; 0 = use "
+            "FLAGS_fleet_max_replicas")
+define_flag("autoscaler_step", 1,
+            "autoscaler policy: replicas added per scale-out decision "
+            "(scale-in always drains one at a time)")
+define_flag("autoscaler_spawn_timeout_s", 15.0,
+            "autoscaler pool: a spawned replica must answer its first "
+            "'PDHQ' probe within this window or it is reaped (record + "
+            "lease reclaimed, autoscaler.spawn_failures counted)")
+define_flag("autoscaler_spawn_retries", 3,
+            "autoscaler pool: consecutive spawn failures tolerated "
+            "before scale-out is declared blocked (the collector's "
+            "scale_blocked alert fires); one success resets the budget")
+define_flag("autoscaler_tenant_idle_s", 0.0,
+            "autoscaler: evict a hosted ModelTenant idle this long with "
+            "an empty queue (scale-to-zero for tenants, via the "
+            "replica's HBM-budget LRU eviction path); 0 = fall back to "
+            "autoscaler_zero_after_s, negative = never evict tenants")
+define_flag("autoscaler_ledger_ring", 128,
+            "autoscaler: decision-ledger ring length (every scale action "
+            "with its triggering evidence; dumped into the flight "
+            "recorder and rendered by `monitor top`)")
+
 # ---- executable plane (core/executable.py + core/compile_cache.py) --------
 define_flag("compile_cache_dir", "",
             "persistent on-disk executable cache (core/compile_cache.py): "

@@ -355,6 +355,7 @@ class TelemetryCollector:
         self._rules: List[Dict[str, Any]] = []
         self._active_alerts: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self.burn_threshold = 1.0   # multi-window burn rule (obs/slo.py)
+        self._pool: Dict[str, Any] = {}   # autoscaler pool state
         self.incidents: Dict[str, Dict[str, Any]] = {}
         self._last_incident = 0.0
         self._conn_seq = 0
@@ -675,6 +676,28 @@ class TelemetryCollector:
         with self._lock:
             return [dict(a) for a in self._active_alerts.values()]
 
+    # -- autoscaler integration --
+    def pool_update(self, doc: Dict[str, Any]) -> None:
+        """The co-located Autoscaler publishes its pool state here after
+        every tick (target vs actual replicas, last decision + trigger,
+        blocked verdict); `monitor top` renders it, and the built-in
+        `scale_blocked` rule fires ONE alert event per transition into a
+        scale-out that cannot be satisfied (spawn budget exhausted / HBM
+        refused)."""
+        with self._lock:
+            self._pool = dict(doc)
+        fired: List[Tuple[str, Dict[str, Any]]] = []
+        cleared: List[str] = []
+        self._transition(
+            "autoscaler", "scale_blocked", bool(doc.get("blocked")),
+            {"reason": doc.get("blocked_reason"),
+             "target": doc.get("target"), "actual": doc.get("actual")},
+            fired, cleared)
+        for name, detail in fired:
+            self._dispatch_event("autoscaler",
+                                 {"kind": "alert", "ts": _now(),
+                                  "detail": dict(detail, rule=name)})
+
     # -- read side --
     def mergeable_snapshots(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -719,8 +742,6 @@ class TelemetryCollector:
             if isinstance(hist, dict) and hist.get("count"):
                 p99 = _monitor.Histogram.from_payload(
                     "serving.e2e_latency", hist).quantile(0.99)
-            burns = {k[len("slo.burn."):-1]: v for k, v in gauges.items()
-                     if k.startswith("slo.burn.") and k.endswith("s")}
             hbm = max([v for k, v in gauges.items()
                        if k.startswith("mem.") and k.endswith("bytes")]
                       or [0])
@@ -730,7 +751,7 @@ class TelemetryCollector:
                          "qps": rates.get(src, 0.0),
                          "queue": gauges.get("serving.queue_depth", 0),
                          "p99_s": p99,
-                         "burn": _slo.shortest_window_burn({"burn": burns}),
+                         "burn": _slo.burn_from_gauges(gauges),
                          "hbm_bytes": hbm})
         worst, _, _, skew = _merge.skew_over_median(
             {s: v for s, v in p99s.items() if v > 0})
@@ -744,9 +765,10 @@ class TelemetryCollector:
         with self._lock:
             events = list(self.events)[-16:]
             incidents = [dict(i) for i in self.incidents.values()]
+            pool = dict(self._pool)
         return {"fleet": self.fleet, "ts": _now(), "sources": rows,
                 "events": events, "incidents": incidents,
-                "alerts": self.alerts()}
+                "alerts": self.alerts(), "pool": pool}
 
 
 # ---------------------------------------------------------------------------
@@ -789,6 +811,19 @@ def render_top(doc: Dict[str, Any]) -> str:
             f"{r.get('p99_s', 0.0) * 1e3:>9.2f}"
             f"{r.get('burn', 0.0):>7.2f}"
             f"{r.get('hbm_bytes', 0) / 1e6:>9.1f}  {state}")
+    pool = doc.get("pool") or {}
+    if pool:
+        line = (f"pool: target={pool.get('target')} "
+                f"actual={pool.get('actual')}")
+        if pool.get("blocked"):
+            line += f"  [BLOCKED: {pool.get('blocked_reason') or '?'}]"
+        last = pool.get("last") or {}
+        if last:
+            delta = last.get("delta") or 0
+            line += (f"  last={last.get('action')}"
+                     f"{delta:+d} trigger={last.get('reason')}"
+                     f" outcome={last.get('outcome')}")
+        lines.append(line)
     alerts = doc.get("alerts") or []
     for a in alerts:
         lines.append(f"ALERT {a.get('rule')} on {a.get('source')}: "

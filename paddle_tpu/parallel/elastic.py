@@ -100,6 +100,14 @@ class ElasticManager:
                 if misses * self.heartbeat_interval > self.lease_ttl * 3:
                     return  # store genuinely gone: lease is long expired
 
+    def reclaim(self, rank: int) -> None:
+        """Forcibly expire `rank`'s lease (the store has no delete: an
+        empty value reads as expired). The autoscaler uses this to
+        reclaim a corpse's lease after a SIGKILL mid-drain or a spawn
+        that never came up — membership converges immediately instead of
+        waiting out the TTL."""
+        self.store.set(f"lease:{rank}", b"")
+
     def stop(self):
         self._stop.set()
         self._watch_stop.set()

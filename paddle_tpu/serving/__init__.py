@@ -20,6 +20,9 @@ from .fleet import (  # noqa: F401  (after engine: fleet builds on it)
     FleetError, FleetRouter, HBMBudgetExceededError, ModelTenant,
     NoHealthyReplicaError, ReplicaAgent, RolloutResult, SequenceLedger,
 )
+from .autoscaler import (  # noqa: F401  (after fleet: the control plane)
+    Autoscaler, DecisionLedger, ReplicaPool, ScaleDecision, ScalePolicy,
+)
 from .llm import LLMConfig, LLMEngine, LLMStream  # noqa: F401
 
 __all__ = [
@@ -31,4 +34,6 @@ __all__ = [
     "FleetRouter", "ReplicaAgent", "ModelTenant", "SequenceLedger",
     "RolloutResult", "FleetError", "NoHealthyReplicaError",
     "HBMBudgetExceededError",
+    "Autoscaler", "ScalePolicy", "ScaleDecision", "ReplicaPool",
+    "DecisionLedger",
 ]

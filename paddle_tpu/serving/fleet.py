@@ -227,6 +227,7 @@ class ModelTenant:
             "bytes": self.bytes,
             "slo": self.slo.stats() if self.slo is not None else None,
             "queue_depth": self.engine.stats()["queue_depth"],
+            "idle_s": round(time.monotonic() - self.last_used, 3),
         }
 
 
@@ -416,6 +417,15 @@ class ReplicaAgent:
             tenant.reload()
             if _monitor._ENABLED:
                 _monitor.count("fleet.model_rollbacks")
+        elif op == "evict":
+            # scale-to-zero for an idle tenant: the autoscaler rides the
+            # same LRU eviction path the HBM budget uses, so a later
+            # host_model() re-admits the tenant cold (weights reload from
+            # the guard store, the compile cache warm-starts the engine)
+            version = tenant.version
+            if tenant.engine.stats()["queue_depth"] > 0:
+                raise FleetError(f"model {name!r} is busy; not evictable")
+            self.evict_model(name)
         else:
             raise FleetError(f"unknown model-ctl op {op!r}")
         _obs.record_event("fleet.model_ctl", replica=self.replica_id,
@@ -549,12 +559,19 @@ class FleetRouter:
         self._health_interval = float(
             _flags.flag("fleet_health_interval_s"))
         self._max_replicas = int(_flags.flag("fleet_max_replicas"))
+        lease_ttl = float(_flags.flag("fleet_lease_ttl_s"))
+        # a handle dead this long with NO live lease is a corpse: reaped
+        # from membership (and its stale record cleared) instead of being
+        # probed forever — long enough that a live-but-slow replica's
+        # lease always outruns it
+        self._reap_after = max(2.0 * lease_ttl,
+                               4.0 * self._health_interval)
         # prompt death detection: the elastic watcher fires on a missed
         # lease without waiting for the next health sweep
         self._elastic = ElasticManager(
             _PrefixStore(store, f"fleet:{self.fleet}:"), rank=-1,
             world_size=self._max_replicas,
-            lease_ttl=float(_flags.flag("fleet_lease_ttl_s")),
+            lease_ttl=lease_ttl,
             heartbeat_interval=float(_flags.flag("fleet_heartbeat_s")))
         self._health_thread: Optional[threading.Thread] = None
         self._closed = False
@@ -634,6 +651,48 @@ class FleetRouter:
             _obs.record_event("fleet.replica_dead", replica=int(rid),
                               via="telemetry")
 
+    def forget(self, replica_id: int, reclaim: bool = True) -> bool:
+        """Remove a replica from membership entirely. With `reclaim`,
+        also clear its store record and lease (the store has no delete;
+        empty == gone) so neither this router nor any other ever probes
+        the corpse again. The autoscaler's pool calls this for a spawn
+        that never answered its first 'PDHQ'; `refresh` calls it for any
+        handle dead past the reap window with no live lease."""
+        with self._lock:
+            h = self.replicas.pop(replica_id, None)
+        if h is not None:
+            h.close_pool()
+        if reclaim:
+            try:
+                self.store.set(
+                    f"fleet:{self.fleet}:replica:{replica_id}", b"")
+                self._elastic.reclaim(replica_id)
+            except Exception:
+                pass  # store gone on teardown: nothing left to reclaim
+        if h is not None:
+            _obs.record_event("fleet.replica_reaped", replica=replica_id)
+        return h is not None
+
+    def _reap_if_corpse(self, h: _ReplicaHandle) -> bool:
+        """A handle that has been dead past the reap window AND holds no
+        live lease is a corpse — a replica that died between spawn and
+        its first 'PDHQ' answer would otherwise keep a stale record that
+        every sweep probes forever."""
+        if h.healthy or h.detected_dead_at is None:
+            return False
+        if time.monotonic() - h.detected_dead_at < self._reap_after:
+            return False
+        try:
+            alive = set(self._elastic.alive_ranks())
+        except Exception:
+            return False  # store blip: reap on a later sweep
+        if h.replica_id in alive:
+            return False
+        self.forget(h.replica_id)
+        if _monitor._ENABLED:
+            _monitor.count("fleet.replicas_reaped")
+        return True
+
     def refresh(self) -> None:
         """One membership + health sweep (the fleet-health thread calls
         this every FLAGS_fleet_health_interval_s; tests call it directly
@@ -667,6 +726,7 @@ class FleetRouter:
                     _obs.record_event("fleet.replica_joined", replica=rid,
                                       port=rec["port"], rejoin=rejoin)
             self._probe(h)
+            self._reap_if_corpse(h)
 
     def _probe(self, h: _ReplicaHandle) -> None:
         try:

@@ -1,0 +1,82 @@
+"""Autoscaler replica runner (executed by test_autoscaler_chaos.py).
+
+One autoscaler-spawned fleet member in a real child process: a
+ReplicaAgent over a @to_static predictor whose declared buckets are
+warmed BEFORE the replica registers — through the persistent compile
+cache (FLAGS_compile_cache_dir via env), so a spawn into a primed cache
+serves its first request with ZERO trace compiles. Serves until
+SIGKILLed (the chaos half of the drill) or until the parent writes a
+line on stdin, then prints ONE json line — the compile-cache warm-start
+report plus serve counters — for the parent's acceptance assertions.
+
+argv: [store_host, store_port, fleet_name, port_file]
+env:  FLEET_REPLICA_ID (optional) — rejoin with a fixed id.
+      FLAGS_monitor / FLAGS_telemetry / FLAGS_slo_* /
+      FLAGS_compile_cache_dir / FLAGS_serving_queue_depth — the parent
+      sets the whole observability + cache surface through env flags.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+store_host = sys.argv[1]
+store_port = int(sys.argv[2])
+fleet_name = sys.argv[3]
+port_file = sys.argv[4]
+
+from paddle_tpu import monitor  # noqa: E402
+from paddle_tpu._native import TCPStore  # noqa: E402
+from paddle_tpu.core import compile_cache as cc  # noqa: E402
+from paddle_tpu.core import flags as _flags  # noqa: E402
+from paddle_tpu.jit.to_static import to_static  # noqa: E402
+from paddle_tpu.serving import EngineConfig, ReplicaAgent  # noqa: E402
+
+_flags.set_flags({"fleet_heartbeat_s": 0.15, "fleet_lease_ttl_s": 0.6})
+
+
+@to_static
+def _model(a):
+    return a * 2.0 + 1.0
+
+
+def _handler(a):
+    time.sleep(0.004)   # synthetic model time: the spike must saturate
+    return _model(a)
+
+
+store = TCPStore(store_host, store_port, is_master=False)
+rid = os.environ.get("FLEET_REPLICA_ID")
+agent = ReplicaAgent(
+    _handler, store, fleet=fleet_name,
+    replica_id=int(rid) if rid else None,
+    engine_config=EngineConfig(warmup_on_start=False, batch_timeout_ms=2,
+                               max_batch_size=8, learn_buckets=False))
+# warm BEFORE registering: the replica only starts advertising once its
+# buckets are compiled (from-cache on a warm spawn: zero trace compiles)
+agent.server.engine.declare_bucket([(4,)], ["float32"], [1, 2, 4, 8])
+agent.server.engine.warmup()
+agent.start()
+
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    f.write(f"{agent.replica_id} {agent.host} {agent.port}")
+os.rename(tmp, port_file)   # atomic: the parent never reads a half-write
+
+sys.stdin.readline()        # parent says "exit gracefully" (or SIGKILLs us)
+served = int(agent.server.engine.stats()["counters"].get("completed", 0))
+agent.stop(drain=True)
+
+snap = monitor.snapshot()["counters"]
+print(json.dumps({
+    "replica_id": agent.replica_id,
+    "served": served,
+    "warm_start": cc.warm_start_report(),
+    "trace_compile": int(snap.get("trace_compile", 0)),
+}))
+sys.stdout.flush()

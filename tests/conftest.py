@@ -272,3 +272,41 @@ def _no_ps_leak():
         f"{[type(o).__name__ for o in leaked]}")
     assert len(after := ps_threads()) <= before, (
         f"PS thread(s) leaked out of the test: {after}")
+
+
+@pytest.fixture(autouse=True)
+def _no_autoscaler_leak():
+    """A leaked autoscaler keeps its control loop scaling a dead fleet —
+    and holds every ReplicaAgent its pool spawned — under every later
+    test. Reap stragglers (close() also stops the pool's spawned
+    handles; defined LAST so this teardown runs before the fleet/
+    telemetry fixtures assert their planes) and assert the
+    `autoscaler-*` threads are quiescent after EVERY test."""
+    import threading
+    import time
+    from paddle_tpu.serving import autoscaler as _autoscaler
+
+    def scaler_threads():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("autoscaler-")]
+
+    before = len(scaler_threads())
+    yield
+    leaked = [a for a in list(_autoscaler._LIVE)
+              if not getattr(a, "_closed", True)]
+    for a in leaked:
+        try:
+            a.close()
+        except Exception:
+            pass
+    for _ in range(20):  # reaped threads need a beat to exit
+        after = scaler_threads()
+        if len(after) <= before:
+            break
+        time.sleep(0.1)
+    assert not leaked, (
+        f"{len(leaked)} autoscaler(s) leaked out of the test "
+        f"(Autoscaler.close() never reached): "
+        f"{[type(o).__name__ for o in leaked]}")
+    assert len(after := scaler_threads()) <= before, (
+        f"autoscaler thread(s) leaked out of the test: {after}")

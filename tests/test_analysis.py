@@ -823,7 +823,11 @@ class TestSelfLint:
              os.path.join(PKG, "distributed", "ps", "ha.py"),
              # fleet telemetry plane (ISSUE 16): the exporter's event()
              # rides the serving hot path; pushes run on their own thread
-             os.path.join(PKG, "obs", "telemetry.py")],
+             os.path.join(PKG, "obs", "telemetry.py"),
+             # elastic autoscaler (ISSUE 17): the sense→decide→act tick
+             # runs beside serving every interval — it must stay
+             # device-sync-free or the decision loop taxes the p99
+             os.path.join(PKG, "serving", "autoscaler.py")],
             all_functions=True)
         assert n_files > 25
         assert findings == [], "\n".join(f.format() for f in findings)

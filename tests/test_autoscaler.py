@@ -1,0 +1,516 @@
+"""SLO-driven elastic autoscaler (serving/autoscaler.py): pure-policy
+hysteresis/cooldown/scale-to-zero traces, the decision ledger, the
+ReplicaPool actuator (spawn-until-healthy, never-healthy reaping,
+graceful + interrupted drain) and the full sense→decide→act loop against
+an injected collector — all deterministic tier-1; the chaos soak lives
+in test_autoscaler_chaos.py."""
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import faults, monitor
+from paddle_tpu._native import TCPStore
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.guard import guard_state_version, save_guard_state
+from paddle_tpu.obs import telemetry as _telemetry
+from paddle_tpu.obs.slo import SloPlane, burn_from_gauges
+from paddle_tpu.serving import (Autoscaler, DecisionLedger, EngineConfig,
+                                FleetRouter, ModelTenant, ReplicaAgent,
+                                ReplicaPool, ScalePolicy)
+
+CFG = dict(max_batch_size=8, batch_timeout_ms=1.0, warmup_on_start=False)
+
+FAST_FLEET = {"fleet_heartbeat_s": 0.1, "fleet_lease_ttl_s": 0.4,
+              "fleet_health_interval_s": 0.1}
+
+# explicit numbers so the trace tests never depend on flag defaults
+POLICY = dict(burn_high=1.0, burn_low=0.25, queue_high=0.8, queue_low=0.2,
+              min_replicas=1, max_replicas=4, cooldown_s=5.0,
+              idle_after_s=10.0, zero_after_s=30.0, step=1)
+
+
+@pytest.fixture()
+def fleet_flags():
+    before = {k: _flags.flag(k) for k in FAST_FLEET}
+    _flags.set_flags(FAST_FLEET)
+    yield
+    _flags.set_flags(before)
+
+
+@pytest.fixture()
+def monitored():
+    monitor.reset()
+    _flags.set_flags({"monitor": True})
+    yield monitor
+    _flags.set_flags({"monitor": False})
+    monitor.reset()
+
+
+def _store():
+    return TCPStore("127.0.0.1", 0, is_master=True)
+
+
+def _policy(**kw):
+    return ScalePolicy(**{**POLICY, **kw})
+
+
+def _sig(**kw):
+    base = {"burn": 0.0, "queue_frac": 0.0, "actual": 2,
+            "alive_sources": 2, "pending": 0}
+    base.update(kw)
+    return base
+
+
+def _spawn_fn(store):
+    """A spawn callable that never leaks a half-started agent: a fault
+    raised inside start() (e.g. replica.register) stops the agent before
+    the error propagates to the pool."""
+    def spawn():
+        agent = ReplicaAgent(lambda x: x * 2.0, store,
+                             engine_config=EngineConfig(**CFG))
+        try:
+            return agent.start()
+        except BaseException:
+            agent.stop(drain=False)
+            raise
+    return spawn
+
+
+def _source(burn=0.0, queue=0, role="replica", alive=True):
+    """One injected collector source record (the shape the 'PDTM' wire
+    path builds) — lets tier-1 drive _sense without sockets."""
+    return {"counters": {}, "histograms": {}, "meta": {},
+            "gauges": {"slo.burn.60s": burn, "serving.queue_depth": queue},
+            "role": role, "alive": alive}
+
+
+# ---------------------------------------------------------------------------
+# the pure policy: table-driven traces
+# ---------------------------------------------------------------------------
+
+class TestScalePolicy:
+    def test_burn_spike_scales_out_once_per_cooldown(self):
+        p = _policy()
+        decisions = [(t, p.decide(_sig(burn=5.0), now=float(t)))
+                     for t in range(11)]
+        outs = [t for t, d in decisions if d.action == "out"]
+        assert outs == [0, 5, 10]
+        assert all(d.reason == "cooldown" for t, d in decisions
+                   if d.action == "hold")
+        d0 = decisions[0][1]
+        assert d0.delta == 1 and d0.reason == "burn_high"
+        assert d0.evidence["burn"] == 5.0
+
+    def test_queue_pressure_triggers_and_burn_takes_precedence(self):
+        p = _policy()
+        d = p.decide(_sig(queue_frac=0.9), now=0.0)
+        assert (d.action, d.reason) == ("out", "queue_high")
+        p2 = _policy()
+        d = p2.decide(_sig(burn=2.0, queue_frac=0.9), now=0.0)
+        assert d.reason == "burn_high"
+
+    def test_hysteresis_band_is_inert(self):
+        # mid-band (between low and high) forever: no action, and no
+        # idle credit accrues that a later calm stretch could inherit
+        p = _policy()
+        for t in range(100):
+            d = p.decide(_sig(burn=0.5), now=float(t))
+            assert (d.action, d.reason) == ("hold", "steady")
+        d = p.decide(_sig(burn=0.0), now=100.0)
+        assert (d.action, d.reason) == ("hold", "calm")
+        assert d.evidence["idle_s"] == 0.0
+
+    def test_sustained_idle_scales_in_exactly_once_per_window(self):
+        p = _policy()
+        ins = [t for t in range(25)
+               if p.decide(_sig(), now=float(t)).action == "in"]
+        # the idle clock restarts on every scale-in: one drain per
+        # 10s sustained-calm window, not a cascade at t=10,11,12,...
+        assert ins == [10, 20]
+
+    def test_midband_blip_resets_the_idle_clock(self):
+        p = _policy()
+        for t in range(9):
+            p.decide(_sig(), now=float(t))
+        p.decide(_sig(burn=0.5), now=9.0)  # blip into the band
+        decisions = [(t, p.decide(_sig(), now=float(t)))
+                     for t in range(10, 21)]
+        ins = [t for t, d in decisions if d.action == "in"]
+        assert ins == [20]  # 10s from the blip, not from t=0
+
+    def test_scale_to_zero_needs_longer_conviction(self):
+        p = _policy(min_replicas=0)
+        # surplus replica drains at the idle threshold...
+        d = [p.decide(_sig(actual=2), now=float(t))
+             for t in range(11)][-1]
+        assert (d.action, d.reason) == ("in", "sustained_idle")
+        # ...but the LAST one waits for zero_after_s (a cold start is
+        # at stake): calm resumed at t=10, zero fires at t=40 not t=20
+        decisions = [(t, p.decide(_sig(actual=1), now=float(t)))
+                     for t in range(11, 41)]
+        ins = [(t, d.reason) for t, d in decisions if d.action == "in"]
+        assert ins == [(40, "scale_to_zero")]
+
+    def test_min_one_never_scales_to_zero(self):
+        p = _policy(min_replicas=1)
+        for t in range(200):
+            assert p.decide(_sig(actual=1), now=float(t)).action == "hold"
+
+    def test_blind_policy_holds_and_freezes_the_idle_clock(self):
+        p = _policy()
+        for t in range(9):
+            p.decide(_sig(), now=float(t))  # 9s of calm banked
+        for t in range(9, 20):
+            d = p.decide(_sig(alive_sources=0), now=float(t))
+            assert (d.action, d.reason) == ("hold", "no_signal")
+        # signal back: the idle clock starts OVER — never scale in on
+        # credit earned before the collector went dark
+        d = p.decide(_sig(), now=20.0)
+        assert (d.action, d.reason) == ("hold", "calm")
+
+    def test_below_min_bootstraps_without_telemetry(self):
+        p = _policy(min_replicas=2)
+        d = p.decide(_sig(actual=0, alive_sources=0), now=0.0)
+        assert (d.action, d.delta, d.reason) == ("out", 2, "below_min")
+
+    def test_cold_start_from_zero_on_pending_work(self):
+        p = _policy(min_replicas=0)
+        d = p.decide(_sig(actual=0, alive_sources=0), now=0.0)
+        assert (d.action, d.reason) == ("hold", "calm")
+        d = p.decide(_sig(actual=0, alive_sources=0, pending=3), now=1.0)
+        assert (d.action, d.delta, d.reason) == ("out", 1, "cold_start")
+
+    def test_at_max_holds_under_fire(self):
+        p = _policy()
+        d = p.decide(_sig(burn=9.0, actual=4), now=0.0)
+        assert (d.action, d.reason) == ("hold", "at_max")
+        # and the step is clamped, never overshooting the ceiling
+        p2 = _policy(step=3)
+        d = p2.decide(_sig(burn=9.0, actual=3), now=0.0)
+        assert (d.action, d.delta) == ("out", 1)
+
+
+# ---------------------------------------------------------------------------
+# burn off gauges: worst-of, not merged-sum
+# ---------------------------------------------------------------------------
+
+class TestBurnFromGauges:
+    def test_shortest_window_wins(self):
+        assert burn_from_gauges({"slo.burn.60s": 2.5,
+                                 "slo.burn.300s": 1.0}) == 2.5
+
+    def test_garbled_doc_is_zero(self):
+        assert burn_from_gauges(None) == 0.0
+        assert burn_from_gauges({"slo.burn.xs": 1.0, "other": 3}) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# decision ledger
+# ---------------------------------------------------------------------------
+
+class TestDecisionLedger:
+    def test_ring_bound_counts_and_last(self):
+        led = DecisionLedger(ring=4)
+        for i in range(10):
+            led.record("out", 1, "burn_high", {"burn": float(i)},
+                       "spawned:0", target=2, actual=1)
+        snap = led.snapshot()
+        assert len(snap["decisions"]) == 4
+        assert snap["recorded"] == 10
+        assert snap["counts"] == {"out": 10}
+        assert snap["decisions"][-1]["seq"] == 9
+        assert led.last()["evidence"]["burn"] == 9.0
+
+    def test_monitor_counter_per_action(self, monitored):
+        led = DecisionLedger(ring=8)
+        led.record("out", 1, "burn_high", {}, "spawned:0", 1, 1)
+        led.record("in", -1, "sustained_idle", {}, "drained", 1, 1)
+        c = monitor.snapshot()["counters"]
+        assert c["autoscaler.decisions.out"] == 1
+        assert c["autoscaler.decisions.in"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the actuator
+# ---------------------------------------------------------------------------
+
+class TestReplicaPool:
+    def test_scale_out_until_healthy_then_graceful_scale_in(
+            self, fleet_flags, monitored):
+        store = _store()
+        router = FleetRouter(store)   # unstarted: tests drive refresh()
+        pool = ReplicaPool(router, _spawn_fn(store), spawn_timeout_s=10.0)
+        try:
+            res = pool.scale_out(2)
+            assert res["failed"] == 0 and len(res["ok"]) == 2
+            assert pool.actual() == 2 and pool.spawned == 2
+            assert set(pool.handles) == set(res["ok"])
+            # scale in: 'PDDR' drain + record AND lease reclaimed
+            results = pool.scale_in(1)
+            assert [r["outcome"] for r in results] == ["drained"]
+            rid = results[0]["replica"]
+            assert store.get(f"fleet:fleet:replica:{rid}") == b""
+            assert store.get(f"fleet:fleet:lease:{rid}") == b""
+            router.refresh()
+            assert pool.actual() == 1 and pool.drained == 1
+            c = monitor.snapshot()["counters"]
+            assert c["autoscaler.spawned"] == 2
+            assert c["autoscaler.drained"] == 1
+        finally:
+            pool.stop_all()
+            router.close()
+
+    def test_spawn_register_fault_is_counted_not_routed(
+            self, fleet_flags, monitored):
+        # ISSUE 17 satellite regression: a replica dying between spawn
+        # and its first 'PDHQ' answer must be reaped by the ledger, not
+        # routed to forever
+        store = _store()
+        router = FleetRouter(store)
+        pool = ReplicaPool(router, _spawn_fn(store), spawn_timeout_s=2.0)
+        try:
+            with faults.inject("replica.register:error"):
+                res = pool.scale_out(1)
+            assert res["ok"] == [] and res["failed"] == 1
+            assert "InjectedFault" in res["why"][0]
+            assert pool.spawn_failures == 1
+            assert pool.handles == {}
+            assert router.replicas == {}
+            c = monitor.snapshot()["counters"]
+            assert c["autoscaler.spawn_failures"] == 1
+        finally:
+            pool.stop_all()
+            router.close()
+
+    def test_never_healthy_spawn_is_reaped_record_and_all(
+            self, fleet_flags):
+        # the spawn "succeeds" but the replica never answers a 'PDHQ'
+        # (registered a record, then died): after the timeout the handle
+        # is stopped and forget() clears the store record + lease
+        store = _store()
+        router = FleetRouter(store)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+
+        class CorpseHandle:
+            replica_id = 5
+            stopped = False
+
+            def stop(self, drain=True):
+                self.stopped = True
+
+        handle = CorpseHandle()
+
+        def spawn():
+            store.set("fleet:fleet:replica:5", json.dumps(
+                {"host": "127.0.0.1", "port": dead_port, "pid": 0,
+                 "ts": 0.0}))
+            return handle
+
+        pool = ReplicaPool(router, spawn, spawn_timeout_s=0.6)
+        try:
+            res = pool.scale_out(1)
+            assert res["ok"] == [] and res["why"] == ["never_healthy"]
+            assert handle.stopped
+            assert 5 not in router.replicas
+            assert store.get("fleet:fleet:replica:5") == b""
+            router.refresh()   # the cleared record never re-joins
+            assert 5 not in router.replicas
+        finally:
+            pool.stop_all()
+            router.close()
+
+    def test_scale_in_victim_sigkilled_mid_drain_still_converges(
+            self, fleet_flags):
+        store = _store()
+        router = FleetRouter(store)
+        pool = ReplicaPool(router, _spawn_fn(store), spawn_timeout_s=10.0)
+        try:
+            (rid,) = pool.scale_out(1)["ok"]
+            # the victim dies between being picked and the 'PDDR'
+            # landing (its port is gone but the router still believes
+            # it healthy): the connection error is the verdict
+            pool.handles[rid].server.stop(drain=False)
+            results = pool.scale_in(1)
+            assert [r["outcome"] for r in results] == \
+                ["died_during_drain"]
+            assert store.get(f"fleet:fleet:replica:{rid}") == b""
+            assert store.get(f"fleet:fleet:lease:{rid}") == b""
+            assert rid not in router.replicas
+        finally:
+            pool.stop_all()
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# the control loop
+# ---------------------------------------------------------------------------
+
+class TestAutoscalerLoop:
+    def test_bootstrap_spawns_to_min_without_telemetry(self, fleet_flags):
+        store = _store()
+        router = FleetRouter(store)
+        pool = ReplicaPool(router, _spawn_fn(store), spawn_timeout_s=10.0)
+        auto = Autoscaler(None, pool,
+                          policy=_policy(min_replicas=1, cooldown_s=0.0),
+                          interval_s=999.0)
+        try:
+            d = auto.tick(now=0.0)
+            assert (d.action, d.reason) == ("out", "below_min")
+            assert pool.actual() == 1 and auto.target == 1
+            entry = auto.ledger.last()
+            assert entry["outcome"].startswith("spawned:")
+            # settled at the floor: the next tick holds
+            assert auto.tick(now=1.0).action == "hold"
+        finally:
+            auto.close()
+            router.close()
+
+    def test_sense_takes_worst_source_burn_not_the_sum(self, fleet_flags):
+        store = _store()
+        router = FleetRouter(store)
+        collector = _telemetry.TelemetryCollector(_store())  # unstarted
+        collector.sources["replica-0"] = _source(burn=0.4, queue=2)
+        collector.sources["replica-1"] = _source(burn=0.4, queue=4)
+        collector.sources["trainer-0"] = _source(burn=9.0, role="trainer")
+        collector.sources["replica-9"] = _source(burn=9.0, alive=False)
+        pool = ReplicaPool(router, _spawn_fn(store))
+        auto = Autoscaler(collector, pool, policy=_policy(),
+                          interval_s=999.0, queue_capacity=10)
+        try:
+            sig = auto._sense()
+            # two replicas at 0.4 each: the fleet signal is 0.4 (the
+            # worst source), NOT 0.8 (the merged-gauge sum) — and
+            # non-replica / dead sources never contribute
+            assert sig["burn"] == pytest.approx(0.4)
+            assert sig["alive_sources"] == 2
+            assert sig["queue_frac"] == pytest.approx(6 / 20)
+            assert sig["actual"] == 0
+        finally:
+            auto.close()
+            router.close()
+
+    def test_spawn_exhaustion_blocks_alerts_once_and_recovers(
+            self, fleet_flags, monitored):
+        store = _store()
+        router = FleetRouter(store)
+        collector = _telemetry.TelemetryCollector(_store())  # unstarted
+        collector.sources["replica-0"] = _source(burn=5.0)
+
+        def broken_spawn():
+            raise RuntimeError("substrate down")
+
+        pool = ReplicaPool(router, broken_spawn, spawn_timeout_s=1.0)
+        auto = Autoscaler(collector, pool,
+                          policy=_policy(min_replicas=0, cooldown_s=0.0),
+                          interval_s=999.0)
+        try:
+            for t in range(auto._spawn_retries + 2):
+                auto.tick(now=float(t))
+            # budget burned through: blocked, and the collector's
+            # scale_blocked alert fired exactly ONCE per transition
+            # even though the blocked ticks keep coming
+            assert auto._blocked_reason == "spawn_budget_exhausted"
+            alerts = [a for a in collector.alerts()
+                      if a["rule"] == "scale_blocked"]
+            assert len(alerts) == 1
+            assert alerts[0]["reason"] == "spawn_budget_exhausted"
+            alert_events = [e for e in collector.events
+                            if e.get("kind") == "alert"
+                            and (e.get("detail") or {}).get("rule")
+                            == "scale_blocked"]
+            assert len(alert_events) == 1
+            # `monitor top` renders the pool row with the verdict
+            doc = collector.snapshot_doc()
+            assert doc["pool"]["blocked"] is True
+            rendered = _telemetry.render_top(doc)
+            assert "pool: target=" in rendered
+            assert "BLOCKED: spawn_budget_exhausted" in rendered
+            assert monitor.snapshot()["counters"][
+                "autoscaler.spawn_failures"] >= auto._spawn_retries
+            # substrate recovers: the post-cooldown probe spawn succeeds,
+            # the budget refills and the alert clears
+            pool._spawn = _spawn_fn(store)
+            d = auto.tick(now=100.0)
+            assert d.action == "out"
+            assert pool.actual() == 1
+            assert auto._blocked_reason is None
+            assert auto._spawn_budget == auto._spawn_retries
+            assert collector.snapshot_doc()["pool"]["blocked"] is False
+            assert not [a for a in collector.alerts()
+                        if a["rule"] == "scale_blocked"]
+        finally:
+            auto.close()
+            router.close()
+
+    def test_idle_tenant_scale_to_zero_fires_once(self, tmp_path,
+                                                  fleet_flags, monitored):
+        store = _store()
+        agent = ReplicaAgent(lambda x: x * 2.0, store,
+                             engine_config=EngineConfig(**CFG)).start()
+        router = FleetRouter(store)
+        pool = ReplicaPool(router, _spawn_fn(store))
+        before = _flags.flag("autoscaler_tenant_idle_s")
+        _flags.set_flags({"autoscaler_tenant_idle_s": 5.0})
+        auto = Autoscaler(None, pool,
+                          policy=_policy(min_replicas=1), interval_s=999.0)
+        try:
+            d = str(tmp_path / "m")
+            if guard_state_version(d) == 0:
+                save_guard_state(d, {"w": np.ones((4,), np.float32)}, {})
+            tenant = ModelTenant("m", d, lambda arrays, meta:
+                                 (lambda x: x * arrays["w"]),
+                                 engine_config=EngineConfig(**CFG),
+                                 slo=SloPlane(latency_ms=1000, target=0.9))
+            agent.host_model(tenant)
+            tenant.last_used = time.monotonic() - 100.0
+            router.refresh()   # the probe snapshots idle_s ≈ 100
+            auto.tick(now=0.0)
+            assert "m" not in agent.tenants
+            entries = [e for e in auto.ledger.snapshot()["decisions"]
+                       if e["action"] == "evict_tenant"]
+            assert len(entries) == 1
+            assert entries[0]["evidence"]["model"] == "m"
+            c = monitor.snapshot()["counters"]
+            assert c["autoscaler.tenants_evicted"] == 1
+            assert c["fleet.models_evicted"] == 1
+            # the sweep is edge-complete: an evicted tenant is gone from
+            # the next probe, so the next tick has nothing to evict
+            router.refresh()
+            auto.tick(now=1.0)
+            assert monitor.snapshot()["counters"][
+                "autoscaler.tenants_evicted"] == 1
+        finally:
+            _flags.set_flags({"autoscaler_tenant_idle_s": before})
+            auto.close()
+            agent.stop(drain=False)
+            router.close()
+
+    def test_loop_thread_lifecycle_and_dump(self, tmp_path, fleet_flags):
+        store = _store()
+        router = FleetRouter(store)
+        pool = ReplicaPool(router, _spawn_fn(store))
+        auto = Autoscaler(None, pool, policy=_policy(min_replicas=0),
+                          interval_s=0.05).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while auto.ticks == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert auto.ticks > 0
+            auto.ledger.record("out", 1, "burn_high", {"burn": 2.0},
+                               "spawned:0", 1, 1)
+            path = auto.dump(str(tmp_path / "dump.json"))
+            with open(path) as f:
+                doc = json.load(f)
+            led = doc["extra"]["autoscaler"]["ledger"]
+            assert led["decisions"][-1]["reason"] == "burn_high"
+            assert doc["extra"]["autoscaler"]["policy"]["max"] == 4
+        finally:
+            auto.close()
+            router.close()
+        assert auto._closed and auto._thread is None

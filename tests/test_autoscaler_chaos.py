@@ -1,0 +1,322 @@
+"""Autoscaler chaos acceptance (slow tier): a 10x traffic spike against
+one warm replica must grow the pool (each new replica warm-started with
+ZERO compiles via the persistent compile cache), recover the burn signal
+within a bounded window, keep the SequenceLedger audit clean (nothing
+lost, nothing duplicated), and converge back to the floor after the
+spike — even with a SIGKILL landing mid-scale-in."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu._native import TCPStore
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.inference.server import PredictorClient
+from paddle_tpu.obs import telemetry as _telemetry
+from paddle_tpu.serving import (Autoscaler, FleetRouter, ReplicaPool,
+                                ScalePolicy)
+
+FAST_FLEET = {"fleet_heartbeat_s": 0.1, "fleet_lease_ttl_s": 0.4,
+              "fleet_health_interval_s": 0.1}
+
+
+@pytest.fixture()
+def fleet_flags():
+    before = {k: _flags.flag(k) for k in FAST_FLEET}
+    _flags.set_flags(FAST_FLEET)
+    yield
+    _flags.set_flags(before)
+
+
+@pytest.fixture()
+def monitored():
+    monitor.reset()
+    _flags.set_flags({"monitor": True})
+    yield monitor
+    _flags.set_flags({"monitor": False})
+    monitor.reset()
+
+
+def _store():
+    return TCPStore("127.0.0.1", 0, is_master=True)
+
+
+class SubprocessReplica:
+    """The pool handle over one autoscaler_replica_runner.py child:
+    `replica_id`/`poll` for the spawn loop, graceful `stop` (stdin line
+    -> drain -> the runner's warm-start JSON report), `kill` for chaos."""
+
+    def __init__(self, proc, replica_id, host, port):
+        self.proc = proc
+        self.replica_id = replica_id
+        self.host = host
+        self.port = int(port)
+        self.report = None
+
+    def poll(self):
+        return self.proc.poll()
+
+    def stop(self, drain=True):
+        if self.proc.poll() is None:
+            try:
+                self.proc.stdin.write(b"done\n")
+                self.proc.stdin.flush()
+                self.proc.wait(timeout=60)
+            except Exception:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+        if self.report is None:
+            try:
+                out = self.proc.stdout.read() or b""
+                for line in reversed(
+                        out.decode(errors="replace").splitlines()):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        self.report = json.loads(line)
+                        break
+            except Exception:
+                pass
+
+    def kill(self):
+        self.proc.kill()
+        self.proc.wait(timeout=10)
+
+
+def _spawn_factory(store, fleet, tmp_path, cache_dir, all_handles):
+    def spawn():
+        tag = len(all_handles)
+        port_file = str(tmp_path / f"replica-{tag}.port")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", FLAGS_monitor="1",
+                   FLAGS_telemetry="1", FLAGS_telemetry_interval_s="0.05",
+                   FLAGS_slo_latency_ms="100", FLAGS_slo_target="0.9",
+                   FLAGS_slo_windows="5,60",
+                   FLAGS_serving_queue_depth="2",
+                   FLAGS_compile_cache_dir=cache_dir)
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__),
+                          "autoscaler_replica_runner.py"),
+             store.host, str(store.port), fleet, port_file],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
+        deadline = time.monotonic() + 90
+        while not os.path.exists(port_file):
+            assert proc.poll() is None, "replica died during startup"
+            assert time.monotonic() < deadline, "replica never registered"
+            time.sleep(0.05)
+        rid, host, port = open(port_file).read().split()
+        handle = SubprocessReplica(proc, int(rid), host, port)
+        all_handles.append(handle)
+        return handle
+    return spawn
+
+
+@pytest.mark.slow
+class TestAutoscaleChaos:
+    def test_spike_grows_pool_recovers_and_audits_clean(
+            self, tmp_path, fleet_flags, monitored):
+        store = _store()
+        fleet = "autoscale"
+        cache_dir = str(tmp_path / "compile-cache")
+        collector = _telemetry.TelemetryCollector(store, fleet=fleet)
+        collector.start()
+        router = FleetRouter(store, fleet=fleet).start()
+        all_handles = []
+        pool = ReplicaPool(
+            router, _spawn_factory(store, fleet, tmp_path, cache_dir,
+                                   all_handles),
+            spawn_timeout_s=90.0)
+        # queue thresholds parked high: the drill's scale signal is the
+        # burn — and a frozen post-traffic queue gauge must not wedge
+        # the policy inside the hysteresis band
+        policy = ScalePolicy(burn_high=1.0, burn_low=0.25,
+                             queue_high=0.98, queue_low=0.9,
+                             min_replicas=1, max_replicas=3,
+                             cooldown_s=2.0, idle_after_s=4.0,
+                             zero_after_s=3600.0, step=1)
+        auto = Autoscaler(collector, pool, policy=policy,
+                          interval_s=0.25, queue_capacity=2)
+        stop_spike = threading.Event()
+        stop_trickle = threading.Event()
+        outcomes, lock = [], threading.Lock()
+
+        def client(stop_ev):
+            k = 0
+            while not stop_ev.is_set():
+                k += 1
+                try:
+                    st, _ = router.run(
+                        [np.full((1, 4), float(k), np.float32)],
+                        deadline_ms=8000)
+                    with lock:
+                        outcomes.append(st)
+                except Exception as e:
+                    with lock:
+                        outcomes.append(repr(e))
+
+        def worst_burn():
+            return max([float(r.get("burn") or 0.0)
+                        for r in collector.fleet_table()
+                        if r.get("alive") and r.get("role") == "replica"]
+                       or [0.0])
+
+        threads = []
+        try:
+            # ---- steady state: the floor replica (cold: it PRIMES the
+            # compile cache for every later spawn) ----------------------
+            auto.start()
+            deadline = time.monotonic() + 120
+            while pool.actual() < 1 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert pool.actual() == 1, "bootstrap to min_replicas failed"
+            floor_rid = all_handles[0].replica_id
+
+            # ---- the 10x spike ----------------------------------------
+            spike_at = time.monotonic()
+            threads = [threading.Thread(target=client,
+                                        args=(stop_spike,))
+                       for _ in range(16)]
+            [t.start() for t in threads]
+            deadline = time.monotonic() + 60
+            while pool.actual() < 2 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            t_first_new = time.monotonic() - spike_at
+            assert pool.actual() >= 2, (
+                f"spike never grew the pool: burn={worst_burn()}, "
+                f"ledger={auto.ledger.last()}")
+            assert t_first_new < 60.0
+            # keep pressing: the pool must climb to max (the pressure is
+            # sized so two replicas still burn budget)
+            deadline = time.monotonic() + 45
+            while pool.actual() < 3 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert pool.actual() == 3, (
+                f"pool stalled at {pool.actual()}: burn={worst_burn()}, "
+                f"ledger={auto.ledger.last()}")
+            # every replica serves a few requests DIRECTLY — the
+            # warm-start acceptance below ("first request with zero
+            # trace compiles") must not depend on a late spawn winning
+            # router traffic before the spike subsides
+            for h in all_handles:
+                if h.poll() is not None:
+                    continue
+                c = PredictorClient(h.host, h.port, failover=False)
+                try:
+                    for _ in range(3):
+                        st, _out = c.run(
+                            [np.full((1, 4), 1.0, np.float32)],
+                            deadline_ms=8000)
+                finally:
+                    c.close()
+
+            # ---- spike subsides to a trickle: the burn signal must
+            # recover below the scale-out threshold in a bounded window
+            stop_spike.set()
+            [t.join(timeout=30) for t in threads]
+            trickle = [threading.Thread(target=client,
+                                        args=(stop_trickle,))
+                       for _ in range(2)]
+            [t.start() for t in trickle]
+            threads = trickle
+            recovered_at = None
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                b = worst_burn()
+                if recovered_at is None and b < policy.burn_high:
+                    recovered_at = time.monotonic()
+                if b < policy.burn_low:   # decayed enough that the
+                    break                 # frozen gauge reads calm
+                time.sleep(0.25)
+            assert recovered_at is not None, (
+                f"burn never recovered below {policy.burn_high}: "
+                f"{worst_burn()}")
+            # fully calm before the traffic stops: the burn gauge
+            # freezes at its last published value, and a value stuck in
+            # the hysteresis band would block every idle scale-in
+            assert worst_burn() < policy.burn_low
+
+            # ---- SIGKILL mid-scale-in: wait for the first idle drain
+            # to be RECORDED, then a victim dies out from under the
+            # control loop while it is still working the pool down -----
+            stop_trickle.set()
+            [t.join(timeout=30) for t in threads]
+            threads = []
+            deadline = time.monotonic() + 45
+            while (auto.ledger.snapshot()["counts"].get("in", 0) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+            assert auto.ledger.snapshot()["counts"].get("in", 0) >= 1, (
+                f"no idle scale-in fired: {auto.ledger.last()}")
+            spawned = [h for h in all_handles
+                       if h.replica_id != floor_rid
+                       and h.poll() is None]
+            assert spawned, "no spike-spawned replica to chaos"
+            victim = spawned[0]
+            victim.kill()
+            # converge to exactly the floor: the kill can momentarily
+            # leave 0 alive (if the drain already took the floor
+            # replica) — below_min respawns back up to 1
+            deadline = time.monotonic() + 90
+            while pool.actual() != 1 and time.monotonic() < deadline:
+                time.sleep(0.2)
+            assert pool.actual() == 1, (
+                f"pool never converged to the floor: "
+                f"{[h.replica_id for h in router.healthy_replicas()]}")
+            # the SIGKILLed victim's record was reaped, not re-probed
+            deadline = time.monotonic() + 15
+            while (store.get(f"fleet:{fleet}:replica:"
+                             f"{victim.replica_id}") != b""
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+            assert store.get(
+                f"fleet:{fleet}:replica:{victim.replica_id}") == b""
+            assert victim.replica_id not in router.replicas
+
+            # ---- the soak's contract ----------------------------------
+            n = len(outcomes)
+            assert n > 100, f"burst too small to mean anything: {n}"
+            errors = [o for o in outcomes if not isinstance(o, int)]
+            assert len(errors) / n <= 0.01, (
+                f"error rate {len(errors)}/{n}: {errors[:5]}")
+            a = router.ledger.audit()
+            assert a["lost"] == 0, a
+            assert a["open"] == 0, a
+            assert a["settled"] + a["rejected"] == a["issued"], a
+            led = auto.ledger.snapshot()
+            assert led["counts"].get("out", 0) >= 1
+            assert led["counts"].get("in", 0) >= 1
+
+            # ---- warm-start acceptance: graceful stops yield reports --
+            auto.close(stop_pool=True)
+            reports = {h.replica_id: h.report for h in all_handles
+                       if h.report is not None}
+            floor_report = reports.get(floor_rid)
+            assert floor_report is not None
+            # the floor replica was COLD: it paid the trace compiles and
+            # stored the executables every later spawn loads
+            assert floor_report["trace_compile"] > 0
+            assert floor_report["warm_start"]["stores"] > 0
+            warm = [r for rid, r in reports.items() if rid != floor_rid]
+            assert warm, "no spike-spawned replica survived to report"
+            for r in warm:
+                # spawned into the primed cache: served real traffic
+                # with ZERO trace compiles (the jit ledger counter)
+                assert r["trace_compile"] == 0, r
+                assert r["warm_start"]["hits"] > 0, r
+                assert r["served"] > 0, r
+        finally:
+            stop_spike.set()
+            stop_trickle.set()
+            [t.join(timeout=30) for t in threads]
+            auto.close(stop_pool=True)
+            for h in all_handles:
+                if h.poll() is None:
+                    h.stop(drain=False)
+            router.close()
+            collector.stop()

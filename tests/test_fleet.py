@@ -385,6 +385,58 @@ class TestFleetRouting:
         finally:
             agent.stop(drain=False)
 
+    def test_corpse_record_is_reaped_not_probed_forever(self, fleet_flags,
+                                                        monitored):
+        # ISSUE 17 regression: a replica that registered its record and
+        # then died before its first 'PDHQ' answer (no lease, dead port)
+        # must be reaped from membership — record cleared — once it has
+        # been dead past the reap window, not re-probed on every sweep
+        store = _store()
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        store.set("fleet:fleet:replica:3", json.dumps(
+            {"host": "127.0.0.1", "port": dead_port, "pid": 0, "ts": 0.0}))
+        router = FleetRouter(store)
+        try:
+            router.refresh()
+            assert 3 in router.replicas   # discovered, probe failed
+            assert not router.replicas[3].healthy
+            deadline = time.monotonic() + 5.0
+            while 3 in router.replicas and time.monotonic() < deadline:
+                time.sleep(0.1)
+                router.refresh()
+            assert 3 not in router.replicas
+            assert store.get("fleet:fleet:replica:3") == b""
+            router.refresh()   # the cleared record never re-joins
+            assert 3 not in router.replicas
+            counters = monitor.snapshot()["counters"]
+            assert counters["fleet.replicas_reaped"] == 1
+        finally:
+            router.close()
+
+    def test_live_replica_is_never_reaped_by_its_lease(self, fleet_flags):
+        # the reap gate is the LEASE: a slow-to-answer but heartbeating
+        # replica keeps its membership even after the reap window
+        store = _store()
+        agent = _agent(store)
+        router = FleetRouter(store)
+        try:
+            router.refresh()
+            rid = agent.replica_id
+            assert rid in router.replicas
+            # wedge the probe's view: force-mark it dead long enough ago
+            # that the reap window has elapsed — the live lease vetoes
+            h = router.replicas[rid]
+            h.healthy = False
+            h.detected_dead_at = time.monotonic() - 60.0
+            assert router._reap_if_corpse(h) is False
+            assert rid in router.replicas
+        finally:
+            router.close()
+            agent.stop(drain=False)
+
 
 # ---------------------------------------------------------------------------
 # multi-model hosting under an HBM budget + per-tenant SLO isolation
