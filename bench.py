@@ -1197,6 +1197,154 @@ def bench_autoscale(backend):
     return out
 
 
+def bench_net(backend):
+    """One-wire substrate tax A/B (utils/net.py): serving request p99
+    and PS dense-push throughput through the RpcChannel substrate vs a
+    hand-rolled PRE-substrate wire client (same bytes, no channel, no
+    fault sites, no retry loop) against the same live servers — the tax
+    target is <=2% on both. A third arm re-runs the substrate clients
+    with FLAGS_net_auth_token set, measuring what the 'PDAR' HMAC
+    record layer costs when the fleet flips the one security flag.
+
+    Knob: BENCH_NET=ab|off (default ab runs both arms)."""
+    import socket as _socket
+    import struct as _struct
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.distributed.ps.service import (_HDR, CMD_PUSH_DENSE,
+                                                   PsClient, PsServer,
+                                                   _tname)
+    from paddle_tpu.inference.server import (_REQ_MAGIC, PredictorClient,
+                                             PredictorServer,
+                                             _read_tensor, _write_tensor)
+    from paddle_tpu.serving import EngineConfig
+
+    if os.environ.get("BENCH_NET", "ab").lower() == "off":
+        return {"skipped": "BENCH_NET=off"}
+
+    n_req = 400 if backend == "tpu" else 200
+    n_push = 300 if backend == "tpu" else 150
+    dense_n = 4096
+    x = np.random.rand(1, 16).astype(np.float32)
+    g = np.ones(dense_n, np.float32)
+
+    srv = PredictorServer(lambda a: a, engine_config=EngineConfig(
+        warmup_on_start=False)).start()
+    ps = PsServer()
+    ps.add_dense_table("w", dense_n, lr=0.1)
+    ps.run()
+
+    def serving_p99_legacy():
+        s = _socket.create_connection((srv.host, srv.port), timeout=30)
+        s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+
+        def one():
+            s.sendall(_struct.pack("<II", _REQ_MAGIC, 1))
+            _write_tensor(s, x)
+            hdr = b""
+            while len(hdr) < 9:
+                hdr += s.recv(9 - len(hdr))
+            _read_tensor(s)
+
+        try:
+            for _ in range(20):
+                one()                     # warm the bucket executable
+            p99s = []
+            for _ in range(3):
+                lat = []
+                for _ in range(n_req):
+                    t0 = time.perf_counter()
+                    one()
+                    lat.append(time.perf_counter() - t0)
+                p99s.append(float(np.quantile(lat, 0.99)))
+            return float(np.median(p99s)) * 1e6
+        finally:
+            s.close()
+
+    def serving_p99_substrate():
+        client = PredictorClient(srv.host, srv.port)
+        try:
+            for _ in range(20):
+                client.run([x])
+            p99s = []
+            for _ in range(3):
+                lat = []
+                for _ in range(n_req):
+                    t0 = time.perf_counter()
+                    client.run([x])
+                    lat.append(time.perf_counter() - t0)
+                p99s.append(float(np.quantile(lat, 0.99)))
+            return float(np.median(p99s)) * 1e6
+        finally:
+            client.close()
+
+    def push_rate_legacy():
+        s = _socket.create_connection((ps.host, ps.port), timeout=30)
+        s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        frame = _HDR.pack(CMD_PUSH_DENSE, _tname("w"), dense_n, 0) \
+            + g.tobytes()
+        try:
+            rates = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(n_push):
+                    s.sendall(frame)
+                    if s.recv(1) != b"\x01":
+                        raise RuntimeError("push rejected")
+                rates.append(n_push / (time.perf_counter() - t0))
+            return float(np.median(rates))
+        finally:
+            s.close()
+
+    def push_rate_substrate():
+        client = PsClient([f"{ps.host}:{ps.port}"], call_timeout=30.0)
+        try:
+            client.push_dense("w", g)     # learn the shard split first
+            rates = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(n_push):
+                    client.push_dense("w", g)
+                rates.append(n_push / (time.perf_counter() - t0))
+            return float(np.median(rates))
+        finally:
+            client.close()
+
+    try:
+        p99_legacy = serving_p99_legacy()
+        p99_sub = serving_p99_substrate()
+        push_legacy = push_rate_legacy()
+        push_sub = push_rate_substrate()
+        # flag flip: fresh connections negotiate the HMAC record layer
+        _flags.set_flags({"net_auth_token": "bench-token"})
+        try:
+            p99_auth = serving_p99_substrate()
+            push_auth = push_rate_substrate()
+        finally:
+            _flags.set_flags({"net_auth_token": ""})
+    finally:
+        srv.stop()
+        ps.stop()
+
+    return {
+        "requests_per_arm": n_req,
+        "pushes_per_arm": n_push,
+        "serving_p99_us_legacy": round(p99_legacy, 1),
+        "serving_p99_us_substrate": round(p99_sub, 1),
+        "serving_p99_tax_pct": round(
+            (p99_sub - p99_legacy) / p99_legacy * 100, 2),
+        "serving_p99_us_auth": round(p99_auth, 1),
+        "serving_auth_overhead_pct": round(
+            (p99_auth - p99_sub) / p99_sub * 100, 2),
+        "ps_push_per_s_legacy": round(push_legacy, 1),
+        "ps_push_per_s_substrate": round(push_sub, 1),
+        "ps_push_tax_pct": round(
+            (push_legacy - push_sub) / push_legacy * 100, 2),
+        "ps_push_per_s_auth": round(push_auth, 1),
+        "ps_push_auth_overhead_pct": round(
+            (push_sub - push_auth) / push_sub * 100, 2),
+    }
+
+
 def bench_ps_durability(backend):
     """PS durability tax A/B: sequenced sparse-push throughput with the
     WAL off vs on (FLAGS_ps_wal_dir), plus the recovery path timed —
@@ -1388,6 +1536,7 @@ def main():
                     ("serving_slo", bench_serving_slo),
                     ("telemetry", bench_telemetry),
                     ("autoscale", bench_autoscale),
+                    ("net", bench_net),
                     ("ps_durability", bench_ps_durability),
                     ("llm", bench_llm),
                     ("warm_start", bench_warm_start)):

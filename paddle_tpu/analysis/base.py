@@ -74,6 +74,11 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "iteration flushes the pending lazy segment, re-serializing "
          "dispatch the executor was batching; hoist the sync out of the "
          "hot loop (or accumulate on device and sync once after it)"),
+    Rule("raw-socket", Severity.WARNING,
+         "socket.recv/sendall/create_connection outside utils/net.py — "
+         "raw wire I/O bypasses the unified RPC substrate (deadlines, "
+         "retries, auth/TLS, fault sites, wire-health counters); route "
+         "through RpcChannel/RpcServer or the net.py helpers"),
     Rule("buffer-retain", Severity.INFO,
          "advisory: a self./cls. attribute assigned from a per-step tensor "
          "inside a loop body — the held reference outlives the step, "

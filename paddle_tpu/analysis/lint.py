@@ -48,6 +48,12 @@ _PARAMISH_RE = re.compile(r"param|grad|slot|moment|velocit", re.IGNORECASE)
 _ARRAY_CALL_ROOTS = {"jnp", "jax", "lax", "paddle", "run_op"}
 # default values that mark a parameter as non-tensor config
 _SCALAR_DEFAULT_TYPES = (bool, int, float, str, bytes, type(None))
+# raw socket operations that belong in the substrate (utils/net.py);
+# the substrate itself and the C-API mirror (csrc/predict_capi.cpp
+# callers) are exempt by path
+_RAW_SOCKET_CALLS = {"recv", "sendall", "create_connection"}
+_RAW_SOCKET_EXEMPT_RE = re.compile(
+    r"(^|[/\\])(utils[/\\]net\.py$|csrc[/\\])")
 
 
 def _dotted(node) -> Tuple[str, ...]:
@@ -208,10 +214,11 @@ class _RegionLinter(ast.NodeVisitor):
     lambdas included — the traced region covers them)."""
 
     def __init__(self, path: str, func: str, tainted: Set[str],
-                 full: bool):
+                 full: bool, raw_socket_exempt: bool = False):
         self.path, self.func = path, func
         self.taint = _Taint(tainted)
         self.full = full            # taint-based rules enabled
+        self.raw_socket_exempt = raw_socket_exempt
         self.findings: List[Finding] = []
         self._loop_depth = 0        # For/While bodies (lazy-sync advisory)
         # names carrying per-iteration values (loop targets + names
@@ -266,6 +273,14 @@ class _RegionLinter(ast.NodeVisitor):
                 self._add_sync(node,
                                f"{'.'.join(chain)}(tensor) pulls a traced "
                                "value to the host")
+        if len(chain) > 1 and chain[-1] in _RAW_SOCKET_CALLS \
+                and not self.raw_socket_exempt:
+            self._add("raw-socket", node,
+                      f".{chain[-1]}() is raw socket I/O outside "
+                      "utils/net.py — it bypasses the unified RPC "
+                      "substrate (deadlines, retries, auth/TLS, fault "
+                      "sites); route through RpcChannel/RpcServer or the "
+                      "net.py helpers")
         self.generic_visit(node)
 
     # -- control flow on tensors / shapes --
@@ -459,7 +474,9 @@ def lint_source(source: str, path: str = "<string>",
             continue                     # dunders are never traced regions
         tainted = _taint_fixpoint(fdef, _seed_params(fdef)) if traced \
             else set()
-        linter = _RegionLinter(path, fdef.name, tainted, full=traced)
+        linter = _RegionLinter(
+            path, fdef.name, tainted, full=traced,
+            raw_socket_exempt=bool(_RAW_SOCKET_EXEMPT_RE.search(path)))
         for stmt in fdef.body:
             linter.visit(stmt)
         findings.extend(linter.findings)

@@ -371,6 +371,33 @@ define_flag("telemetry_incident_min_interval_s", 30.0,
             "fan-outs — a crash loop yields one fleet-wide dump set per "
             "window, not a dump storm")
 
+# ---- unified RPC substrate (utils/net.py) ---------------------------------
+define_flag("net_auth_token", "",
+            "RPC substrate: shared secret enabling per-frame HMAC auth "
+            "on EVERY plane at once (serving, PS, bus, telemetry) — "
+            "clients open each connection with a 'PDAH' challenge "
+            "handshake and both sides speak 'PDAR' HMAC-SHA256 records; "
+            "unauthenticated peers are rejected and counted "
+            "(net.auth_rejects). Empty = off: the wire stays "
+            "byte-identical to the pre-substrate protocols")
+define_flag("net_tls_cert", "",
+            "RPC substrate: path to a PEM cert chain — set together "
+            "with net_tls_key to wrap every plane's listener in TLS "
+            "(clients also present it for mutual TLS); empty = off")
+define_flag("net_tls_key", "",
+            "RPC substrate: path to the PEM private key for "
+            "net_tls_cert (empty = key lives in the cert file)")
+define_flag("net_tls_ca", "",
+            "RPC substrate: path to the PEM CA bundle peers are "
+            "verified against — on clients it turns on server "
+            "verification, on servers it requires client certs")
+define_flag("net_deadline_wire", False,
+            "RPC substrate: prefix every request with a 'PDDL' "
+            "absolute-deadline frame so servers DROP expired work "
+            "(net.deadline_drops) instead of computing it. Off by "
+            "default: pre-substrate peers reject the unknown magic, so "
+            "flip it only on same-version deployments")
+
 # ---- SLO-driven autoscaler (serving/autoscaler.py) ------------------------
 define_flag("autoscaler_interval_s", 0.5,
             "autoscaler: control-loop tick period — each tick senses the "

@@ -43,6 +43,16 @@ except ImportError:
     _flags = None
 
 try:
+    from ..utils import net as _net
+except ImportError:
+    _net = None  # spec-loaded standalone: raw-socket fallback transport
+
+# The bus codec reads frames on substrate-accepted connections, and the
+# spec-loaded standalone runner keeps a raw-socket fallback transport
+# (no package, no substrate) — both are deliberate, not a bypass.
+# tpu-lint: disable=raw-socket
+
+try:
     from ..obs import trace as _trace
 except ImportError:
     class _NullBusSpan:  # standalone runner: tracing plane disabled
@@ -379,10 +389,14 @@ class DistMessageBus(MessageBus):
         self._peer_locks: Dict[int, threading.Lock] = {}  # serialize frames
         self._stop = threading.Event()
 
-        self._lsock = _socket.socket()
-        self._lsock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
-        self._lsock.bind((host, 0))
-        self._lsock.listen(16)
+        if _net is not None:
+            self._lsock = _net.make_listener(host, 0, backlog=16)
+        else:
+            self._lsock = _socket.socket()
+            self._lsock.setsockopt(_socket.SOL_SOCKET,
+                                   _socket.SO_REUSEADDR, 1)
+            self._lsock.bind((host, 0))
+            self._lsock.listen(16)
         self._port = self._lsock.getsockname()[1]
         store.set(f"fleetbus/{rank}", f"{host}:{self._port}")
         self._accept_thread = threading.Thread(target=self._accept_loop,
@@ -410,34 +424,66 @@ class DistMessageBus(MessageBus):
                 conn, _ = self._lsock.accept()
             except OSError:
                 return
+            if _net is not None:
+                try:
+                    conn = _net.secure_server(conn, "bus")
+                except (_net.AuthError, OSError, ValueError):
+                    continue  # unauthenticated peer: counted + dropped
             threading.Thread(target=self._reader, args=(conn,),
                              daemon=True).start()
 
     def _reader(self, conn):
         import struct as _struct
+
+        def _read_exact(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = conn.recv(min(1 << 20, n - len(buf)))
+                if not chunk:
+                    return None
+                buf += chunk
+            return buf
+
         try:
             while True:
-                hdr = b""
-                while len(hdr) < 8:
-                    chunk = conn.recv(8 - len(hdr))
-                    if not chunk:
-                        return
-                    hdr += chunk
+                hdr = _read_exact(8)
+                if hdr is None:
+                    return
                 (ln,) = _struct.unpack("<q", hdr)
-                data = b""
-                while len(data) < ln:
-                    chunk = conn.recv(min(1 << 20, ln - len(data)))
-                    if not chunk:
+                tctx = None
+                if _net is not None and ln == _net.BUS_TRACE_SENTINEL:
+                    # substrate trace carriage: the sentinel length
+                    # prefixes `u32 ctx_len + ctx + i64 real_len`;
+                    # untraced frames keep the legacy framing bit-for-bit
+                    chdr = _read_exact(4)
+                    if chdr is None:
                         return
-                    data += chunk
+                    (clen,) = _struct.unpack("<I", chdr)
+                    if clen > 1024:
+                        return  # corrupt carriage: unrecoverable stream
+                    ctx_raw = _read_exact(clen)
+                    lhdr = _read_exact(8)
+                    if ctx_raw is None or lhdr is None:
+                        return
+                    (ln,) = _struct.unpack("<q", lhdr)
+                    try:
+                        tctx = _trace.unpack_ctx(ctx_raw)
+                    except Exception:
+                        tctx = None  # a trace must never break the bus
+                if ln < 0:
+                    return  # corrupt length: unrecoverable stream
+                data = _read_exact(ln)
+                if data is None:
+                    return
                 if _faults._ENABLED:
+                    _faults.check("net.bus.recv")
                     _faults.check("bus.recv")
-                # tolerant unpack: traced peers append a 6th element (the
-                # packed trace ctx); legacy peers send the plain 5-tuple
+                # tolerant unpack: legacy traced peers append a 6th
+                # element (the packed trace ctx); plain peers send the
+                # 5-tuple; the substrate carriage above wins when present
                 src, dst, kind, payload, micro, *rest = \
                     self._pickle.loads(data)
-                tctx = None
-                if rest:
+                if tctx is None and rest:
                     try:
                         tctx = _trace.unpack_ctx(rest[0])
                     except Exception:
@@ -468,10 +514,25 @@ class DistMessageBus(MessageBus):
                 lk = self._peer_locks[r] = threading.Lock()
             return lk
 
+    def _chan(self, r: int):
+        # substrate channel per peer: owns connect/reconnect (counted as
+        # bus.reconnects) and the net.bus.send / bus.send fault sites.
+        # Caller holds the PER-PEER lock; _conn_lock only guards the map.
+        with self._conn_lock:
+            ch = self._conns.get(r)
+            if ch is None:
+                ch = self._conns[r] = _net.RpcChannel(
+                    "bus", endpoint=self.endpoints[r],
+                    connect_timeout=60,
+                    legacy_sites=("bus.send", None),
+                    legacy_reconnect_counter="bus.reconnects")
+            return ch
+
     def _remote_sock(self, r: int):
-        # caller holds the PER-PEER lock; _conn_lock only guards the map,
-        # so one slow peer's connect/send cannot head-of-line block sends
-        # to every other peer
+        # standalone raw-socket fallback (the in-package path rides
+        # _chan); caller holds the PER-PEER lock, _conn_lock only guards
+        # the map, so one slow peer's connect/send cannot head-of-line
+        # block sends to every other peer
         with self._conn_lock:
             sk = self._conns.get(r)
         if sk is None:
@@ -491,6 +552,12 @@ class DistMessageBus(MessageBus):
     def _drop_conn(self, r: int):
         # a failed send leaves the stream mid-frame: close and forget so
         # the retry opens a FRESH connection (frames never straddle one)
+        if _net is not None:
+            with self._conn_lock:
+                ch = self._conns.get(r)
+            if ch is not None:
+                ch.drop()
+            return
         with self._conn_lock:
             sk = self._conns.pop(r, None)
         if sk is not None:
@@ -510,9 +577,10 @@ class DistMessageBus(MessageBus):
             self._inboxes[msg.dst].put(msg)
             return
         # serialize as a plain tuple: Message's defining module may be
-        # loaded under a different name in the peer (spec-loaded runners)
-        # — a packed trace ctx rides along as an OPTIONAL 6th element so
-        # untraced frames stay bit-identical to the legacy 5-tuple
+        # loaded under a different name in the peer (spec-loaded runners).
+        # Trace carriage rides the SUBSTRATE frame (sentinel length +
+        # packed ctx) so untraced frames stay bit-identical to the legacy
+        # 5-tuple; the standalone fallback keeps the 6th-element shim.
         tctx = None
         sp = _trace.NULL_SPAN
         if _trace._ENABLED:
@@ -521,24 +589,40 @@ class DistMessageBus(MessageBus):
                                     attrs={"dst": msg.dst,
                                            "kind": msg.kind})
         tup = (msg.src, msg.dst, msg.kind, msg.payload, msg.micro)
-        if tctx is not None:
-            tup = tup + (_trace.pack_ctx(tctx),)
-        data = self._pickle.dumps(
-            tup, protocol=self._pickle.HIGHEST_PROTOCOL)
-        frame = self._struct.pack("<q", len(data)) + data
+        ctx_raw = _trace.pack_ctx(tctx) if tctx is not None else b""
+        if ctx_raw and _net is not None:
+            data = self._pickle.dumps(
+                tup, protocol=self._pickle.HIGHEST_PROTOCOL)
+            frame = (self._struct.pack("<q", _net.BUS_TRACE_SENTINEL)
+                     + self._struct.pack("<I", len(ctx_raw)) + ctx_raw
+                     + self._struct.pack("<q", len(data)) + data)
+        else:
+            if ctx_raw:
+                tup = tup + (ctx_raw,)  # legacy 6-tuple shim (standalone)
+            data = self._pickle.dumps(
+                tup, protocol=self._pickle.HIGHEST_PROTOCOL)
+            frame = self._struct.pack("<q", len(data)) + data
         import time as _time
         with self._peer_lock(owner):
             delay = self._send_backoff
             last: Optional[BaseException] = None
             for attempt in range(self._send_retries + 1):
                 if attempt:
+                    if _net is not None:
+                        _net._count("net.retries")
+                        _net._count("net.bus.retries")
                     _time.sleep(delay)
                     delay = min(delay * 2, 2.0)
                 try:
-                    if _faults._ENABLED:
-                        _faults.check("bus.send")
-                    sk = self._remote_sock(owner)
-                    sk.sendall(frame)
+                    if _net is not None:
+                        # fires net.bus.send + bus.send fault sites,
+                        # reconnects (counted) through the channel
+                        self._chan(owner).sendall(frame)
+                    else:
+                        if _faults._ENABLED:
+                            _faults.check("bus.send")
+                        sk = self._remote_sock(owner)
+                        sk.sendall(frame)
                     sp.end(retries=attempt)
                     return
                 except OSError as e:

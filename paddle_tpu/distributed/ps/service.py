@@ -36,6 +36,11 @@ from ... import monitor as _monitor
 from ...core import flags as _flags
 from ...obs import trace as _trace
 
+# The PS codec reads/writes CMD_* frames on connections the substrate
+# (utils/net.py RpcChannel / secure_server / dial) owns and hands out —
+# those raw send/recv calls are the plane's wire format, not a bypass.
+# tpu-lint: disable=raw-socket
+
 _HDR = struct.Struct("<B16sqq")  # cmd, table name (padded), n, dim
 # payload plausibility caps (the header fields are client-controlled)
 _MAX_PAYLOAD_ROWS = 1 << 24      # 16M ids per request
@@ -92,6 +97,7 @@ class PsError(RuntimeError):
     """Server-reported request failure (carried in an error frame)."""
 
 
+from ...utils import net as _net  # noqa: E402
 from ...utils.net import recv_exact as _recv_exact  # noqa: E402
 
 
@@ -138,10 +144,7 @@ class PsServer:
         # table name -> (kind, constructor cfg): rides the snapshot
         # manifest so recovery can rebuild tables before loading arrays
         self._cfgs: Dict[str, tuple] = {}
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(64)
+        self._sock = _net.make_listener(host, port, backlog=64)
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -400,6 +403,12 @@ class PsServer:
                 continue
             except OSError:
                 return
+            try:
+                # one flag flip secures the PS plane: TLS + 'PDAH' auth,
+                # unauthenticated peers rejected + counted
+                conn = _net.secure_server(conn, "ps")
+            except (_net.AuthError, OSError, ValueError):
+                continue
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True, name="ps-handler").start()
 
@@ -427,7 +436,11 @@ class PsServer:
         self._conns.add(conn)
         try:
             while True:
-                hdr = _recv_exact(conn, _HDR.size)
+                # recv_head strips an optional 'PDDL' deadline prefix and
+                # DROPS already-expired work (DeadlineExpiredError lands
+                # in the outer except: connection closed, nothing computed)
+                hdr, _req_deadline = _net.recv_head(conn, _HDR.size,
+                                                    plane="ps")
                 cmd, name, n, dim = _HDR.unpack(hdr)
                 name = name.rstrip(b"\0").decode()
                 if _faults._ENABLED:
@@ -810,40 +823,34 @@ class PsClient:
         ct = float(_flags.flag("ps_rpc_call_timeout_s")
                    if call_timeout is None else call_timeout)
         self.call_timeout = ct if ct > 0 else None
-        self._socks: List[Optional[socket.socket]] = [None] * len(endpoints)
+        # one RpcChannel per shard: the substrate owns connect/reconnect,
+        # the security stack, and the plane's fault sites; this client
+        # keeps only the sharding + verb framing
+        self._chans: List[_net.RpcChannel] = [
+            self._make_chan(ep) for ep in endpoints]
         self._locks = [threading.Lock() for _ in endpoints]
         self._dims: Dict[str, int] = {}  # table -> row dim (accessor config)
         self._dense_sizes: Dict[str, list] = {}  # table -> per-server sizes
         self._client_id = _new_client_id()
         self._push_seq = [0] * len(endpoints)   # per-server request seq
-        self._connected_once = [False] * len(endpoints)
         # per-CONNECTION hello state (None = not negotiated yet) and the
         # per-ENDPOINT legacy verdict (sticky: a native server stays one)
         self._hello_ok: List[Optional[bool]] = [None] * len(endpoints)
         self._legacy = [False] * len(endpoints)
 
+    def _make_chan(self, ep: str) -> "_net.RpcChannel":
+        return _net.RpcChannel(
+            "ps", endpoint=ep, connect_timeout=self.call_timeout or 120,
+            legacy_sites=("ps.rpc.send", "ps.rpc.recv"),
+            legacy_reconnect_counter="ps.reconnects")
+
     def _sock(self, i):
-        if self._socks[i] is None:
-            host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)),
-                                         timeout=self.call_timeout or 120)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            if self._connected_once[i]:
-                if _monitor._ENABLED:
-                    _monitor.count("ps.reconnects")
-            self._connected_once[i] = True
-            self._socks[i] = s
-        return self._socks[i]
+        return self._chans[i].sock
 
     def _drop(self, i):
         # a transport error leaves the stream byte-desynced: close and
-        # forget the socket so the next request starts clean
-        if self._socks[i] is not None:
-            try:
-                self._socks[i].close()
-            except OSError:
-                pass
-            self._socks[i] = None
+        # forget the connection so the next request starts clean
+        self._chans[i].drop()
         self._hello_ok[i] = None   # renegotiate on the next connection
 
     def _deadline(self) -> Optional[float]:
@@ -863,8 +870,9 @@ class PsClient:
             return False
         if not new or new == self.endpoints or len(new) != len(self.endpoints):
             return False
-        for i in range(len(self._socks)):
+        for i in range(len(self._chans)):
             self._drop(i)
+            self._chans[i].endpoint = new[i]
         self.endpoints = new
         self._legacy = [False] * len(new)
         if _monitor._ENABLED:
@@ -883,43 +891,18 @@ class PsClient:
         when there is one — that closes with error status when the RPC
         ultimately fails (injected `ps.rpc.send` conn-resets/timeouts
         land here: no leaked open spans)."""
-        sp = _trace.span(f"ps.rpc.{op}")
-        delay = self.backoff_s
-        last: Optional[BaseException] = None
-        try:
-            # with a resolver the retry budget is the CALL DEADLINE, not a
-            # fixed count: failover (lease expiry + standby promotion) can
-            # take several backoff rounds, and the contract is reaching
-            # the new primary within the original per-call deadline
-            overall = self._deadline() if self._resolver is not None else None
-            attempt = 0
-            while True:
-                if attempt:
-                    if _monitor._ENABLED:
-                        _monitor.count("ps.retries")
-                    time.sleep(delay * (1.0 + random.random()))  # full jitter
-                    delay = min(delay * 2, 2.0)
-                try:
-                    out = attempt_fn()
-                    sp.end(retries=attempt)
-                    return out
-                except PsError:
-                    raise
-                except OSError as e:
-                    last = e
-                    self._refresh_endpoints()
-                attempt += 1
-                if overall is not None:
-                    if time.monotonic() >= overall:
-                        break
-                elif attempt > self.max_retries:
-                    break
-            raise last
-        except BaseException as e:
-            # idempotent: only fires when the success path did not end it
-            sp.end(status=_trace.STATUS_ERROR,
-                   error=f"{type(e).__name__}: {str(e)[:200]}")
-            raise
+        # with a resolver the retry budget is the CALL DEADLINE, not a
+        # fixed count: failover (lease expiry + standby promotion) can
+        # take several backoff rounds, and the contract is reaching the
+        # new primary within the original per-call deadline
+        return _net.call_with_retry(
+            attempt_fn, plane="ps", op=op,
+            max_retries=self.max_retries, backoff_s=self.backoff_s,
+            deadline=(self._deadline() if self._resolver is not None
+                      else None),
+            retry_on=(OSError,), no_retry=(PsError,),
+            on_transport_error=self._refresh_endpoints,
+            span_name=f"ps.rpc.{op}", legacy_retry_counter="ps.retries")
 
     def _ensure_seq(self, s: int) -> bool:
         """True when the CURRENT connection to server s has a registered
@@ -960,11 +943,11 @@ class PsClient:
         """Send one request per shard; on a transport error every involved
         socket is dropped (earlier sends may have unread responses that
         would byte-desync a reused connection)."""
+        wire_dl = (self._deadline()
+                   if _net.deadline_wire_enabled() else None)
         try:
             for s, sel in shards:
-                if _faults._ENABLED:
-                    _faults.check("ps.rpc.send")
-                self._sock(s).sendall(make_payload(s, sel))
+                self._chans[s].sendall(make_payload(s, sel), wire_dl)
         except OSError:
             for s, _ in shards:
                 self._drop(s)
@@ -975,12 +958,12 @@ class PsClient:
         sockets in sync); re-raise the first failure afterwards."""
         first: Optional[BaseException] = None
         for s, sel in shards:
-            sk = self._socks[s]
-            if sk is None:
+            ch = self._chans[s]
+            if not ch.connected:
                 continue
             try:
-                if _faults._ENABLED:
-                    _faults.check("ps.rpc.recv")
+                ch.check_recv_faults()
+                sk = ch.sock
                 _check_status(sk, deadline)
                 if recv_one is not None:
                     recv_one(s, sel, sk)
@@ -1354,7 +1337,7 @@ class PsClient:
                 pass
 
     def close(self):
-        for i in range(len(self._socks)):
+        for i in range(len(self._chans)):
             self._drop(i)
 
 
@@ -1362,10 +1345,7 @@ class PsClient:
 #      socket; service.py owns the wire structs) ----
 
 def ha_connect(endpoint: str, timeout: Optional[float] = None):
-    host, port = endpoint.rsplit(":", 1)
-    s = socket.create_connection((host, int(port)), timeout=timeout or 120)
-    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    return s
+    return _net.dial(endpoint, timeout=timeout or 120, plane="ps")
 
 
 def rpc_replicate(sock, after_lsn: int, max_records: int = 0,

@@ -57,6 +57,11 @@ from typing import Dict, Optional
 
 import numpy as np
 
+# The serving codec reads/writes frames on connections the substrate
+# (utils/net.py RpcChannel / secure_server) owns and hands out — those
+# raw send/recv calls are the plane's wire format, not a bypass.
+# tpu-lint: disable=raw-socket
+
 _REQ_MAGIC = 0x50445251       # 'PDRQ'
 _REQ_DEADLINE_MAGIC = 0x50445244  # 'PDRD': u32 deadline_ms precedes count
 _HEALTH_MAGIC = 0x50444851    # 'PDHQ': health/stats probe, no tensor body
@@ -73,6 +78,7 @@ from ..obs import trace as _trace  # noqa: E402
 from ..serving import (  # noqa: E402
     DeadlineExceededError, EngineConfig, EngineStoppedError,
     ServerOverloadedError, ServingEngine)
+from ..utils import net as _net  # noqa: E402
 from ..utils.net import (  # noqa: E402
     DRAIN_MAGIC as _DRAIN_MAGIC, MODEL_CTL_MAGIC as _MODEL_CTL_MAGIC,
     MODEL_MAGIC as _MODEL_MAGIC, STATUS_DEADLINE, STATUS_ERROR, STATUS_OK,
@@ -147,10 +153,7 @@ class PredictorServer:
         self.on_model_ctl = on_model_ctl
         self.stats_extra = stats_extra
         self.drain_info: dict = {}  # merged into the 'PDDR' drain report
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(64)
+        self._sock = _net.make_listener(host, port, backlog=64)
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -195,12 +198,20 @@ class PredictorServer:
                 continue
             except OSError:
                 return
+            try:
+                conn = _net.secure_server(conn, "serving")
+            except (_net.AuthError, OSError, ValueError):
+                continue  # unauthenticated/broken peer: counted + dropped
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
     def _handle_one(self, conn) -> bool:
         """One request/response exchange; False = close the connection."""
-        magic, = struct.unpack("<I", _recv_exact(conn, 4))
+        # recv_head strips any 'PDDL' deadline prefix: expired work is
+        # dropped HERE (DeadlineExpiredError -> _handle closes the conn)
+        # instead of computed.
+        head, _req_deadline = _net.recv_head(conn, 4, plane="serving")
+        magic, = struct.unpack("<I", head)
         tctx = None
         model: Optional[str] = None
         read_deadline = None
@@ -545,8 +556,14 @@ class PredictorClient:
         self._connect_timeout = float(
             _flags.flag("serving_client_connect_timeout_s")
             if connect_timeout is None else connect_timeout)
-        self._sock: Optional[socket.socket] = None
-        self._idx = 0  # replica the live socket points at
+        self._idx = 0  # replica the live connection points at
+        # the serving plane's substrate channel: the resolver serves the
+        # replica list rotated to start at the current index, so failover
+        # (`self._idx += 1`) naturally re-resolves to the next replica
+        self._chan = _net.RpcChannel(
+            "serving", resolver=self._rotation,
+            connect_timeout=self._connect_timeout,
+            on_connect=self._note_connected)
         self._connect()
 
     # wire status -> terminal span status for the client.send root span
@@ -560,34 +577,32 @@ class PredictorClient:
         """(host, port) the live connection points at."""
         return self.replicas[self._idx % len(self.replicas)]
 
+    def _rotation(self):
+        n = len(self.replicas)
+        return [self.replicas[(self._idx + k) % n] for k in range(n)]
+
+    def _note_connected(self, chan):
+        # channel landed somewhere in the rotation: remember which
+        # replica, and arm the per-call read timeout on the live socket
+        self._idx = self.replicas.index(chan.endpoint)
+        chan.sock.settimeout(self.timeout)
+
     def _connect(self, deadline: Optional[float] = None):
         """Bounded connect: up to max_retries+1 rounds over the replica
-        list, exponential backoff with FULL jitter between rounds (decorr
-        against thundering-herd reconnects), the whole dance optionally
-        bounded by an absolute `deadline`."""
+        list (one RpcChannel.connect sweep per round), exponential
+        backoff with FULL jitter between rounds (decorr against
+        thundering-herd reconnects), the whole dance optionally bounded
+        by an absolute `deadline`."""
         self._disconnect()
         last: Optional[Exception] = None
         for attempt in range(self._max_retries + 1):
-            for k in range(len(self.replicas)):
-                idx = (self._idx + k) % len(self.replicas)
-                host, port = self.replicas[idx]
-                ct = self._connect_timeout
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        raise TimeoutError(
-                            "connect deadline exceeded") from last
-                    ct = min(ct, remaining)
-                try:
-                    sock = socket.create_connection((host, port),
-                                                    timeout=ct)
-                    sock.settimeout(self.timeout)
-                    sock.setsockopt(socket.IPPROTO_TCP,
-                                    socket.TCP_NODELAY, 1)
-                    self._sock, self._idx = sock, idx
-                    return
-                except OSError as e:
-                    last = e
+            try:
+                self._chan.connect(deadline)
+                return
+            except _net.ConnectDeadlineError:
+                raise
+            except OSError as e:
+                last = e
             if attempt < self._max_retries:
                 # full jitter: sleep U(0, base * 2^attempt)
                 delay = random.random() * (self._backoff_ms / 1000.0
@@ -601,17 +616,12 @@ class PredictorClient:
             f"rounds over {self.replicas}") from last
 
     def _disconnect(self):
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        self._chan.drop()
 
     def _ensure(self, deadline: Optional[float] = None):
-        if self._sock is None:
+        if not self._chan.connected:
             self._connect(deadline)
-        return self._sock
+        return self._chan.sock
 
     def run(self, arrays, deadline_ms: Optional[float] = None,
             model: Optional[str] = None):
@@ -651,6 +661,8 @@ class PredictorClient:
         sock = self._ensure(deadline)
         with _trace.span("client.send",
                          attrs={"n_tensors": len(arrays)}) as sp:
+            if deadline is not None and _net.deadline_wire_enabled():
+                _net.send_deadline(sock, deadline)
             if sp.trace_id is not None:
                 send_trace_frame(sock, sp.ctx())
             if model is not None:
@@ -662,9 +674,11 @@ class PredictorClient:
                                   int(deadline_ms), len(arrays))
             else:
                 hdr = struct.pack("<II", _REQ_MAGIC, len(arrays))
+            hdr = self._chan.check_send_faults(hdr)
             sock.sendall(hdr)
             for a in arrays:
                 _write_tensor(sock, np.asarray(a))
+            self._chan.check_recv_faults()
             magic, status = struct.unpack(
                 "<IB", _recv_exact(sock, 5, deadline))
             if magic != _RESP_MAGIC:
@@ -695,10 +709,14 @@ class PredictorClient:
                     if deadline_ms is not None else None)
         sock = self._ensure(deadline)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        sock.sendall(struct.pack("<IIII", _STREAM_REQ_MAGIC,
-                                 int(max_new_tokens),
-                                 int(deadline_ms or 0), 1))
+        if deadline is not None and _net.deadline_wire_enabled():
+            _net.send_deadline(sock, deadline)
+        hdr = self._chan.check_send_faults(
+            struct.pack("<IIII", _STREAM_REQ_MAGIC, int(max_new_tokens),
+                        int(deadline_ms or 0), 1))
+        sock.sendall(hdr)
         _write_tensor(sock, prompt)
+        self._chan.check_recv_faults()
         tokens = []
         while True:
             magic, = struct.unpack("<I", _recv_exact(sock, 4, deadline))
@@ -726,10 +744,14 @@ class PredictorClient:
         deadline = (time.monotonic() + deadline_ms / 1000.0
                     if deadline_ms is not None else None)
         sock = self._ensure(deadline)
+        if deadline is not None and _net.deadline_wire_enabled():
+            _net.send_deadline(sock, deadline)
         if body:
-            sock.sendall(struct.pack("<II", magic, len(body)) + body)
+            hdr = struct.pack("<II", magic, len(body)) + body
         else:
-            sock.sendall(struct.pack("<I", magic))
+            hdr = struct.pack("<I", magic)
+        sock.sendall(self._chan.check_send_faults(hdr))
+        self._chan.check_recv_faults()
         rmagic, status = struct.unpack("<IB", _recv_exact(sock, 5,
                                                           deadline))
         if rmagic != _RESP_MAGIC:

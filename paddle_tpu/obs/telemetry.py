@@ -121,7 +121,14 @@ class TelemetryExporter:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._sock: Optional[socket.socket] = None
+        # the telemetry plane's substrate channel: resolver re-discovers
+        # the collector through the store on every (re)connect, the
+        # legacy `telemetry.push` fault site keeps firing alongside
+        # `net.telemetry.send`
+        self._chan = _net.RpcChannel(
+            "telemetry", resolver=self._resolve,
+            connect_timeout=_IO_TIMEOUT_S,
+            legacy_sites=("telemetry.push", None))
         self._addr: Optional[Tuple[str, int]] = None
         self._need_hello = True
         self._last_counters: Dict[str, Any] = {}
@@ -186,13 +193,12 @@ class TelemetryExporter:
         self._close_sock()
 
     def _close_sock(self) -> None:
-        s, self._sock = self._sock, None
-        if s is not None:
-            try:
-                s.close()
-            except OSError:
-                pass
+        self._chan.drop()
         self._need_hello = True
+
+    def _resolve(self) -> List[Tuple[str, int]]:
+        addr = self._discover()
+        return [addr] if addr is not None else []
 
     def _discover(self) -> Optional[Tuple[str, int]]:
         try:
@@ -210,18 +216,16 @@ class TelemetryExporter:
             return None
 
     def _ensure_conn(self) -> bool:
-        if self._sock is not None and not self._need_hello:
+        if self._chan.connected and not self._need_hello:
             return True
         addr = self._discover()
         if addr is None:
             return False
-        if self._sock is None or addr != self._addr:
+        if not self._chan.connected or addr != self._addr:
             self._close_sock()
             try:
-                self._sock = socket.create_connection(
-                    addr, timeout=_IO_TIMEOUT_S)
+                self._chan.connect()
             except OSError:
-                self._sock = None
                 return False
             self._addr = addr
         try:
@@ -237,13 +241,13 @@ class TelemetryExporter:
         return True
 
     def _exchange(self, body: Dict[str, Any]) -> Dict[str, Any]:
-        if _faults._ENABLED:
-            _faults.check("telemetry.push")
-        sock = self._sock
-        if sock is None:
+        self._chan.check_send_faults()
+        if not self._chan.connected:
             raise ConnectionError("no collector connection")
+        sock = self._chan.sock
         _net.send_crc_frame(sock, _net.PDTM_MAGIC,
                             json.dumps(body, default=str).encode())
+        self._chan.check_recv_faults()
         ack = json.loads(_net.recv_crc_frame(
             sock, _net.PDTA_MAGIC,
             deadline=time.monotonic() + _IO_TIMEOUT_S))
@@ -285,7 +289,7 @@ class TelemetryExporter:
             # network failure (or injected telemetry.push fault): drop
             # the connection, re-buffer the drained events (drop-oldest
             # still bounds them), and let the next tick retry
-            had_conn = self._sock is not None
+            had_conn = self._chan.connected
             self._close_sock()
             if had_conn:
                 self.reconnects += 1
@@ -364,10 +368,7 @@ class TelemetryCollector:
     def start(self) -> "TelemetryCollector":
         if self._listener is not None:
             return self
-        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind((self.host, self.port))
-        srv.listen(64)
+        srv = _net.make_listener(self.host, self.port, backlog=64)
         # poll-style accept: closing a listener does not reliably wake a
         # thread blocked in accept(), so the loop must time out to see
         # the stop flag
@@ -425,6 +426,10 @@ class TelemetryCollector:
                 continue
             except OSError:
                 return  # listener closed
+            try:
+                conn = _net.secure_server(conn, "telemetry")
+            except (_net.AuthError, OSError, ValueError):
+                continue  # unauthenticated peer: counted + dropped
             conn.settimeout(None)
             with self._lock:
                 self._conns.append(conn)
@@ -779,13 +784,16 @@ def query_collector(host: str, port: int,
                     timeout_s: float = _IO_TIMEOUT_S) -> Dict[str, Any]:
     """One query round-trip: 'PDTM' {"op": "query"} -> the collector's
     snapshot_doc in the 'PDTA' body."""
-    with socket.create_connection((host, int(port)),
-                                  timeout=timeout_s) as sock:
+    sock = _net.dial((host, int(port)), timeout=timeout_s,
+                     plane="telemetry")
+    try:
         _net.send_crc_frame(sock, _net.PDTM_MAGIC,
                             json.dumps({"op": "query"}).encode())
         ack = json.loads(_net.recv_crc_frame(
             sock, _net.PDTA_MAGIC,
             deadline=time.monotonic() + timeout_s))
+    finally:
+        sock.close()
     return ack.get("doc") or {}
 
 

@@ -640,16 +640,18 @@ class TestFusedUpdateLint:
         assert fs == []
 
     def test_new_hotpath_modules_self_lint_clean(self):
-        """Satellite: io/prefetch.py and parallel/reducer.py stay clean
+        """Satellite: io/prefetch.py, parallel/reducer.py and the RPC
+        substrate (utils/net.py, raw-socket exempt by path) stay clean
         under the full --all rule set (same gate as models/nn/ops)."""
         from paddle_tpu import analysis
         pkg = os.path.dirname(os.path.dirname(
             os.path.abspath(analysis.__file__)))  # .../paddle_tpu
         findings, n = analysis.lint_paths(
             [os.path.join(pkg, "io", "prefetch.py"),
-             os.path.join(pkg, "parallel", "reducer.py")],
+             os.path.join(pkg, "parallel", "reducer.py"),
+             os.path.join(pkg, "utils", "net.py")],
             all_functions=True)
-        assert n == 2
+        assert n == 3
         assert findings == [], "\n".join(f.format() for f in findings)
 
     def test_rule_registered(self):

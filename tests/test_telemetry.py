@@ -213,7 +213,7 @@ class TestWirePlane:
                          ["counters"]["reqs"] == 7)
             # kill the socket: the exporter reconnects and resyncs with a
             # FULL snapshot, so absolute counts survive the delta reset
-            exp._sock.close()
+            exp._chan.sock.close()
             monitor.count("reqs", 1)
             assert _wait(lambda: col.sources["replica-0"]
                          ["counters"]["reqs"] == 8)

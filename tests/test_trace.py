@@ -345,98 +345,9 @@ class TestPsRpcSpans:
             srv.stop()
 
 
-# ---------------------------------------------------------------------------
-# wire back-compat: untraced requests are bit-identical to pre-PDTC
-# ---------------------------------------------------------------------------
-
-class _ByteSink:
-    def __init__(self):
-        self.data = b""
-
-    def sendall(self, b):
-        self.data += b
-
-
-def _legacy_request_bytes(x):
-    """The exact byte stream a pre-PDTC client sends for one request."""
-    from paddle_tpu.inference.server import (_REQ_MAGIC, _write_tensor)
-    sink = _ByteSink()
-    sink.sendall(struct.pack("<II", _REQ_MAGIC, 1))
-    _write_tensor(sink, x)
-    return sink.data
-
-
-def _legacy_ok_response_bytes(y):
-    from paddle_tpu.inference.server import (_RESP_MAGIC, _write_tensor)
-    from paddle_tpu.utils.net import STATUS_OK
-    sink = _ByteSink()
-    sink.sendall(struct.pack("<IBI", _RESP_MAGIC, STATUS_OK, 1))
-    _write_tensor(sink, y)
-    return sink.data
-
-
-class TestWireBackCompat:
-    def test_untraced_client_frames_bit_identical_to_legacy(self):
-        """FLAGS_trace off: the new client's byte stream for a request
-        must EQUAL the pre-PDTC protocol byte-for-byte (an old server
-        needs no changes to keep serving it)."""
-        from paddle_tpu.inference.server import PredictorClient
-        x = np.arange(8, dtype=np.float32).reshape(1, 8)
-        want = _legacy_request_bytes(x)
-        got = {}
-
-        lsock = socket.socket()
-        lsock.bind(("127.0.0.1", 0))
-        lsock.listen(1)
-
-        def old_server():
-            conn, _ = lsock.accept()
-            buf = b""
-            while len(buf) < len(want):
-                chunk = conn.recv(len(want) - len(buf))
-                if not chunk:
-                    break
-                buf += chunk
-            got["bytes"] = buf
-            conn.sendall(_legacy_ok_response_bytes(x * 2.0))
-            conn.close()
-
-        t = threading.Thread(target=old_server, daemon=True)
-        t.start()
-        c = PredictorClient(*lsock.getsockname())
-        try:
-            status, outs = c.run([x])
-        finally:
-            c.close()
-            lsock.close()
-            t.join(5)
-        assert status == 0
-        np.testing.assert_allclose(outs[0], x * 2.0)
-        assert got["bytes"] == want        # bit-identical: no 'PDTC'
-
-    def test_legacy_client_against_traced_server(self, traced):
-        """A pre-PDTC client (raw legacy bytes, no trace frame) against a
-        server with FLAGS_trace ON: the request round-trips AND the server
-        mints no garbage traces (absence of ctx means 'no trace')."""
-        from paddle_tpu.inference.server import (PredictorServer,
-                                                 _read_tensor)
-        from paddle_tpu.utils.net import recv_exact
-        srv = PredictorServer(lambda a: a * 2.0,
-                              engine_config=EngineConfig(
-                                  warmup_on_start=False)).start()
-        x = np.arange(4, dtype=np.float32).reshape(1, 4)
-        try:
-            s = socket.create_connection((srv.host, srv.port), timeout=30)
-            s.sendall(_legacy_request_bytes(x))
-            magic, status = struct.unpack("<IB", recv_exact(s, 5))
-            assert status == 0
-            (n,) = struct.unpack("<I", recv_exact(s, 4))
-            assert n == 1
-            np.testing.assert_allclose(_read_tensor(s), x * 2.0)
-            s.close()
-        finally:
-            srv.stop()
-        assert trace.traces() == []   # no server-side trace minted
+# wire back-compat (untraced requests bit-identical to pre-PDTC) moved to
+# tests/test_net.py::TestGoldenBytesMatrix — the serving row of the
+# per-plane golden-bytes matrix that covers all four wire planes.
 
 
 # ---------------------------------------------------------------------------
