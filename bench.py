@@ -1417,6 +1417,85 @@ def bench_ps_durability(backend):
     return out
 
 
+def bench_online(backend):
+    """Online-serving delta plane: (a) the delta-push tax — sequenced
+    sparse-push throughput with no subscriber vs with a DeltaSubscriber
+    tailing the same table at the default cadence (the per-commit
+    version bookkeeping is always on; the tax arm adds the concurrent
+    delta pulls contending for the table lock), and (b) push ->
+    servable visibility — how long after `push_sparse` returns until an
+    `OnlineServingTable` lookup reflects the new value, reported as
+    p50/p95/p99 over repeated rounds. (b) bounds the staleness a
+    serving replica adds on top of the trainer's own push latency.
+
+    Knob: BENCH_ONLINE=ab|on|off (default off: the arm spins a
+    background tail thread and is not part of the BASELINE.md headline
+    set)."""
+    from paddle_tpu.distributed.ps import (DeltaSubscriber, PsClient,
+                                           PsServer)
+    from paddle_tpu.serving.online import OnlineServingTable
+
+    if os.environ.get("BENCH_ONLINE", "off").lower() not in ("on", "ab"):
+        return {"skipped": "BENCH_ONLINE=off"}
+    dim, batch, n_push, n_vis = 16, 64, 300, 60
+    ids = np.arange(batch, dtype=np.int64)
+    grads = np.ones((batch, dim), np.float32)
+    server = PsServer("127.0.0.1", 0)
+    server.run()
+    client = PsClient([f"127.0.0.1:{server.port}"])
+    out = {"pushes_per_arm": n_push, "batch": batch, "dim": dim}
+    sub = None
+    try:
+        client.create_sparse_table("emb", dim, optimizer="sgd", lr=0.1,
+                                   seed=7)
+        client.push_sparse("emb", ids, grads)   # warm the table rows
+
+        t0 = time.perf_counter()
+        for _ in range(n_push):
+            client.push_sparse("emb", ids, grads)
+        out["per_push_us_solo"] = round(
+            (time.perf_counter() - t0) / n_push * 1e6, 1)
+
+        tbl = OnlineServingTable("emb", dim)
+        sub = DeltaSubscriber({"emb": tbl},
+                              endpoint=f"127.0.0.1:{server.port}",
+                              subscriber_id="bench",
+                              pull_timeout_s=5.0).start()
+        t0 = time.perf_counter()
+        for _ in range(n_push):
+            client.push_sparse("emb", ids, grads)
+        out["per_push_us_tailed"] = round(
+            (time.perf_counter() - t0) / n_push * 1e6, 1)
+        out["tail_overhead_pct"] = round(
+            (out["per_push_us_tailed"] - out["per_push_us_solo"])
+            / out["per_push_us_solo"] * 100, 1)
+
+        # push -> servable: poll the serving table until the pushed
+        # value lands (sgd lr=0.1 on an all-ones grad moves every row
+        # deterministically, so "landed" == first element changed)
+        vis_ms = []
+        probe = ids[:1]
+        for _ in range(n_vis):
+            before = tbl.lookup(probe)[0, 0]
+            t0 = time.perf_counter()
+            client.push_sparse("emb", ids, grads)
+            while tbl.lookup(probe)[0, 0] == before:
+                time.sleep(0.0005)
+            vis_ms.append((time.perf_counter() - t0) * 1e3)
+        lat = np.asarray(vis_ms)
+        out["visibility_ms"] = {
+            "p50": round(float(np.quantile(lat, 0.50)), 2),
+            "p95": round(float(np.quantile(lat, 0.95)), 2),
+            "p99": round(float(np.quantile(lat, 0.99)), 2)}
+        out["staleness_s_at_probe"] = round(tbl.staleness_s(), 4)
+    finally:
+        if sub is not None:
+            sub.stop()
+        client.close()
+        server.stop()
+    return out
+
+
 def bench_llm(backend):
     """Continuous-batching LLM serving (serving/llm.py): concurrent
     variable-length requests through the slot-paged KV-cache engine.
@@ -1538,6 +1617,7 @@ def main():
                     ("autoscale", bench_autoscale),
                     ("net", bench_net),
                     ("ps_durability", bench_ps_durability),
+                    ("online", bench_online),
                     ("llm", bench_llm),
                     ("warm_start", bench_warm_start)):
         extra[key] = _run_workload(key, fn, backend, extra)

@@ -135,6 +135,21 @@ define_flag("ps_ha_lease_ttl_s", 2.0,
 define_flag("ps_ha_heartbeat_s", 0.5,
             "PS HA: lease heartbeat interval (must be well under "
             "FLAGS_ps_ha_lease_ttl_s)")
+define_flag("online_max_staleness_s", 5.0,
+            "online serving: a table whose last successful delta sync is "
+            "older than this is considered stale; lookups then follow "
+            "FLAGS_online_staleness_degrade")
+define_flag("online_staleness_degrade", "serve_stale",
+            "online serving: behavior past the staleness bound — "
+            "'serve_stale' answers from the stale table (counted + one "
+            "telemetry event per episode), 'reject' raises "
+            "StalenessExceededError to the caller")
+define_flag("online_delta_interval_ms", 50.0,
+            "online serving: DeltaSubscriber poll interval for tailing "
+            "the PS delta-push plane (CMD_DELTA)")
+define_flag("online_delta_max_rows", 0,
+            "online serving: cap on rows per delta pull (cut on version "
+            "boundaries, never inside one); 0 = unbounded")
 define_flag("bus_send_retries", 3,
             "fleet message bus: reconnect-and-resend attempts per frame "
             "before raising PeerGoneError")

@@ -19,10 +19,12 @@ from typing import Optional
 import numpy as np
 
 from .table import DenseTable, SparseTable  # noqa: F401
-from .service import Communicator, PsClient, PsError, PsServer  # noqa: F401
+from .service import (Communicator, CommunicatorFlushTimeout,  # noqa: F401
+                      PsClient, PsError, PsServer)
 from .native import NativePsServer  # noqa: F401
 from .wal import PsSnapshotUnsupportedError, SeqLedger, WalWriter  # noqa: F401
 from .ha import HaPsNode, connect as ha_connect_client  # noqa: F401
+from .delta import DeltaBatch, DeltaSubscriber, rpc_delta  # noqa: F401
 
 
 class PsContext:

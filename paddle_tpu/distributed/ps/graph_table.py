@@ -103,3 +103,56 @@ class GraphTable:
 
     def state(self):
         return {"adj": self._adj, "w": self._w, "feat": self._feat}
+
+    # ---- durability (rides the PS snapshot/fetch-state plane) ----
+    def snapshot_arrays(self) -> Dict[str, np.ndarray]:
+        """Deterministic flat-array form for atomic_write+CRC snapshots:
+        edges as (src, dst, weight) triples iterated over SORTED source
+        nodes with per-node insertion order preserved (the order
+        `sample_neighbors` indexes by), isolated nodes separately, and
+        features sorted by key. Same table content -> same bytes, so a
+        restart restore is bit-identical."""
+        with self._lock:
+            src: List[int] = []
+            dst: List[int] = []
+            w: List[float] = []
+            iso: List[int] = []
+            for s in sorted(self._adj.keys()):
+                nbrs = self._adj[s]
+                if not nbrs:
+                    iso.append(s)
+                    continue
+                src.extend([s] * len(nbrs))
+                dst.extend(nbrs)
+                w.extend(self._w.get(s, [1.0] * len(nbrs)))
+            fkeys = sorted(self._feat.keys())
+            fdim = (self._feat[fkeys[0]].shape[0] if fkeys
+                    else max(self.feat_dim, 1))
+            fvals = (np.stack([self._feat[k] for k in fkeys])
+                     if fkeys else np.zeros((0, fdim), np.float32))
+            return {
+                "edge_src": np.asarray(src, np.int64),
+                "edge_dst": np.asarray(dst, np.int64),
+                "edge_w": np.asarray(w, np.float32),
+                "iso_nodes": np.asarray(iso, np.int64),
+                "feat_keys": np.asarray(fkeys, np.int64),
+                "feat_vals": fvals.astype(np.float32),
+            }
+
+    def load_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
+        """Restore from `snapshot_arrays` output (replaces content)."""
+        with self._lock:
+            self._adj.clear()
+            self._w.clear()
+            self._feat.clear()
+            for s, d, wt in zip(arrays["edge_src"], arrays["edge_dst"],
+                                arrays["edge_w"]):
+                self._adj.setdefault(int(s), []).append(int(d))
+                self._w.setdefault(int(s), []).append(float(wt))
+                self._adj.setdefault(int(d), self._adj.get(int(d), []))
+            for n in arrays.get("iso_nodes", ()):
+                self._adj.setdefault(int(n), [])
+            feat_vals = np.asarray(arrays.get(
+                "feat_vals", np.zeros((0, 1), np.float32)), np.float32)
+            for i, k in enumerate(arrays.get("feat_keys", ())):
+                self._feat[int(k)] = feat_vals[i].copy()

@@ -83,6 +83,9 @@ CMD_REPLICATE = 18            # i64 after_lsn + i64 max_records
 CMD_HA_STATUS = 19            # no payload -> JSON frame
 CMD_HANDBACK = 20             # i64 blob_len + concatenated records
 CMD_FETCH_STATE = 21          # no payload -> meta JSON + npz blob
+# delta-push plane (delta.py): a serving replica tails a sparse table's
+# embedding ROWS (values, not optimizer slots) watermarked by commit lsn
+CMD_DELTA = 22                # i64 after_lsn + i64 max_rows + subscriber id
 
 from .table import OPT_WIRE_IDS as _OPT_IDS  # single source, both planes
 _SPARSE_CFG = struct.Struct("<ffqBBfffffff")   # lr,std,seed,opt,ctr,b1,b2,eps,sdec,ccoef,dth,ttl
@@ -95,6 +98,16 @@ _BARRIER_TIMEOUT = 60.0
 
 class PsError(RuntimeError):
     """Server-reported request failure (carried in an error frame)."""
+
+
+class CommunicatorFlushTimeout(TimeoutError):
+    """`Communicator.flush` deadline expired with work still queued.
+    The undelivered batches are NOT dropped: they stay parked with
+    their original seqs and the next flush()/stop() delivers them."""
+
+    def __init__(self, msg: str, pending: int = 0):
+        super().__init__(msg)
+        self.pending = pending
 
 
 from ...utils import net as _net  # noqa: E402
@@ -177,6 +190,16 @@ class PsServer:
         self._repl_acks: Dict[str, int] = {}   # standby id -> acked lsn
         self._handback_floor = 0
         self.applied_lsn = 0
+        # ---- delta-push plane (serving subscribers; see delta.py) ----
+        # table -> key -> version of the commit that last touched the
+        # row (value-shipping: the delta response reads the CURRENT row,
+        # so a conservative extra mark is harmless, never wrong)
+        self._delta_dirty: Dict[str, Dict[int, int]] = {}
+        self._delta_acks: Dict[str, int] = {}  # subscriber id -> acked ver
+        # subscribers at/below this watermark get a full-table resync:
+        # mutations up to here predate the dirty map (recovery, install)
+        self._delta_floor = 0
+        self._delta_seq = 0   # version counter for WAL-less servers
         if wal_dir is not None:
             self._recover()
         self._closed = False
@@ -208,6 +231,9 @@ class PsServer:
                 _monitor.count("ps.wal.records_replayed")
         self._wal = _wal.WalWriter(self.wal_dir, start_lsn=last + 1)
         self.applied_lsn = last
+        # replayed mutations are not in the dirty map: any subscriber
+        # whose watermark predates recovery needs a full resync
+        self._delta_floor = last
 
     def _apply_record(self, rec: "_wal.Record"):
         """Apply one WAL record to the in-memory tables (recovery replay
@@ -228,12 +254,14 @@ class PsServer:
         (callers own dedup). Exception-tolerant by contract — see
         `_apply_record`. True = applied."""
         try:
-            if rec.rtype in (_wal.R_ADD_SPARSE, _wal.R_ADD_DENSE):
+            if rec.rtype in (_wal.R_ADD_SPARSE, _wal.R_ADD_DENSE,
+                             _wal.R_ADD_GRAPH):
                 # idempotent on replay/handback: re-registering must NOT
                 # clobber a live table with a fresh one
                 if rec.table not in self._tables:
-                    kind = ("sparse" if rec.rtype == _wal.R_ADD_SPARSE
-                            else "dense")
+                    kind = {_wal.R_ADD_SPARSE: "sparse",
+                            _wal.R_ADD_DENSE: "dense",
+                            _wal.R_ADD_GRAPH: "graph"}[rec.rtype]
                     self._install_table(rec.table, kind,
                                         json.loads(rec.payload.decode()))
             elif rec.rtype == _wal.R_PUSH_SPARSE:
@@ -259,17 +287,27 @@ class PsServer:
 
     def _commit(self, rtype: int, name: str, client: Optional[str],
                 seq: Optional[int], payload_fn: Callable[[], bytes],
-                apply_fn: Callable[[], object]):
+                apply_fn: Callable[[], object], delta_ids=None):
         """The one mutating-request path: dedup -> WAL append -> apply,
         atomically w.r.t. snapshot collection (`_wal_lock`). Returns the
         apply result, or None for a deduplicated retry. Without a WAL the
-        dedup + apply semantics are unchanged from PR 3."""
+        dedup + apply semantics are unchanged from PR 3.
+
+        `delta_ids` names the sparse keys whose ROWS this commit may
+        change (an id array, or a callable evaluated AFTER the apply —
+        shrink only knows its evictions afterwards); they are stamped
+        with the commit's version so delta subscribers pick them up."""
         if self._wal is None:
             if seq is not None and client:
                 with self._seq_lock:
                     if not self._ledger.record(client, seq):
                         return None
-            return apply_fn()
+            out = apply_fn()
+            if delta_ids is not None:
+                with self._wal_lock:
+                    self._delta_seq += 1
+                    self._mark_delta(name, delta_ids, self._delta_seq)
+            return out
         with self._wal_lock:
             if seq is not None and client:
                 with self._seq_lock:
@@ -279,9 +317,26 @@ class PsServer:
                                    -1 if seq is None else seq, payload_fn())
             out = apply_fn()
             self.applied_lsn = lsn
+            if delta_ids is not None:
+                self._mark_delta(name, delta_ids, lsn)
             self._commits_since_snap += 1
         self._maybe_autosnapshot()
         return out
+
+    def _mark_delta(self, name: str, ids, version: int) -> None:
+        """Stamp keys dirty at `version` (caller holds `_wal_lock`)."""
+        if callable(ids):
+            ids = ids()
+        if len(ids) == 0:
+            return
+        dirty = self._delta_dirty.setdefault(name, {})
+        for k in ids:
+            dirty[int(k)] = version
+
+    def _delta_version(self) -> int:
+        """Head of the delta stream: the WAL lsn when durable, a local
+        commit counter otherwise (both monotonic per server lifetime)."""
+        return self.applied_lsn if self._wal is not None else self._delta_seq
 
     def _maybe_autosnapshot(self):
         if not self._snap_every or self._commits_since_snap < self._snap_every:
@@ -289,13 +344,22 @@ class PsServer:
         try:
             self.snapshot()
         except _wal.PsSnapshotUnsupportedError:
-            # a graph table is registered: auto-compaction cannot cover
-            # it, and a serving-path push must never error for that
+            # a table type without a snapshot representation is
+            # registered: auto-compaction cannot cover it, and a
+            # serving-path push must never error for that
             if not self._snap_skip_warned:
                 self._snap_skip_warned = True
                 import warnings
-                warnings.warn("ps: auto-snapshot skipped — a graph table "
-                              "has no snapshot representation")
+                warnings.warn("ps: auto-snapshot skipped — a registered "
+                              "table has no snapshot representation")
+            self._commits_since_snap = 0
+        except Exception:
+            # a failed compaction (crashed mid-commit, disk error) must
+            # not fail the push that tripped it: the WAL already holds
+            # the commit, recovery falls back past an orphaned payload,
+            # and the NEXT snapshot interval retries the compaction
+            if _monitor._ENABLED:
+                _monitor.count("ps.snapshot.failures")
             self._commits_since_snap = 0
 
     def collect_state(self):
@@ -319,8 +383,11 @@ class PsServer:
 
     def snapshot(self) -> int:
         """Compact the WAL into one crash-atomic generation; returns the
-        new version. Raises PsSnapshotUnsupportedError when a registered
-        table (graph) has no snapshot representation — never silent loss."""
+        new version. Graph tables ride along via `snapshot_arrays` (their
+        content never hits the per-edge WAL — registration does, so a
+        pre-snapshot crash recovers an empty-but-present graph). Raises
+        PsSnapshotUnsupportedError when a registered table has no
+        snapshot representation — never silent loss."""
         if self.wal_dir is None:
             raise ValueError("ps: snapshot() needs a wal_dir")
         with self._snap_lock:
@@ -348,10 +415,13 @@ class PsServer:
             cfg = dict(cfg)
             shape = tuple(cfg.pop("shape"))
             self._tables[name] = DenseTable(shape, **cfg)
+        elif kind == "graph":
+            from .graph_table import GraphTable
+            self._tables[name] = GraphTable(**cfg)
         else:
             raise ValueError(f"ps: unknown table kind {kind!r}")
-        self._cfgs[name] = (kind, cfg if kind == "sparse"
-                            else dict(cfg, shape=list(shape)))
+        self._cfgs[name] = (kind, dict(cfg, shape=list(shape))
+                            if kind == "dense" else cfg)
         return self._tables[name]
 
     def _log_add(self, rtype, name, cfg):
@@ -375,13 +445,13 @@ class PsServer:
         return tbl
 
     def add_graph_table(self, name, **kw):
-        from .graph_table import GraphTable
-        _tname(name)
-        # graph tables are read-only server-side state built from their
-        # edge files: deliberately OUTSIDE the WAL/snapshot plane, and
-        # snapshot() raises typed for them rather than dropping state
-        self._tables[name] = GraphTable(**kw)
-        return self._tables[name]
+        # graph edges/features stay OUTSIDE the WAL record stream
+        # (load-once read-only state, no per-edge commits) but ride the
+        # snapshot/fetch-state plane via GraphTable.snapshot_arrays, so
+        # recovery and standby bootstrap carry the feature source too
+        tbl = self._install_table(name, "graph", kw)
+        self._log_add(_wal.R_ADD_GRAPH, name, kw)
+        return tbl
 
     def table(self, name):
         return self._tables[name]
@@ -475,6 +545,16 @@ class PsServer:
                 if cmd == CMD_REPLICATE:
                     repl_args = _LEN.unpack(_recv_exact(conn, 8)) \
                         + _LEN.unpack(_recv_exact(conn, 8))
+                elif cmd == CMD_DELTA:
+                    after_v = _LEN.unpack(_recv_exact(conn, 8))[0]
+                    max_rows = _LEN.unpack(_recv_exact(conn, 8))[0]
+                    (slen,) = _LEN.unpack(_recv_exact(conn, 8))
+                    if not 0 <= slen <= 256:
+                        _send_err(conn, f"ps: implausible subscriber id "
+                                        f"length {slen}")
+                        return
+                    repl_args = (after_v, max_rows,
+                                 _recv_exact(conn, slen).decode())
                 elif cmd == CMD_HANDBACK:
                     (blen,) = _LEN.unpack(_recv_exact(conn, 8))
                     if not 0 <= blen <= 4 * _MAX_PAYLOAD_ELEMS:
@@ -517,6 +597,10 @@ class PsServer:
                         raise PsError("ps: sequenced push before CMD_HELLO")
                     if cmd == CMD_REPLICATE:
                         self._serve_replicate(conn, name, *repl_args)
+                        continue
+                    if cmd == CMD_DELTA:
+                        from . import delta as _delta
+                        _delta.serve_delta(self, conn, name, *repl_args)
                         continue
                     if cmd == CMD_HA_STATUS:
                         doc = json.dumps(self.ha_status()).encode()
@@ -572,7 +656,7 @@ class PsServer:
                         self._commit(
                             _wal.R_PUSH_SPARSE, name, client_id, req_seq,
                             lambda: _wal.pack_push_sparse(ids, grads),
-                            lambda: tbl.push(ids, grads))
+                            lambda: tbl.push(ids, grads), delta_ids=ids)
                         conn.sendall(_ST_OK)
                     elif cmd == CMD_PULL_DENSE:
                         w = tbl.pull().astype(np.float32)
@@ -604,8 +688,13 @@ class PsServer:
                                      lambda: b"", tbl.decay)
                         conn.sendall(_ST_OK)
                     elif cmd == CMD_SHRINK:
-                        evicted = self._commit(_wal.R_SHRINK, name, None,
-                                               None, lambda: b"", tbl.shrink)
+                        # tombstones are only known after the apply, so
+                        # the mark is a callable over the table's record
+                        evicted = self._commit(
+                            _wal.R_SHRINK, name, None, None, lambda: b"",
+                            tbl.shrink,
+                            delta_ids=lambda: getattr(
+                                tbl, "last_shrink_evicted", ()))
                         conn.sendall(_ST_OK + _LEN.pack(int(evicted)))
                     elif cmd == CMD_SAMPLE_NEIGHBORS:
                         nb, w = tbl.sample_neighbors(ids, int(dim))
@@ -645,9 +734,12 @@ class PsServer:
             recs = _wal.replay(self.wal_dir, after_lsn=int(after_lsn),
                                max_records=int(max_records) or None,
                                count_fallback=False)
-            blob = b"".join(_wal.encode_record(r) for r in recs)
-        conn.sendall(_ST_OK + _LEN.pack(len(recs)) + _LEN.pack(len(blob))
-                     + blob)
+            frames = [_wal.encode_record(r) for r in recs]
+        blen = sum(len(f) for f in frames)
+        # scatter-gather: the already-encoded records go to the kernel
+        # as-is instead of being re-joined into one blob copy
+        _net.send_frames(conn, [_ST_OK + _LEN.pack(len(recs))
+                                + _LEN.pack(blen)] + frames)
 
     def ha_status(self) -> dict:
         return {"role": self.ha_role, "applied_lsn": self.applied_lsn,
@@ -665,13 +757,16 @@ class PsServer:
         for rec in _wal.decode_stream(blob):
             if rec.lsn <= self._handback_floor:
                 continue
-            if (rec.rtype in (_wal.R_ADD_SPARSE, _wal.R_ADD_DENSE)
+            if (rec.rtype in (_wal.R_ADD_SPARSE, _wal.R_ADD_DENSE,
+                              _wal.R_ADD_GRAPH)
                     and rec.table in self._tables):
                 continue   # already registered: no duplicate WAL record
             out = self._commit(rec.rtype, rec.table, rec.client or None,
                                rec.seq if rec.seq >= 0 else None,
                                lambda: rec.payload,
-                               lambda: self._apply_payload(rec))
+                               lambda: self._apply_payload(rec),
+                               delta_ids=lambda rec=rec:
+                                   self._delta_ids_for(rec))
             if out:
                 applied += 1
         if applied and _monitor._ENABLED:
@@ -687,9 +782,86 @@ class PsServer:
                            "tables": cfgs}).encode()
         buf = io.BytesIO()
         np.savez(buf, **arrays)
-        blob = buf.getvalue()
-        conn.sendall(_ST_OK + _LEN.pack(len(meta)) + meta
-                     + _LEN.pack(len(blob)) + blob)
+        blob = buf.getbuffer()
+        _net.send_frames(conn, [_ST_OK + _LEN.pack(len(meta)) + meta
+                                + _LEN.pack(blob.nbytes), blob])
+
+    def delta_since(self, name: str, after_version: int, max_rows: int = 0,
+                    subscriber: str = ""):
+        """The delta-push plane's read side (CMD_DELTA; see delta.py).
+
+        Returns `(version, dim, full, live_keys, rows, dead_keys)` —
+        every sparse ROW touched by a commit after `after_version`
+        (values only, never optimizer slots), plus tombstones for
+        evicted keys. `full=True` (watermark below the resync floor —
+        a fresh subscriber, or this server recovered/installed state)
+        means the payload is the WHOLE table and the subscriber must
+        replace, not merge. `max_rows` cuts the incremental path on a
+        version boundary only — the returned watermark is always safe
+        to resume from. The request watermark doubles as the
+        subscriber's ack: tombstones every subscriber has passed are
+        dropped. Runs under the commit lock, so a row mid-push is
+        never shipped torn."""
+        tbl = self._tables.get(name)
+        if not isinstance(tbl, SparseTable):
+            raise PsError(f"ps: delta stream needs a sparse table, "
+                          f"{name!r} is {type(tbl).__name__}")
+        after = int(after_version)
+        with self._wal_lock:
+            if subscriber:
+                self._delta_acks[subscriber] = after
+            version = self._delta_version()
+            if after < self._delta_floor:
+                with tbl._lock:
+                    live = list(tbl._rows.keys())
+                    block = self._stack_rows(
+                        [tbl._rows[k] for k in live], tbl.dim)
+                return version, tbl.dim, True, live, block, []
+            dirty = self._delta_dirty.get(name, {})
+            items = sorted((ver, k) for k, ver in dirty.items()
+                           if ver > after)
+            if max_rows and len(items) > max_rows:
+                cut = int(max_rows)
+                edge = items[cut - 1][0]
+                while cut < len(items) and items[cut][0] == edge:
+                    cut += 1   # never split one commit across pulls
+                items = items[:cut]
+                version = items[-1][0]
+            live, dead, rows = [], [], []
+            with tbl._lock:
+                for _ver, k in items:
+                    r = tbl._rows.get(k)
+                    if r is None:
+                        dead.append(k)
+                    else:
+                        live.append(k)
+                        rows.append(r)
+                block = self._stack_rows(rows, tbl.dim)
+            if self._delta_acks:
+                floor = min(self._delta_acks.values())
+                stale = [k for k, ver in dirty.items()
+                         if ver <= floor and k not in tbl._rows]
+                for k in stale:
+                    del dirty[k]
+            return version, tbl.dim, False, live, block, dead
+
+    @staticmethod
+    def _stack_rows(rows, dim) -> np.ndarray:
+        if not rows:
+            return np.zeros((0, dim), np.float32)
+        return np.stack(rows).astype(np.float32, copy=False)
+
+    def _delta_ids_for(self, rec: "_wal.Record"):
+        """Sparse keys whose rows a replicated/handed-back record may
+        have changed (evaluated AFTER the record applied). Stats-only
+        records (show/click, decay) leave embedding rows untouched."""
+        if rec.rtype == _wal.R_PUSH_SPARSE:
+            ids, _ = _wal.unpack_push_sparse(rec.payload)
+            return ids
+        if rec.rtype == _wal.R_SHRINK:
+            tbl = self._tables.get(rec.table)
+            return getattr(tbl, "last_shrink_evicted", ()) if tbl else ()
+        return ()
 
     def apply_replicated(self, rec: "_wal.Record"):
         """Standby-side: persist one replicated record under its ORIGINAL
@@ -700,6 +872,7 @@ class PsServer:
                 self._wal.append_record(rec)
             self._apply_record(rec)
             self.applied_lsn = rec.lsn
+            self._mark_delta(rec.table, self._delta_ids_for(rec), rec.lsn)
             self._commits_since_snap += 1
         if _monitor._ENABLED:
             _monitor.count("ps.replication.records")
@@ -718,6 +891,9 @@ class PsServer:
             with self._seq_lock:
                 self._ledger = _wal.SeqLedger()
             self.applied_lsn = 0
+            self._delta_dirty.clear()
+            self._delta_floor = 0
+            self._delta_seq = 0
             if self.wal_dir is not None:
                 _wal.wipe(self.wal_dir)
 
@@ -746,6 +922,10 @@ class PsServer:
                                     meta["tables"].items()}, arrays)
                 self._wal = _wal.WalWriter(self.wal_dir, start_lsn=lsn + 1)
             self.applied_lsn = lsn
+            # installed arrays are not in the dirty map: delta
+            # subscribers at or below this point need a full resync
+            self._delta_floor = lsn
+            self._delta_seq = lsn
 
     def stop(self):
         self._stop.set()
@@ -1471,11 +1651,45 @@ class Communicator:
     def push_dense_async(self, table, grad):
         self._put(("dense", table, np.asarray(grad), None, {}, 0))
 
-    def flush(self, timeout=30.0):
+    def pending(self) -> int:
+        """Batches enqueued or in flight but not yet applied."""
+        with self._cond:
+            return self._pending
+
+    def flush(self, timeout=30.0, on_timeout="requeue"):
+        """Block until every queued push applied (or permanently failed).
+
+        On timeout the behavior is DETERMINISTIC, never
+        silently-dropped work (`ps.communicator.flush_timeouts` counts
+        either way):
+
+        - ``on_timeout="requeue"`` (default): raise
+          `CommunicatorFlushTimeout` carrying the pending batch count.
+          Every undelivered batch stays parked in the worker with its
+          ORIGINAL per-server seqs — the ledger keeps the retries
+          exactly-once — so a later flush()/stop() delivers exactly
+          what this one could not.
+        - ``on_timeout="drain"``: keep waiting past the deadline until
+          the queue drains or a permanent error is recorded. The
+          elapsed timeout is reported via the counter only.
+        """
+        if on_timeout not in ("requeue", "drain"):
+            raise ValueError(f"flush: unknown on_timeout={on_timeout!r}")
         with self._cond:
             if not self._cond.wait_for(lambda: self._pending == 0,
                                        timeout=timeout):
-                raise TimeoutError("Communicator flush timed out")
+                if _monitor._ENABLED:
+                    _monitor.count("ps.communicator.flush_timeouts")
+                if on_timeout == "requeue":
+                    raise CommunicatorFlushTimeout(
+                        f"Communicator flush timed out after {timeout}s "
+                        f"with {self._pending} batch(es) pending; they "
+                        "remain queued with their original seqs",
+                        pending=self._pending)
+                # drain: a permanent error also releases the wait —
+                # the worker stops applying and pending hits zero as
+                # remaining items fall through the error check
+                self._cond.wait_for(lambda: self._pending == 0)
         self._raise_if_failed()
 
     def stop(self):

@@ -227,6 +227,9 @@ class SparseTable:
                 "(accessor options need accessor='ctr')")
         self._accessor = CtrAccessor(**accessor_kw) if accessor == "ctr" else None
         self._stats: Dict[int, dict] = {}
+        # keys evicted by the most recent shrink() — the delta-push plane
+        # reads this to ship tombstones to serving subscribers
+        self.last_shrink_evicted: list = []
 
     def _row(self, key: int) -> np.ndarray:
         r = self._rows.get(key)
@@ -279,6 +282,9 @@ class SparseTable:
                 self._slots.pop(k, None)
                 self._stats.pop(k, None)
                 self._on_evict(k)
+            # evicted keys from the LAST shrink, for consumers that must
+            # propagate tombstones (the PS delta-push plane)
+            self.last_shrink_evicted = list(dead)
             return len(dead)
 
     def row_stat(self, key: int) -> Optional[dict]:
@@ -495,6 +501,7 @@ class SSDSparseTable(SparseTable):
                 _, _, stat = pickle.loads(self._db[kb])
                 if stat is not None and self._accessor.should_evict(stat):
                     del self._db[kb]
+                    self.last_shrink_evicted.append(int(kb))
                     n += 1
         return n
 

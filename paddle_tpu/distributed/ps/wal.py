@@ -62,6 +62,7 @@ R_DECAY = 4
 R_SHRINK = 5
 R_ADD_SPARSE = 6     # payload: JSON table config
 R_ADD_DENSE = 7
+R_ADD_GRAPH = 8      # registration only: graph CONTENT rides snapshots
 
 # lsn, rtype, table name (padded), client id (padded), seq, payload len
 _REC_HDR = struct.Struct("<qB16s16sqq")

@@ -23,6 +23,10 @@ from .fleet import (  # noqa: F401  (after engine: fleet builds on it)
 from .autoscaler import (  # noqa: F401  (after fleet: the control plane)
     Autoscaler, DecisionLedger, ReplicaPool, ScaleDecision, ScalePolicy,
 )
+from .online import (  # noqa: F401  (the online-learning serving plane)
+    OnlineRollbackGuard, OnlineServingTable, StalenessExceededError,
+    load_serving_tables, save_serving_generation,
+)
 from .llm import LLMConfig, LLMEngine, LLMStream  # noqa: F401
 
 __all__ = [
@@ -36,4 +40,6 @@ __all__ = [
     "HBMBudgetExceededError",
     "Autoscaler", "ScalePolicy", "ScaleDecision", "ReplicaPool",
     "DecisionLedger",
+    "OnlineServingTable", "OnlineRollbackGuard", "StalenessExceededError",
+    "save_serving_generation", "load_serving_tables",
 ]

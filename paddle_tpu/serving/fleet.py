@@ -1000,6 +1000,27 @@ class FleetRouter:
                              promoted=True, rolled_back=False,
                              canary_burn=burn, probed=probed)
 
+    def rollback_model(self, model: str) -> Dict[int, int]:
+        """Instant fleet-wide rollback of `model` to its guard `.bak`
+        generation ('PDMV' model-ctl on every healthy replica). Returns
+        replica id -> restored version. The online rollback guard drives
+        this when a poisoned table generation reaches serving."""
+        restored: Dict[int, int] = {}
+        for h in self.healthy_replicas():
+            try:
+                ctl = self._model_ctl(h, "rollback", model)
+                restored[h.replica_id] = int(ctl.get("version", 0))
+            except (ConnectionError, TimeoutError, OSError):
+                h.mark_dead()
+        if _monitor._ENABLED:
+            _monitor.count("fleet.rollbacks")
+        _obs.record_event("fleet.model_rollback", model=model,
+                          replicas=sorted(restored))
+        from ..obs import telemetry as _telemetry
+        _telemetry.emit("model_rollback", model=model,
+                        replicas=sorted(restored))
+        return restored
+
     # -- observability --
     def snapshot(self) -> Dict[str, Any]:
         """The `fleet` section of an obs dump / the monitor CLI table."""

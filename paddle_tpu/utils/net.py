@@ -201,6 +201,47 @@ def recv_exact(sock, n: int, deadline: float | None = None) -> bytes:
     return bytes(buf)
 
 
+# sendmsg takes at most IOV_MAX iovecs per call; batch conservatively so
+# a huge replication frame list never trips EINVAL on a small-limit OS.
+_IOV_BATCH = 1024
+
+
+def send_frames(sock, frames) -> None:
+    """Send a list of bytes-like frames as one contiguous wire stream.
+
+    On a plain TCP socket this is vectored I/O (`sendmsg`, i.e.
+    writev): the kernel gathers the frames, so a caller holding N
+    already-encoded records never pays the O(total) `b"".join` copy.
+    Any wrapped socket (auth record layer, TLS) only exposes
+    `sendall` semantics — there the frames are joined and sent
+    through the wrapper, which keeps its framing/HMAC intact. The
+    receiver cannot tell the difference: the bytes on the wire are
+    identical either way.
+    """
+    frames = [f if isinstance(f, (bytes, bytearray, memoryview))
+              else bytes(f) for f in frames]
+    frames = [f for f in frames if len(f)]
+    if not frames:
+        return
+    if type(sock) is not _socket_mod.socket or \
+            not hasattr(sock, "sendmsg"):
+        sock.sendall(b"".join(frames))
+        return
+    views = [memoryview(f).cast("B") for f in frames]
+    idx = 0
+    while idx < len(views):
+        batch = views[idx:idx + _IOV_BATCH]
+        sent = sock.sendmsg(batch)
+        # Advance past whole frames the kernel took, then trim the
+        # partial one; short sends are normal under backpressure.
+        while batch and sent >= len(batch[0]):
+            sent -= len(batch[0])
+            batch.pop(0)
+            idx += 1
+        if batch and sent:
+            views[idx] = batch[0][sent:]
+
+
 # =============================================================================
 # The unified RPC substrate. Everything below is shared by the four wire
 # planes; everything above is the framing vocabulary they speak over it.
@@ -725,6 +766,21 @@ class RpcChannel:
         if deadline is not None and deadline_wire_enabled():
             send_deadline(sock, deadline)
         sock.sendall(data)
+
+    def send_frames(self, frames, deadline: float | None = None) -> None:
+        """Scatter-gather `sendall`: identical wire bytes, no join copy
+        on the fault-free plain-TCP path. With fault injection armed the
+        frames are joined first so `torn` mangling keeps its documented
+        truncate-the-whole-payload semantics."""
+        if _faults._ENABLED:
+            self.sendall(b"".join(
+                bytes(f) if not isinstance(f, (bytes, bytearray, memoryview))
+                else f for f in frames), deadline)
+            return
+        sock = self.connect()
+        if deadline is not None and deadline_wire_enabled():
+            send_deadline(sock, deadline)
+        send_frames(sock, frames)
 
     def recv_exact(self, n: int, deadline: float | None = None) -> bytes:
         self.check_recv_faults()

@@ -161,17 +161,20 @@ def _no_fleet_leak():
     import threading
     import time
     from paddle_tpu.serving import fleet as _fleet
+    from paddle_tpu.serving import online as _online
 
     def fleet_threads():
         return [t.name for t in threading.enumerate()
                 if t.is_alive() and t.name in
                 ("fleet-health", "elastic-heartbeat", "elastic-watcher",
-                 "predictor-serve")]
+                 "predictor-serve", "online-guard")]
 
     before = len(fleet_threads())
     yield
     leaked = [obj for obj in list(_fleet._LIVE)
               if not getattr(obj, "_closed", True)]
+    leaked += [g for g in list(_online._LIVE)
+               if g._thread is not None and g._thread.is_alive()]
     for obj in leaked:
         try:
             obj.close() if hasattr(obj, "close") else obj.stop(drain=False)
@@ -238,6 +241,7 @@ def _no_ps_leak():
     EVERY test, reaping leftovers so one offender cannot cascade."""
     import threading
     import time
+    from paddle_tpu.distributed.ps import delta as _ps_delta
     from paddle_tpu.distributed.ps import ha as _ps_ha
     from paddle_tpu.distributed.ps import service as _ps_service
     from paddle_tpu.distributed.ps import wal as _ps_wal
@@ -246,7 +250,7 @@ def _no_ps_leak():
         return [t.name for t in threading.enumerate()
                 if t.is_alive() and t.name in
                 ("ps-serve", "ps-handler", "ps-repl-tail",
-                 "ps-communicator")]
+                 "ps-communicator", "ps-delta-tail")]
 
     before = len(ps_threads())
     yield
@@ -256,6 +260,8 @@ def _no_ps_leak():
                if not getattr(s, "_closed", True)
                and not s._stop.is_set()]
     leaked += [w for w in list(_ps_wal._LIVE_WRITERS) if not w.closed]
+    leaked += [d for d in list(_ps_delta._LIVE)
+               if d._thread is not None and d._thread.is_alive()]
     for obj in leaked:
         try:
             obj.stop() if hasattr(obj, "stop") else obj.close()

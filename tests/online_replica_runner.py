@@ -1,0 +1,105 @@
+"""Online serving replica runner (executed by test_online_soak.py).
+
+Joins the fleet as ONE ReplicaAgent in a real child process whose
+prediction handler reads a staleness-bounded OnlineServingTable fed by
+a DeltaSubscriber tailing the PS HA group's CURRENT primary (the tail
+follows a failover through the rendezvous store). Predictions are
+sigmoid(mean(emb[u]) + mean(emb[i])) over [n, 2] (user, item) id pairs
+— the serving half of the streaming CTR model the soak trains.
+
+Publishes `replica_id host port` through the port file once registered.
+stdin verbs (one per line):
+  dump <path>  -> atomically write the table rows (npz) + a stats JSON
+                  sidecar at <path>.json (the soak's serving audit)
+  anything else / EOF -> graceful exit (writes ONLINE_RUNNER_STATS if
+                  set, then stops)
+
+argv: [store_host, store_port, ps_group, fleet_name, table, dim,
+       port_file]
+env:  FLEET_REPLICA_ID (optional) — rejoin with a FIXED id (respawn).
+      ONLINE_RUNNER_STATS (optional) — faults/counters JSON on exit.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+store_host = sys.argv[1]
+store_port = int(sys.argv[2])
+ps_group = sys.argv[3]
+fleet_name = sys.argv[4]
+table = sys.argv[5]
+dim = int(sys.argv[6])
+port_file = sys.argv[7]
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu._native import TCPStore  # noqa: E402
+from paddle_tpu.core import flags as _flags  # noqa: E402
+from paddle_tpu.distributed.ps import DeltaSubscriber  # noqa: E402
+from paddle_tpu.distributed.ps import ha as psha  # noqa: E402
+from paddle_tpu.serving import EngineConfig, ReplicaAgent  # noqa: E402
+from paddle_tpu.serving.online import OnlineServingTable  # noqa: E402
+
+_flags.set_flags({"fleet_heartbeat_s": 0.15, "fleet_lease_ttl_s": 0.6})
+
+store = TCPStore(store_host, store_port, is_master=False)
+tbl = OnlineServingTable(table, dim, degrade="serve_stale")
+sub = DeltaSubscriber({table: tbl},
+                      resolver=psha.resolver(store, ps_group),
+                      subscriber_id=f"replica-{os.getpid()}",
+                      interval_ms=20.0, pull_timeout_s=2.0).start()
+
+
+def predict(x):
+    """[n, 2] f32 (user_id, item_id) -> [n, 1] f32 click probability."""
+    ids = np.asarray(x, np.float32).astype(np.int64)
+    s = (tbl.lookup(ids[:, 0]).mean(axis=1)
+         + tbl.lookup(ids[:, 1]).mean(axis=1))
+    return (1.0 / (1.0 + np.exp(-s))).astype(np.float32).reshape(-1, 1)
+
+
+rid = os.environ.get("FLEET_REPLICA_ID")
+agent = ReplicaAgent(
+    predict, store, fleet=fleet_name,
+    replica_id=int(rid) if rid else None,
+    engine_config=EngineConfig(warmup_on_start=False, batch_timeout_ms=2,
+                               max_batch_size=8)).start()
+
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    f.write(f"{agent.replica_id} {agent.host} {agent.port}")
+os.rename(tmp, port_file)   # atomic: the parent never reads a half-write
+
+while True:
+    line = sys.stdin.readline()
+    parts = line.split()
+    if parts and parts[0] == "dump":
+        path = parts[1]
+        sub.kick()                      # one fresh pull before the audit
+        arrays = tbl.export_arrays()
+        stats = dict(tbl.stats(), watermark=sub.watermark(table))
+        np.savez(path + ".tmp.npz", **arrays)
+        with open(path + ".json.tmp", "w") as f:
+            json.dump(stats, f)
+        os.rename(path + ".json.tmp", path + ".json")
+        os.rename(path + ".tmp.npz", path)   # npz last: parent's ready cue
+        continue
+    break                               # graceful exit (or parent EOF)
+
+agent.stop(drain=True)
+sub.stop()
+
+stats_path = os.environ.get("ONLINE_RUNNER_STATS")
+if stats_path:
+    from paddle_tpu import faults, monitor
+    doc = {"faults": faults.stats(),
+           "counters": monitor.snapshot()["counters"],
+           "table": tbl.stats()}
+    with open(stats_path + ".tmp", "w") as f:
+        json.dump(doc, f)
+    os.rename(stats_path + ".tmp", stats_path)

@@ -7,6 +7,12 @@ writes a line on stdin for a graceful exit. Publishes
 `node_id role host port` through the port file once started.
 
 argv: [store_host, store_port, group_name, wal_dir, port_file]
+env:  PS_RUNNER_SEED_GRAPH (optional) — "name:n_nodes": a PRIMARY seeds
+      a deterministic ring graph table before publishing the port file
+      (the online soak's neighbor-sampling source; a standby gets it
+      via WAL registration + state fetch).
+      PS_RUNNER_STATS (optional) — path: write faults.stats() + monitor
+      counters as JSON on graceful exit (the soak's fault audit).
 """
 import os
 import sys
@@ -32,6 +38,15 @@ _flags.set_flags({"ps_ha_heartbeat_s": 0.15, "ps_ha_lease_ttl_s": 0.6,
 store = TCPStore(store_host, store_port, is_master=False)
 node = HaPsNode(store, name=group_name, wal_dir=wal_dir).start()
 
+seed_graph = os.environ.get("PS_RUNNER_SEED_GRAPH")
+if seed_graph and node.role == "primary":
+    gname, n_nodes = seed_graph.split(":")
+    n = int(n_nodes)
+    g = node.server.add_graph_table(gname, weighted=True, seed=13)
+    src = list(range(n)) * 2
+    dst = [(i + 1) % n for i in range(n)] + [(i + 2) % n for i in range(n)]
+    g.add_edges(src, dst, weight=[1.0] * len(src))
+
 tmp = port_file + ".tmp"
 with open(tmp, "w") as f:
     f.write(f"{node.node_id} {node.role} {node.server.host} "
@@ -40,3 +55,13 @@ os.rename(tmp, port_file)   # atomic: the parent never reads a half-write
 
 sys.stdin.readline()        # parent says "exit gracefully" (or SIGKILLs us)
 node.stop()
+
+stats_path = os.environ.get("PS_RUNNER_STATS")
+if stats_path:
+    import json
+    from paddle_tpu import faults, monitor
+    doc = {"role": node.role, "faults": faults.stats(),
+           "counters": monitor.snapshot()["counters"]}
+    with open(stats_path + ".tmp", "w") as f:
+        json.dump(doc, f)
+    os.rename(stats_path + ".tmp", stats_path)

@@ -827,7 +827,11 @@ class TestSelfLint:
              # elastic autoscaler (ISSUE 17): the sense→decide→act tick
              # runs beside serving every interval — it must stay
              # device-sync-free or the decision loop taxes the p99
-             os.path.join(PKG, "serving", "autoscaler.py")],
+             os.path.join(PKG, "serving", "autoscaler.py"),
+             # online-learning plane (ISSUE 19): the delta tail runs
+             # beside serving and every CTR lookup crosses the table
+             os.path.join(PKG, "distributed", "ps", "delta.py"),
+             os.path.join(PKG, "serving", "online.py")],
             all_functions=True)
         assert n_files > 25
         assert findings == [], "\n".join(f.format() for f in findings)

@@ -245,6 +245,129 @@ class TestSubstratePrimitives:
 
 
 # ---------------------------------------------------------------------------
+# scatter-gather sends (ISSUE 19 satellite): on-wire identity
+# ---------------------------------------------------------------------------
+
+class TestSendFrames:
+    """`send_frames` is an OPTIMIZATION, never a protocol change: the
+    receiver must get byte-for-byte what `sendall(b"".join(frames))`
+    would have produced, through every path (vectored sendmsg on a
+    plain socket, join fallback on wrapped sockets, fault-armed
+    channels)."""
+
+    def test_vectored_send_golden_bytes(self):
+        a, b = socket.socketpair()
+        rng = np.random.default_rng(0)
+        frames = [b"\x01", struct.pack("<q", 7),
+                  rng.integers(0, 255, 4096, np.uint8).tobytes(),
+                  memoryview(b"tail-frame"), bytearray(b"ba-frame"),
+                  b""]   # empty frames are legal and invisible
+        want = b"".join(bytes(f) for f in frames)
+        try:
+            got = {}
+            t = threading.Thread(
+                target=lambda: got.update(d=_recv_all(b, len(want))),
+                daemon=True)
+            t.start()
+            net.send_frames(a, frames)
+            t.join(timeout=10)
+            assert got["d"] == want
+        finally:
+            a.close()
+            b.close()
+
+    def test_partial_sends_advance_across_batches(self, monkeypatch):
+        """Many frames + tiny iovec batches + a slow reader force the
+        kernel to take partial writes mid-frame; the stream must still
+        arrive intact and in order."""
+        monkeypatch.setattr(net, "_IOV_BATCH", 16)
+        a, b = socket.socketpair()
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+        frames = [bytes([i % 256]) * (i % 1000 + 1) for i in range(500)]
+        want = b"".join(frames)
+        try:
+            got = {}
+            t = threading.Thread(
+                target=lambda: got.update(d=_recv_all(b, len(want))),
+                daemon=True)
+            t.start()
+            net.send_frames(a, frames)
+            t.join(timeout=20)
+            assert got["d"] == want
+        finally:
+            a.close()
+            b.close()
+
+    def test_wrapped_socket_falls_back_to_join(self):
+        """Anything that is not a plain socket (auth record layer, TLS)
+        only exposes sendall semantics — frames must go through it as
+        ONE joined write, keeping the wrapper's framing intact."""
+        sink = _ByteSink()
+        net.send_frames(sink, [b"abc", b"", b"def"])
+        assert sink.data == b"abcdef"
+
+    def test_channel_send_frames_identical_with_faults_armed(self):
+        """A fault-armed channel routes frames through check_send_faults
+        (so `torn` keeps its truncate-the-payload semantics); with a
+        spec on an UNRELATED site the bytes must still be identical."""
+        lsock = socket.create_server(("127.0.0.1", 0))
+        host, port = lsock.getsockname()
+        frames = [b"hdr", struct.pack("<q", 3), b"payload-bytes"]
+        want = b"".join(frames)
+        got = {}
+
+        def server():
+            conn, _ = lsock.accept()
+            got["d"] = _recv_all(conn, len(want))
+            conn.close()
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        chan = net.RpcChannel("ps", endpoint=f"{host}:{port}")
+        try:
+            with faults.inject("bus.send:conn_reset:p=0"):
+                assert faults._ENABLED
+                chan.send_frames(frames)
+            t.join(timeout=10)
+            assert got["d"] == want
+        finally:
+            chan.drop()
+            lsock.close()
+
+    def test_replication_stream_bytes_identical(self):
+        """The PS replication response (now sent scatter-gather) decodes
+        to the same records a pre-frames server produced — on-wire
+        identity at the verb level."""
+        from paddle_tpu.distributed.ps import service as ps_service
+        from paddle_tpu.distributed.ps import wal as ps_wal
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            srv = ps_service.PsServer(wal_dir=d).run()
+            try:
+                srv.add_sparse_table("t", 4)
+                cli = ps_service.PsClient([f"{srv.host}:{srv.port}"])
+                cli.register_sparse_dim("t", 4)
+                ids = np.arange(5, dtype=np.int64)
+                grads = np.full((5, 4), 0.5, np.float32)
+                cli.push_sparse("t", ids, grads)
+                sock = ps_service.ha_connect(f"{srv.host}:{srv.port}")
+                try:
+                    recs = ps_service.rpc_replicate(sock, after_lsn=0)
+                finally:
+                    sock.close()
+                cli.close()
+                kinds = [r.rtype for r in recs]
+                assert ps_wal.R_PUSH_SPARSE in kinds
+                rec = next(r for r in recs
+                           if r.rtype == ps_wal.R_PUSH_SPARSE)
+                got_ids, got_grads = ps_wal.unpack_push_sparse(rec.payload)
+                np.testing.assert_array_equal(got_ids, ids)
+                np.testing.assert_array_equal(got_grads, grads)
+            finally:
+                srv.stop()
+
+
+# ---------------------------------------------------------------------------
 # golden bytes: every plane, both directions (the back-compat matrix)
 # ---------------------------------------------------------------------------
 

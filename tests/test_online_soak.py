@@ -1,0 +1,405 @@
+"""Online-learning fault-matrix soak (ISSUE 19 headline, slow tier).
+
+ONE run wires the full recommender pipeline — streaming CTR trainer
+(async Communicator pushes + show/click stats + graph neighbor
+propagation) -> HA parameter servers (WAL + warm standby) -> delta-push
+stream -> two fleet serving replicas — and injects a fault at EVERY
+seam while it streams:
+
+  parent process:  ps.rpc.send, router.dispatch, net.serving.send,
+                   telemetry.push
+  PS children:     ps.delta.push (both), ps.snapshot.commit (the
+                   survivor), ps.wal.write torn (the rejoined standby)
+  process kills:   SIGKILL of the PS primary mid-stream, SIGKILL of one
+                   serving replica (respawned -> full-resync bootstrap)
+
+Audits at quiesce: the PS table matches a fault-free oracle row-for-row
+(zero lost, zero double-applied); every serving replica converges to
+the PS rows bit-exactly with bounded staleness; streaming AUC of the
+predictions the replicas actually served is within +-0.01 of the
+oracle's; each injected seam demonstrably fired.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import faults, monitor
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.distributed.ps import Communicator
+from paddle_tpu.distributed.ps import ha as psha
+from paddle_tpu.distributed.ps.table import SparseTable
+
+DIM, LR, SEED = 8, 0.1, 5
+N_IDS = 24                      # users 0..11, items 12..23
+COLD = [24, 25]                 # touched once, then left to the TTL
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-np.asarray(x, np.float64)))
+
+
+def _auc(labels, preds):
+    y = np.asarray(labels, bool)
+    p = np.asarray(preds, np.float64)
+    pos, neg = p[y], p[~y]
+    if not len(pos) or not len(neg):
+        return 0.5
+    wins = (pos[:, None] > neg[None, :]).sum() \
+        + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    return float(wins) / (len(pos) * len(neg))
+
+
+def _retry_failover(fn, attempts=12, sleep=0.25):
+    """Sync client ops during a failover window: keep re-resolving until
+    the promoted primary answers (the async path gets this from the
+    Communicator's requeue budget)."""
+    last = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except (OSError, TimeoutError) as e:
+            last = e
+            time.sleep(sleep)
+    raise last
+
+
+def _spawn_ps(store, group, wal_dir, tmp_path, tag, env_extra):
+    port_file = str(tmp_path / f"ps-{tag}.port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", FLAGS_monitor="1",
+               **env_extra)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "ps_ha_runner.py"),
+         store.host, str(store.port), group, wal_dir, port_file],
+        stdin=subprocess.PIPE, env=env)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(port_file):
+        assert proc.poll() is None, f"ps node {tag} died during startup"
+        assert time.monotonic() < deadline, f"ps node {tag} never started"
+        time.sleep(0.05)
+    node_id, role, host, port = open(port_file).read().split()
+    os.remove(port_file)
+    return proc, role
+
+
+def _spawn_replica(store, group, tmp_path, tag, env_extra):
+    port_file = str(tmp_path / f"replica-{tag}.port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", FLAGS_monitor="1",
+               **env_extra)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "online_replica_runner.py"),
+         store.host, str(store.port), group, "fleet", "emb", str(DIM),
+         port_file],
+        stdin=subprocess.PIPE, env=env)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(port_file):
+        assert proc.poll() is None, f"replica {tag} died during startup"
+        assert time.monotonic() < deadline, f"replica {tag} never started"
+        time.sleep(0.05)
+    rid, host, port = open(port_file).read().split()
+    os.remove(port_file)
+    return proc, int(rid)
+
+
+def _dump_replica(proc, path, timeout=30.0):
+    proc.stdin.write(f"dump {path}\n".encode())
+    proc.stdin.flush()
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        assert proc.poll() is None, "replica died during dump"
+        assert time.monotonic() < deadline, "replica dump never landed"
+        time.sleep(0.05)
+    with np.load(path) as z:
+        arrays = {k: z[k].copy() for k in z.files}
+    with open(path + ".json") as f:
+        stats = json.load(f)
+    os.remove(path)
+    os.remove(path + ".json")
+    return arrays, stats
+
+
+def _graceful_exit(procs):
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.stdin.write(b"\n")
+                p.stdin.flush()
+            except OSError:
+                pass
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+@pytest.fixture(autouse=True)
+def _monitor_on():
+    paddle.set_flags({"FLAGS_monitor": True})
+    monitor.reset()
+    yield
+    paddle.set_flags({"FLAGS_monitor": False})
+    monitor.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fast_clocks():
+    keep = {k: _flags.flag(k) for k in
+            ("ps_ha_lease_ttl_s", "ps_ha_heartbeat_s",
+             "ps_replication_interval_ms", "ps_rpc_backoff_ms",
+             "fleet_heartbeat_s", "fleet_lease_ttl_s",
+             "fleet_health_interval_s", "telemetry_interval_s")}
+    _flags.set_flags({"ps_ha_lease_ttl_s": 0.6, "ps_ha_heartbeat_s": 0.15,
+                      "ps_replication_interval_ms": 10.0,
+                      "ps_rpc_backoff_ms": 20.0,
+                      "fleet_heartbeat_s": 0.15, "fleet_lease_ttl_s": 0.6,
+                      "fleet_health_interval_s": 0.1,
+                      "telemetry_interval_s": 0.2})
+    yield
+    _flags.set_flags(keep)
+
+
+@pytest.mark.slow
+class TestOnlineFaultMatrixSoak:
+    def test_full_pipeline_fault_matrix(self, tmp_path):
+        from paddle_tpu._native import TCPStore
+        from paddle_tpu.obs import telemetry
+        from paddle_tpu.serving import FleetRouter
+
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        group = "online"
+        stats_b = str(tmp_path / "ps-b.stats")
+        stats_a2 = str(tmp_path / "ps-a2.stats")
+        stats_r1 = str(tmp_path / "r1.stats")
+        wal_a, wal_b = str(tmp_path / "wal-a"), str(tmp_path / "wal-b")
+
+        # -- the fleet, before any faults are armed --------------------
+        col = telemetry.TelemetryCollector(store, fleet="online").start()
+        exp = telemetry.TelemetryExporter(
+            store, source="trainer", role="trainer", fleet="online",
+            interval_s=0.2).start()
+        proc_a, role_a = _spawn_ps(
+            store, group, wal_a, tmp_path, "a",
+            {"PS_RUNNER_SEED_GRAPH": f"graph:{N_IDS}",
+             "FLAGS_fault_inject":
+                 "ps.delta.push:conn_reset:times=2:after=20"})
+        assert role_a == "primary"
+        proc_b, role_b = _spawn_ps(
+            store, group, wal_b, tmp_path, "b",
+            {"PS_RUNNER_STATS": stats_b,
+             "FLAGS_ps_snapshot_every_records": "40",
+             "FLAGS_fault_inject":
+                 "ps.delta.push:conn_reset:times=2:after=20;"
+                 "ps.snapshot.commit:error:times=1:after=1"})
+        assert role_b == "standby"
+        proc_r1, _ = _spawn_replica(store, group, tmp_path, "r1",
+                                    {"ONLINE_RUNNER_STATS": stats_r1})
+        proc_r2, rid_r2 = _spawn_replica(store, group, tmp_path, "r2", {})
+
+        client = psha.connect(store, group, backoff_ms=20.0)
+        comm = Communicator(client)
+        client.create_sparse_table("emb", DIM, optimizer="sgd", lr=LR,
+                                   seed=SEED, accessor="ctr",
+                                   delete_threshold=0.05, ttl_days=3.0)
+        all_ids = np.arange(N_IDS, dtype=np.int64)
+        client.pull_sparse("emb", all_ids)
+        oracle = SparseTable(dim=DIM, optimizer="sgd", lr=LR, seed=SEED,
+                             accessor="ctr", delete_threshold=0.05,
+                             ttl_days=3.0)
+        oracle.pull(all_ids)
+
+        router = FleetRouter(store).start()
+        deadline = time.monotonic() + 20
+        while len(router.healthy_replicas()) < 2:
+            assert time.monotonic() < deadline, "replicas never became healthy"
+            time.sleep(0.1)
+            router.refresh()
+
+        truth = np.random.default_rng(3).normal(size=N_IDS + 2) * 1.5
+        rng = np.random.default_rng(17)
+        labels, served, oracle_preds = [], [], []
+        steps, kill_ps_at, kill_rep_at = 60, 20, 28
+        respawn_rep_at, respawn_ps_at = 34, 40
+        procs = [proc_a, proc_b, proc_r1, proc_r2]
+        proc_a2 = proc_r2b = None
+
+        parent_faults = faults.register(
+            "ps.rpc.send:conn_reset:times=2:after=15;"
+            "router.dispatch:conn_reset:times=2:after=10;"
+            "net.serving.send:conn_reset:times=2:after=25;"
+            "telemetry.push:conn_reset:times=2:after=2")
+        try:
+            # cold rows: one impression, then silence until the TTL
+            comm.push_sparse_async("emb", COLD,
+                                   np.full((2, DIM), 0.5, np.float32))
+            oracle.push(COLD, np.full((2, DIM), 0.5, np.float32))
+            _retry_failover(lambda: client.push_show_click(
+                "emb", COLD, [1.0, 1.0], [0.0, 0.0]))
+            oracle.push_show_click(COLD, [1.0, 1.0], [0.0, 0.0])
+
+            for k in range(steps):
+                u = rng.integers(0, 12, 6).astype(np.int64)
+                it = rng.integers(12, 24, 6).astype(np.int64)
+                p_true = _sigmoid(truth[u] + truth[it])
+                y = rng.random(6) < p_true
+                # the model's own estimate, from the FAULT-FREE oracle
+                # rows (identical to the PS under the zero-loss claim)
+                p = _sigmoid(oracle.pull(u).mean(1)
+                             + oracle.pull(it).mean(1))
+                # route the prediction BEFORE training on its labels:
+                # what the replicas actually served, staleness and all
+                # (serve-after-train would leak this batch's labels into
+                # the served score and inflate its AUC past the oracle's)
+                x = np.stack([u, it], 1).astype(np.float32)
+                try:
+                    st, outs = router.run([x], deadline_ms=3000)
+                    if st == 0:
+                        served.extend(outs[0].ravel().tolist())
+                        oracle_preds.extend(p.tolist())
+                        labels.extend(y.tolist())
+                except Exception:
+                    pass                       # failover gap: skip sample
+                # signSGD keeps every pushed grad at |g| = 0.5, so one
+                # lost or doubled push moves a row past the audit atol
+                gsign = np.where(p - y >= 0, 0.5, -0.5).astype(np.float32)
+                ids = np.concatenate([u, it])
+                g = np.concatenate([np.tile(gsign[:, None], (1, DIM))] * 2)
+                comm.push_sparse_async("emb", ids, g)
+                oracle.push(ids, g)
+                _retry_failover(lambda: client.push_show_click(
+                    "emb", ids, np.ones(12), np.concatenate([y, y])))
+                oracle.push_show_click(ids, np.ones(12),
+                                       np.concatenate([y, y]))
+
+                if k % 5 == 4:
+                    # graph neighbor propagation: whatever the PS
+                    # samples, BOTH sides push the same grads to
+                    nb, _w = _retry_failover(
+                        lambda: client.sample_neighbors("graph", it, 2))
+                    flat = nb[nb >= 0].astype(np.int64)
+                    errs = np.repeat(gsign, 2)[(nb >= 0).ravel()]
+                    gn = np.tile(errs[:, None], (1, DIM)).astype(np.float32)
+                    comm.push_sparse_async("emb", flat, gn)
+                    oracle.push(flat, gn)
+
+                if k == kill_ps_at:
+                    os.kill(proc_a.pid, signal.SIGKILL)
+                    proc_a.wait(timeout=10)
+                if k == kill_rep_at:
+                    os.kill(proc_r2.pid, signal.SIGKILL)
+                    proc_r2.wait(timeout=10)
+                if k == respawn_rep_at:
+                    proc_r2b, _ = _spawn_replica(
+                        store, group, tmp_path, "r2b",
+                        {"FLEET_REPLICA_ID": str(rid_r2)})
+                    procs.append(proc_r2b)
+                if k == respawn_ps_at:
+                    proc_a2, role_a2 = _spawn_ps(
+                        store, group, wal_a, tmp_path, "a2",
+                        {"PS_RUNNER_STATS": stats_a2,
+                         "FLAGS_fault_inject":
+                             "ps.wal.write:torn:times=1"})
+                    procs.append(proc_a2)
+                    assert role_a2 == "standby"
+                if 45 <= k <= 48:              # four decay cycles, spread
+                    _retry_failover(lambda: client.decay("emb"))
+                    oracle.decay()
+                    # every live id gets an impression between decays:
+                    # only COLD ages past the TTL
+                    _retry_failover(lambda: client.push_show_click(
+                        "emb", all_ids, np.ones(N_IDS), np.zeros(N_IDS)))
+                    oracle.push_show_click(all_ids, np.ones(N_IDS),
+                                           np.zeros(N_IDS))
+                if k == 49:                    # TTL-shrink: COLD dies
+                    evicted = _retry_failover(
+                        lambda: client.shrink("emb"))
+                    assert evicted == len(COLD)
+                    assert oracle.shrink() == len(COLD)
+                time.sleep(0.02)               # stream, don't batch
+
+            comm.flush(timeout=120.0)
+        finally:
+            try:
+                comm.stop()
+            except Exception:
+                pass
+            faults.unregister(parent_faults)
+
+        try:
+            # ---- audit 1: PS vs fault-free oracle, row-for-row -------
+            got = _retry_failover(
+                lambda: client.pull_sparse("emb", all_ids))
+            np.testing.assert_allclose(got, oracle.pull(all_ids),
+                                       atol=1e-4)
+
+            # ---- audit 2: both replicas converge to the PS rows ------
+            want = np.asarray(got, np.float32)
+            for tag, proc in (("r1", proc_r1), ("r2b", proc_r2b)):
+                deadline = time.monotonic() + 30
+                while True:
+                    arrays, stats = _dump_replica(
+                        proc, str(tmp_path / f"dump-{tag}.npz"))
+                    keys = arrays["emb::keys"]
+                    ok = (sorted(keys.tolist()) == all_ids.tolist()
+                          and np.array_equal(
+                              arrays["emb::rows"][np.argsort(keys)], want))
+                    if ok or time.monotonic() > deadline:
+                        break
+                    time.sleep(0.25)
+                assert ok, f"replica {tag} never converged to the PS rows"
+                # staleness bound honored at the moment of the audit
+                assert stats["staleness_s"] is not None
+                assert stats["staleness_s"] < float(
+                    _flags.flag("online_max_staleness_s"))
+
+            # ---- audit 3: streaming AUC within +-0.01 of the oracle --
+            assert len(labels) >= steps * 4    # most batches got served
+            auc_served = _auc(labels, served)
+            auc_oracle = _auc(labels, oracle_preds)
+            assert abs(auc_served - auc_oracle) <= 0.01, \
+                (auc_served, auc_oracle)
+            assert auc_oracle > 0.55           # the stream actually learned
+
+            # ---- audit 4: every parent-side seam fired ---------------
+            fstats = faults.stats()
+            for site in ("ps.rpc.send", "router.dispatch",
+                         "net.serving.send", "telemetry.push"):
+                assert fstats[site]["injected"] >= 1, site
+
+            # telemetry kept flowing through its injected resets
+            assert "trainer" in col.sources
+        finally:
+            try:
+                client.close()
+            except Exception:
+                pass
+            router.close()
+            exp.stop()
+            col.stop()
+            _graceful_exit([p for p in procs if p.poll() is None])
+
+        # ---- audit 5: child-side seams fired (exit-time stats) -------
+        with open(stats_b) as f:
+            b = json.load(f)
+        assert b["role"] == "primary"          # the standby promoted
+        assert b["faults"]["ps.delta.push"]["injected"] >= 1
+        assert b["faults"]["ps.snapshot.commit"]["injected"] == 1
+        assert b["counters"].get("ps.snapshot.failures", 0) >= 1
+        with open(stats_a2) as f:
+            a2 = json.load(f)
+        assert a2["faults"]["ps.wal.write"]["injected"] == 1
+        with open(stats_r1) as f:
+            r1 = json.load(f)
+        # the delta subscriber rode out the injected stream resets
+        assert r1["counters"].get("ps.delta.pull_errors", 0) >= 1
+        assert r1["table"]["rows"] == N_IDS

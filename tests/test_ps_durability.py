@@ -17,7 +17,7 @@ import paddle_tpu as paddle
 from paddle_tpu import faults, monitor
 from paddle_tpu.core import flags as _flags
 from paddle_tpu.distributed.ps import (Communicator, PsClient, PsServer,
-                                       PsSnapshotUnsupportedError, SeqLedger)
+                                       SeqLedger)
 from paddle_tpu.distributed.ps import wal as _wal
 
 
@@ -216,17 +216,100 @@ class TestSnapshotRecovery:
             s2.stop()
         assert deleted >= 0
 
-    def test_graph_table_snapshot_raises_typed(self, tmp_path):
-        s = PsServer("127.0.0.1", 0, wal_dir=str(tmp_path))
+    def test_graph_table_snapshot_restart_bit_identical(self, tmp_path):
+        """Graph tables ride snapshots: adjacency (per-node insertion
+        order included — it feeds seeded neighbor sampling), weights,
+        isolated nodes, and node feats all round-trip a cold restart
+        BIT-identically, so a restarted sampler replays the same walk."""
+        d = str(tmp_path)
+        s = PsServer("127.0.0.1", 0, wal_dir=d)
         s.add_sparse_table("emb", dim=4)
-        g = s.add_graph_table("graph")
-        g.add_edges([1, 2], [2, 3])
+        g = s.add_graph_table("graph", weighted=True, feat_dim=2, seed=7)
+        g.add_edges([1, 1, 2], [2, 3, 3], weight=[0.5, 1.5, 1.0])
+        g.add_edges([9], [9])                         # self-loop
+        g.set_node_feat([1, 3], np.arange(4, dtype=np.float32).reshape(2, 2))
         s.run()
+        want = {k: v.copy() for k, v in g.snapshot_arrays().items()}
+        s.snapshot()
+        s.stop()
+
+        s2 = PsServer("127.0.0.1", 0, wal_dir=d)      # cold restart
         try:
-            with pytest.raises(PsSnapshotUnsupportedError):
-                s.snapshot()
+            g2 = s2.table("graph")
+            got = g2.snapshot_arrays()
+            assert set(got) == set(want)
+            for k in want:
+                np.testing.assert_array_equal(got[k], want[k])
+            assert g2.neighbors(1) == g.neighbors(1)  # order preserved
+            np.testing.assert_array_equal(g2.get_node_feat([1, 3]),
+                                          g.get_node_feat([1, 3]))
         finally:
+            s2.stop()
+
+    def test_graph_registration_survives_wal_only_crash(self, tmp_path):
+        """A crash BEFORE any snapshot: the graph table comes back
+        registered (R_ADD_GRAPH replays) though its content — which only
+        rides snapshots — starts empty. Present-but-empty beats a typed
+        lookup error on the serving path."""
+        d = str(tmp_path)
+        s = PsServer("127.0.0.1", 0, wal_dir=d)
+        s.add_graph_table("graph", feat_dim=2)
+        s.table("graph").add_edges([1], [2])
+        s.stop()                                       # no snapshot taken
+        s2 = PsServer("127.0.0.1", 0, wal_dir=d)
+        try:
+            g2 = s2.table("graph")
+            assert g2.n_nodes() == 0                   # content was volatile
+            g2.add_edges([4], [5])                     # and it still works
+            assert g2.neighbors(4)[0] == [5]
+        finally:
+            s2.stop()
+
+    def test_ctr_shrink_spanning_snapshot_replays_exactly(self, tmp_path):
+        """The ISSUE-19 online-learning sequence: decay -> snapshot ->
+        shrink -> crash. The shrink lands in the WAL suffix AFTER the
+        snapshot, so recovery must replay the eviction against the
+        snapshotted stats and delete EXACTLY the same rows."""
+        d = str(tmp_path)
+        s = PsServer("127.0.0.1", 0, wal_dir=d)
+        s.run()
+        c = PsClient([f"127.0.0.1:{s.port}"])
+        c.create_sparse_table("ctr", 4, optimizer="sgd", lr=0.5,
+                              accessor="ctr", delete_threshold=0.5,
+                              ttl_days=2.0)
+        c.register_sparse_dim("ctr", 4)
+        hot, cold = [1, 2], [8, 9]
+        try:
+            c.push_show_click("ctr", hot + cold, [9.0, 7.0, 0.1, 0.2],
+                              [3.0, 2.0, 0.0, 0.0])
+            c.decay("ctr")
+            s.snapshot()                   # stats frozen mid-trajectory
+            # hot rows keep getting impressions; cold rows go dark
+            c.push_show_click("ctr", hot, [2.0, 1.0], [1.0, 0.0])
+            c.decay("ctr")
+            c.decay("ctr")                 # cold: score < 0.5 AND past TTL
+            deleted = c.shrink("ctr")      # WAL suffix: spans the snapshot
+            assert deleted == len(cold)
+            survivors = c.pull_sparse("ctr", hot).copy()
+            alive = sorted(int(k) for k in s.table("ctr")._rows)
+        finally:
+            c.close()
             s.stop()
+
+        s2 = PsServer("127.0.0.1", 0, wal_dir=d)
+        s2.run()
+        try:
+            t2 = s2.table("ctr")
+            assert sorted(int(k) for k in t2._rows) == alive == hot
+            c2 = PsClient([f"127.0.0.1:{s2.port}"])
+            c2.register_sparse_dim("ctr", 4)
+            np.testing.assert_array_equal(
+                c2.pull_sparse("ctr", hot), survivors)
+            # replayed shrink is idempotent: nothing else to evict
+            assert c2.shrink("ctr") == 0
+            c2.close()
+        finally:
+            s2.stop()
 
 
 # ---------------------------------------------------------------------------
