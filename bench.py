@@ -1029,6 +1029,66 @@ def bench_telemetry(backend):
     }
 
 
+def bench_sync(backend):
+    """Runtime concurrency-sanitizer tax A/B (utils/syncwatch.py): the
+    same serving burst with FLAGS_sync_watch off vs on. On the on arm
+    the engine's dispatch lock (and every other factory-built lock
+    constructed under the flag) is a watched wrapper doing held-set +
+    order-graph bookkeeping per outermost acquire; the acceptance target
+    is <=2% serving p99 tax. Off-arm locks are plain `threading.Lock`
+    (the PR-1 one-attribute-check contract), so the off arm IS the
+    baseline.
+
+    Knob: BENCH_SYNC=ab|off (default ab runs both arms)."""
+    import paddle_tpu.monitor as monitor
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.serving import engine as _eng
+    from paddle_tpu.utils import syncwatch as _syncwatch
+
+    if os.environ.get("BENCH_SYNC", "ab").lower() == "off":
+        return {"skipped": "BENCH_SYNC=off"}
+
+    n_req = 400 if backend == "tpu" else 200
+
+    def one_arm(on):
+        _flags.set_flags({"sync_watch": on})
+        _syncwatch._reset()
+        try:
+            # engine constructed UNDER the flag: its dispatch lock is
+            # watched on the on arm, plain on the off arm
+            eng = _eng.ServingEngine(lambda arrays: arrays).start()
+            x = np.random.rand(1, 16).astype("float32")
+            p99s = []
+            try:
+                for _ in range(20):           # warm the bucket executable
+                    eng.submit([x]).result(timeout=10)
+                for _ in range(3):            # median p99: the tail of a
+                    lat = []                  # short burst is noisy
+                    for _ in range(n_req):
+                        t1 = time.perf_counter()
+                        eng.submit([x]).result(timeout=10)
+                        lat.append(time.perf_counter() - t1)
+                    p99s.append(float(np.quantile(lat, 0.99)))
+            finally:
+                eng.stop()
+            return float(np.median(p99s)) * 1e6, _syncwatch.violations()
+        finally:
+            _flags.set_flags({"sync_watch": False})
+            _syncwatch._reset()
+            monitor.reset()
+
+    p99_off, _ = one_arm(False)
+    p99_on, violations = one_arm(True)
+    return {
+        "requests_per_arm": n_req,
+        "serving_p99_us_off": round(p99_off, 1),
+        "serving_p99_us_on": round(p99_on, 1),
+        "serving_p99_tax_pct": round((p99_on - p99_off) / p99_off * 100, 2)
+        if p99_off else None,
+        "order_violations": violations,
+    }
+
+
 def bench_autoscale(backend):
     """Elastic-autoscaler drill + decision-loop tax (serving/autoscaler.py).
 
@@ -1614,6 +1674,7 @@ def main():
                     ("allreduce_smoke", bench_allreduce),
                     ("serving_slo", bench_serving_slo),
                     ("telemetry", bench_telemetry),
+                    ("sync", bench_sync),
                     ("autoscale", bench_autoscale),
                     ("net", bench_net),
                     ("ps_durability", bench_ps_durability),

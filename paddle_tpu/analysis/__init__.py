@@ -1,7 +1,7 @@
 """paddle_tpu.analysis — tpu-lint, the static-analysis plane.
 
-Three levels (ISSUE: trace safety, graph hygiene, collective-deadlock
-detection), all runnable offline and at compile time:
+Four levels (trace safety, graph hygiene, collective-deadlock detection,
+thread/lock concurrency), all runnable offline and at compile time:
 
   1. source lint (`analysis.lint`): AST scan of trace-destined functions
      for host syncs, tensor-dependent Python control flow, traced print,
@@ -9,12 +9,18 @@ detection), all runnable offline and at compile time:
   2. graph analysis (`analysis.graph`): jaxpr/Program walks for dead ops,
      unused inputs, implicit f64 widenings, host callbacks, and
      collective-ordering verification across ranks/pipeline stages;
-  3. driver: `python -m paddle_tpu.analysis <paths>` (severities,
+  3. concurrency analysis (`analysis.concurrency`): whole-package AST
+     pass building the static lock-acquisition graph — `lock-order`
+     inversions, `blocking-under-lock`, `unregistered-thread` (the
+     static half of `utils/syncwatch.py`, which observes the same graph
+     live under `FLAGS_sync_watch`);
+  4. driver: `python -m paddle_tpu.analysis <paths>` (severities,
      `# tpu-lint: disable=RULE` suppressions, `--json`), the same rules as
-     registered passes (`prog.apply_pass('lint')`, `'dead_op_elim'` in
-     `static/passes.py`), and a trace-time hook behind `FLAGS_lint`
-     (warnings + `lint.findings`/`lint.files` monitor counters; the
-     disabled path is one module-attribute check, like `faults`/`monitor`).
+     registered passes (`prog.apply_pass('lint')`, `'concurrency'`,
+     `'dead_op_elim'` in `static/passes.py`), and a trace-time hook behind
+     `FLAGS_lint` (warnings + `lint.findings`/`lint.files` monitor
+     counters; the disabled path is one module-attribute check, like
+     `faults`/`monitor`).
 """
 from __future__ import annotations
 
@@ -31,6 +37,7 @@ __all__ = [
     "analyze_jaxpr", "analyze_program",
     "collective_sequence", "verify_collective_order",
     "verify_stage_chain", "verify_stage_assignment",
+    "analyze_concurrency", "analyze_concurrency_paths", "lock_graph",
     "enabled", "enable", "disable", "lint_traced", "main",
 ]
 
@@ -68,6 +75,15 @@ def __getattr__(name):
                 "iter_eqns", "live_eqn_mask"):
         from . import graph as _graph
         return getattr(_graph, name)
+    # level 4 (concurrency) stays lazy like level 2: importing the core
+    # linter must not grow
+    if name in ("analyze_concurrency", "analyze_concurrency_paths",
+                "lock_graph", "find_cycles"):
+        from . import concurrency as _concurrency
+        return {"analyze_concurrency": _concurrency.analyze_source,
+                "analyze_concurrency_paths": _concurrency.analyze_paths,
+                "lock_graph": _concurrency.lock_graph,
+                "find_cycles": _concurrency.find_cycles}[name]
     if name == "main":
         from .cli import main as _main
         return _main

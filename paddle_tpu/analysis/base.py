@@ -103,6 +103,20 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
     Rule("stage-graph", Severity.ERROR,
          "pipeline stage wiring broken: a stage's output cannot feed the "
          "next stage, or a stage has no owner — the pipeline hangs"),
+    # -- concurrency rules (analysis/concurrency.py, whole-package AST) --
+    Rule("lock-order", Severity.ERROR,
+         "two code paths acquire the same pair of locks in opposite "
+         "orders — two threads running them concurrently deadlock; the "
+         "finding names both sites of the cycle"),
+    Rule("blocking-under-lock", Severity.ERROR,
+         "unbounded blocking (socket recv/accept, queue.get/join/wait "
+         "with no timeout, long time.sleep, RPC call_with_retry) inside "
+         "a held-lock region — every contending thread stalls for the "
+         "full blocking duration; move it out or bound it"),
+    Rule("unregistered-thread", Severity.WARNING,
+         "raw threading.Thread() outside the syncwatch ThreadRegistry — "
+         "invisible to the leak fixtures and the `monitor threads` "
+         "table; spawn via syncwatch.Thread(..., owner=__name__)"),
 ]}
 
 

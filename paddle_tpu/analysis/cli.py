@@ -32,6 +32,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--entry", action="append", default=[],
                    help="extra function NAME treated as trace-destined "
                         "(repeatable)")
+    p.add_argument("--no-concurrency", action="store_true",
+                   help="skip level 4 (lock-order / blocking-under-lock / "
+                        "unregistered-thread); by default the concurrency "
+                        "pass runs over the same paths")
     p.add_argument("--rules", default="",
                    help="comma-separated rule ids: report only these")
     p.add_argument("--disable", default="",
@@ -74,6 +78,9 @@ def main(argv: Optional[List[str]] = None,
         findings, n_files = lint_paths(args.paths,
                                        all_functions=args.all_functions,
                                        entries=args.entry)
+        if not args.no_concurrency:
+            from .concurrency import analyze_paths
+            findings += analyze_paths(args.paths)[0]
     except FileNotFoundError as e:
         print(f"tpu-lint: no such path: {e.args[0]}", file=sys.stderr)
         return 2

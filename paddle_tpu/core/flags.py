@@ -528,3 +528,24 @@ define_flag("lazy_cache_entries", 256,
             "(the ops/lazy.py executable ledger); least-recently-used entries are "
             "evicted beyond the cap (lazy.cache_evictions counter) instead "
             "of the cache growing without bound under shape churn")
+
+# ---- concurrency sanitizer (utils/syncwatch.py) ---------------------------
+define_flag("sync_watch", False,
+            "concurrency sanitizer (utils/syncwatch.py): syncwatch.lock()/"
+            "rlock() factories hand out watched wrappers that record "
+            "per-thread held-sets + acquisition stacks, maintain the "
+            "observed lock-order graph, and raise SyncOrderError (naming "
+            "BOTH acquisition stacks) on a cycle BEFORE the acquire would "
+            "wedge; off = the factories return plain threading locks "
+            "(one module-attribute check at lock-construction time, zero "
+            "per-acquire cost)")
+define_flag("sync_hold_warn_ms", 0.0,
+            "syncwatch: warn with the acquisition stack when a watched "
+            "lock was held longer than this many ms (observed on release "
+            "into the sync.lock_hold_ms histogram; the live thread table "
+            "`python -m paddle_tpu.monitor threads` flags still-held "
+            "locks over the threshold); 0 = record the histogram only")
+define_flag("sync_order_fatal", True,
+            "syncwatch: raise SyncOrderError on a lock-order cycle "
+            "(False: warn + count sync.order_violations and continue — "
+            "for soaks that want the census without dying on first hit)")

@@ -24,6 +24,12 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 try:
+    from ..utils import syncwatch as _syncwatch
+except ImportError:
+    class _syncwatch:  # noqa: N801 — standalone: registry plane disabled
+        Thread = threading.Thread
+
+try:
     from .. import monitor as _monitor
 except ImportError:
     # spec-loaded standalone (tests/fleet_exec_2proc_runner.py keeps this
@@ -157,7 +163,7 @@ class Interceptor:
         self._error: Optional[BaseException] = None
 
     def start(self):
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = _syncwatch.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _loop(self):
@@ -399,7 +405,7 @@ class DistMessageBus(MessageBus):
             self._lsock.listen(16)
         self._port = self._lsock.getsockname()[1]
         store.set(f"fleetbus/{rank}", f"{host}:{self._port}")
-        self._accept_thread = threading.Thread(target=self._accept_loop,
+        self._accept_thread = _syncwatch.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
 
@@ -429,7 +435,7 @@ class DistMessageBus(MessageBus):
                     conn = _net.secure_server(conn, "bus")
                 except (_net.AuthError, OSError, ValueError):
                     continue  # unauthenticated peer: counted + dropped
-            threading.Thread(target=self._reader, args=(conn,),
+            _syncwatch.Thread(target=self._reader, args=(conn,),
                              daemon=True).start()
 
     def _reader(self, conn):

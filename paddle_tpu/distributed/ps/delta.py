@@ -44,6 +44,7 @@ from ... import faults as _faults
 from ... import monitor as _monitor
 from ...core import flags as _flags
 from ...utils import net as _net
+from ...utils import syncwatch as _syncwatch
 
 __all__ = ["DeltaBatch", "DeltaSubscriber", "rpc_delta", "serve_delta"]
 
@@ -224,7 +225,7 @@ class DeltaSubscriber:
             self._wake.clear()
 
     def start(self) -> "DeltaSubscriber":
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        self._thread = _syncwatch.Thread(target=self._loop, daemon=True,
                                         name="ps-delta-tail")
         self._thread.start()
         return self

@@ -38,6 +38,7 @@ from ... import monitor as _monitor
 from ...core import flags as _flags
 from ...framework.sharded_io import atomic_write
 from ...parallel.elastic import ElasticManager, PrefixStore
+from ...utils import syncwatch as _syncwatch
 
 __all__ = ["HaPsNode", "resolver", "connect"]
 
@@ -130,7 +131,7 @@ class HaPsNode:
             self._claim_primary()
         # one maintenance thread for both roles: a standby tails the
         # primary's delta stream; both roles keep ha-status.json fresh
-        self._loop_thread = threading.Thread(
+        self._loop_thread = _syncwatch.Thread(
             target=self._loop, daemon=True, name="ps-repl-tail")
         self._loop_thread.start()
         return self

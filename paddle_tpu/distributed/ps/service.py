@@ -112,6 +112,7 @@ class CommunicatorFlushTimeout(TimeoutError):
 
 from ...utils import net as _net  # noqa: E402
 from ...utils.net import recv_exact as _recv_exact  # noqa: E402
+from ...utils import syncwatch as _syncwatch
 
 
 def _tname(name: str) -> bytes:
@@ -174,14 +175,14 @@ class PsServer:
         # after a failover, a handed-back seq can arrive BELOW seqs the
         # new primary already applied and must still apply exactly once.
         self._ledger = _wal.SeqLedger()
-        self._seq_lock = threading.Lock()
+        self._seq_lock = _syncwatch.lock("ps.PsServer._seq_lock")
         # ---- durability plane ----
         if wal_dir is None:
             wal_dir = str(_flags.flag("ps_wal_dir")) or None
         self.wal_dir = wal_dir
         self._wal: Optional[_wal.WalWriter] = None
-        self._wal_lock = threading.Lock()
-        self._snap_lock = threading.Lock()
+        self._wal_lock = _syncwatch.lock("ps.PsServer._wal_lock")
+        self._snap_lock = _syncwatch.lock("ps.PsServer._snap_lock")
         self._commits_since_snap = 0
         self._snap_every = int(_flags.flag("ps_snapshot_every_records"))
         self._snap_skip_warned = False
@@ -457,7 +458,7 @@ class PsServer:
         return self._tables[name]
 
     def run(self, block=False):
-        self._thread = threading.Thread(target=self._serve, daemon=True,
+        self._thread = _syncwatch.Thread(target=self._serve, daemon=True,
                                         name="ps-serve")
         self._thread.start()
         if block:
@@ -479,7 +480,7 @@ class PsServer:
                 conn = _net.secure_server(conn, "ps")
             except (_net.AuthError, OSError, ValueError):
                 continue
-            threading.Thread(target=self._handle, args=(conn,),
+            _syncwatch.Thread(target=self._handle, args=(conn,),
                              daemon=True, name="ps-handler").start()
 
     def _barrier(self, n_participants: int):
@@ -1008,7 +1009,10 @@ class PsClient:
         # keeps only the sharding + verb framing
         self._chans: List[_net.RpcChannel] = [
             self._make_chan(ep) for ep in endpoints]
-        self._locks = [threading.Lock() for _ in endpoints]
+        # one shared syncwatch name: shard locks are acquired in ascending
+        # shard order by protocol, so order edges between them are noise
+        self._locks = [_syncwatch.lock("ps.PsClient._locks[]")
+                       for _ in endpoints]
         self._dims: Dict[str, int] = {}  # table -> row dim (accessor config)
         self._dense_sizes: Dict[str, list] = {}  # table -> per-server sizes
         self._client_id = _new_client_id()
@@ -1595,7 +1599,7 @@ class Communicator:
         # bounded queue: the worker blocking on its own full queue would
         # deadlock against the producers it is supposed to drain
         self._retry = collections.deque()
-        self._thread = threading.Thread(target=self._run, daemon=True,
+        self._thread = _syncwatch.Thread(target=self._run, daemon=True,
                                         name="ps-communicator")
         self._thread.start()
 

@@ -26,6 +26,7 @@ from typing import List, Optional
 from .. import monitor as _monitor
 from .. import obs as _obs
 from .errors import StepStalledError
+from ..utils import syncwatch as _syncwatch
 
 
 class StepWatchdog:
@@ -94,7 +95,7 @@ class StepWatchdog:
                 except BaseException as e:  # noqa: BLE001 — marshalled to caller
                     results.put((seq, False, e))
 
-        self._runner = threading.Thread(target=loop, daemon=True,
+        self._runner = _syncwatch.Thread(target=loop, daemon=True,
                                         name="guard-watchdog-runner")
         self._runner.start()
 

@@ -86,6 +86,7 @@ from ..utils.net import (  # noqa: E402
     STREAM_REQ_MAGIC as _STREAM_REQ_MAGIC, TRACE_MAGIC as _TRACE_MAGIC,
     recv_exact as _recv_exact, recv_trace_frame, send_status_frame,
     send_trace_frame)
+from ..utils import syncwatch as _syncwatch  # noqa: E402
 
 
 def _read_tensor(conn, deadline: Optional[float] = None) -> np.ndarray:
@@ -164,7 +165,7 @@ class PredictorServer:
         self.engine.start()
         if self.llm_engine is not None:
             self.llm_engine.start()
-        self._thread = threading.Thread(target=self._serve, daemon=True,
+        self._thread = _syncwatch.Thread(target=self._serve, daemon=True,
                                         name="predictor-serve")
         self._thread.start()
         return self
@@ -202,7 +203,7 @@ class PredictorServer:
                 conn = _net.secure_server(conn, "serving")
             except (_net.AuthError, OSError, ValueError):
                 continue  # unauthenticated/broken peer: counted + dropped
-            threading.Thread(target=self._handle, args=(conn,),
+            _syncwatch.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
     def _handle_one(self, conn) -> bool:

@@ -24,6 +24,7 @@ from .. import obs as _obs
 from ..core.tensor import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
+from ..utils import syncwatch as _syncwatch
 
 
 def default_collate_fn(batch):
@@ -59,7 +60,7 @@ class _PrefetchIter:
         self._pending = {}
         self._emit = 0
         for _ in range(n_workers):
-            t = threading.Thread(target=self._worker, daemon=True)
+            t = _syncwatch.Thread(target=self._worker, daemon=True)
             t.start()
             self._threads.append(t)
 

@@ -43,6 +43,7 @@ from .. import obs as _obs
 from ..obs import memory as _mem
 from ..core import flags as _flags
 from ..core.tensor import Tensor
+from ..utils import syncwatch as _syncwatch
 
 __all__ = ["DevicePrefetcher", "maybe_wrap"]
 
@@ -102,7 +103,7 @@ class _Session:
         self._step = step
         self._produced = 0
         self._consumed = 0
-        self._thread = threading.Thread(target=self._feed, daemon=True,
+        self._thread = _syncwatch.Thread(target=self._feed, daemon=True,
                                         name="prefetch-feeder")
         self._thread.start()
 

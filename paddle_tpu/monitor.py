@@ -883,6 +883,15 @@ def _render_flight_dump(doc: Dict[str, Any]) -> str:
     if slosec:
         from .obs import slo as _slo
         lines.extend(_slo.render_slo(slosec).splitlines())
+    # schema /5 sync section (older dumps simply lack the key); one
+    # summary line here — `monitor threads <dump>` renders the full table
+    syncsec = doc.get("sync")
+    if syncsec and syncsec.get("enabled"):
+        nviol = len(syncsec.get("violations") or [])
+        lines.append(f"sync: {len(syncsec.get('threads') or [])} registered "
+                     f"threads, {len(syncsec.get('lock_order') or [])} "
+                     f"lock-order edges, {nviol} violation(s)"
+                     + (" — see `monitor threads <dump>`" if nviol else ""))
     lines.append("-" * 78)
     return "\n".join(lines)
 
@@ -1182,6 +1191,17 @@ def _main(argv=None) -> int:
                        help="refresh N times (default 1: one-shot)")
     p_top.add_argument("--interval", type=float, default=1.0,
                        help="seconds between refreshes")
+    p_threads = sub.add_parser(
+        "threads", help="render the thread/lock table: registered threads "
+                        "with owners, held locks, the observed lock-order "
+                        "graph, and recorded order violations — from a "
+                        "flight dump's `sync` section, or (no path) this "
+                        "live process (utils/syncwatch.py)")
+    p_threads.add_argument("path", nargs="?", default=None)
+    p_threads.add_argument("--hold-warn-ms", type=float, default=None,
+                           help="dump acquisition stacks for locks held "
+                                "longer than this (default: "
+                                "FLAGS_sync_hold_warn_ms)")
     p_ps = sub.add_parser(
         "ps", help="render a parameter-server durability directory "
                    "(distributed/ps/wal.py): snapshot generations, WAL "
@@ -1197,6 +1217,25 @@ def _main(argv=None) -> int:
                 time.sleep(args.interval)
             doc = _telemetry.query_collector(host or "127.0.0.1", int(port))
             print(_telemetry.render_top(doc))
+        return 0
+    if args.cmd == "threads":
+        from .utils import syncwatch as _syncwatch
+        if args.path is None:
+            print(_syncwatch.render_threads(hold_warn_ms=args.hold_warn_ms))
+            return 0
+        doc = _load_artifact(args.path)
+        if not _is_flight_dump(doc):
+            print(f"error: {args.path} is not a flight-recorder dump "
+                  f"(schema: {doc.get('schema')!r})")
+            return 2
+        syncsec = doc.get("sync")
+        if not syncsec:
+            print(f"no sync section in dump "
+                  f"(schema: {doc.get('schema')!r} — /1–/4 dumps predate "
+                  "it, or the dumping process ran without FLAGS_sync_watch)")
+            return 0
+        print(_syncwatch.render_threads(syncsec,
+                                        hold_warn_ms=args.hold_warn_ms))
         return 0
     if args.cmd == "ps":
         return _ps_main(args)

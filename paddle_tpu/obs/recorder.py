@@ -38,9 +38,13 @@ __all__ = ["FlightRecorder", "DUMP_SCHEMA", "dump_to_chrome_events"]
 # obs/trace.py) and "slo" (error-budget burn, obs/slo.py). /4 adds the
 # OPTIONAL correlated-incident identity: "incident_id" (shared by every
 # fleet member's dump of one incident, obs/telemetry.py fan-out) and
-# "source" (the dumping process's telemetry source name). `monitor show`
-# renders every version — an older dump is simply one without the section.
-DUMP_SCHEMA = "paddle_tpu.flight_recorder/4"
+# "source" (the dumping process's telemetry source name). /5 adds "sync"
+# (the runtime deadlock sanitizer's view, utils/syncwatch.py: live
+# registered threads with held locks, the observed lock-order graph, and
+# any recorded order violations — {"enabled": False} when FLAGS_sync_watch
+# is off). `monitor show` renders every version — an older dump is simply
+# one without the section.
+DUMP_SCHEMA = "paddle_tpu.flight_recorder/5"
 
 _COLLECTIVE_RING = 256
 _EVENT_RING = 128
@@ -179,6 +183,8 @@ class FlightRecorder:
         from . import trace as _trace
         out["traces"] = _trace.ring_payload()
         out["slo"] = _slo.stats()
+        from ..utils import syncwatch as _syncwatch
+        out["sync"] = _syncwatch.dump_sync()
         if extra:
             out["extra"] = extra
         return out

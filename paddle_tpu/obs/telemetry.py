@@ -56,6 +56,7 @@ from .. import faults as _faults
 from .. import monitor as _monitor
 from ..core import flags as _flags
 from ..utils import net as _net
+from ..utils import syncwatch as _syncwatch
 
 __all__ = ["TelemetryExporter", "TelemetryCollector", "emit",
            "get_default", "query_collector", "render_top"]
@@ -117,7 +118,7 @@ class TelemetryExporter:
                                 else _flags.flag("telemetry_interval_s"))
         self._events: deque = deque(
             maxlen=max(1, int(_flags.flag("telemetry_buffer"))))
-        self._lock = threading.Lock()
+        self._lock = _syncwatch.lock("telemetry.TelemetryExporter._lock")
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -142,7 +143,7 @@ class TelemetryExporter:
         global _DEFAULT
         if self._thread is not None:
             return self
-        self._thread = threading.Thread(
+        self._thread = _syncwatch.Thread(
             target=self._run, name=f"telemetry-export-{self.source}",
             daemon=True)
         self._thread.start()
@@ -342,7 +343,7 @@ class TelemetryCollector:
         self.fleet = fleet
         self.host = host
         self.port = port
-        self._lock = threading.Lock()
+        self._lock = _syncwatch.lock("telemetry.TelemetryCollector._lock")
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
@@ -375,11 +376,11 @@ class TelemetryCollector:
         srv.settimeout(0.2)
         self.port = srv.getsockname()[1]
         self._listener = srv
-        t = threading.Thread(target=self._accept_loop,
+        t = _syncwatch.Thread(target=self._accept_loop,
                              name="telemetry-accept", daemon=True)
         t.start()
         self._threads.append(t)
-        r = threading.Thread(target=self._reap_loop,
+        r = _syncwatch.Thread(target=self._reap_loop,
                              name="telemetry-reap", daemon=True)
         r.start()
         self._threads.append(r)
@@ -435,7 +436,7 @@ class TelemetryCollector:
                 self._conns.append(conn)
                 self._conn_seq += 1
                 cid = self._conn_seq
-            t = threading.Thread(target=self._conn_loop,
+            t = _syncwatch.Thread(target=self._conn_loop,
                                  args=(conn, cid),
                                  name=f"telemetry-conn-{cid}", daemon=True)
             t.start()

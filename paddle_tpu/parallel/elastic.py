@@ -24,6 +24,7 @@ from typing import List, Optional, Sequence
 
 from .. import faults as _faults
 from .. import monitor as _monitor
+from ..utils import syncwatch as _syncwatch
 
 
 class PrefixStore:
@@ -73,7 +74,7 @@ class ElasticManager:
     # -- node side --
     def register(self):
         self._beat()
-        self._thread = threading.Thread(target=self._run, daemon=True,
+        self._thread = _syncwatch.Thread(target=self._run, daemon=True,
                                         name="elastic-heartbeat")
         self._thread.start()
         return self
@@ -131,7 +132,7 @@ class ElasticManager:
             iv = interval if interval is not None else \
                 min(1.0, self.heartbeat_interval)
             self._watch_stop.clear()
-            self._watch_thread = threading.Thread(
+            self._watch_thread = _syncwatch.Thread(
                 target=self._watch_loop, args=(iv,), daemon=True,
                 name="elastic-watcher")
             self._watch_thread.start()

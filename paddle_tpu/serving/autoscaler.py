@@ -62,6 +62,7 @@ from .. import monitor as _monitor
 from .. import obs as _obs
 from ..core import flags as _flags
 from .fleet import FleetError
+from ..utils import syncwatch as _syncwatch
 
 __all__ = ["Autoscaler", "ScalePolicy", "ScaleDecision", "ReplicaPool",
            "DecisionLedger"]
@@ -234,7 +235,7 @@ class DecisionLedger:
         self._ring: deque = deque(maxlen=max(
             4, int(ring if ring is not None
                    else _flags.flag("autoscaler_ledger_ring"))))
-        self._lock = threading.Lock()
+        self._lock = _syncwatch.lock("autoscaler.DecisionLedger._lock")
         self._seq = 0
         self._counts: Dict[str, int] = {}
 
@@ -465,7 +466,7 @@ class Autoscaler:
         if self._thread is not None:
             return self
         self.target = max(self.target, self.pool.actual())
-        self._thread = threading.Thread(
+        self._thread = _syncwatch.Thread(
             target=self._run, name="autoscaler-loop", daemon=True)
         self._thread.start()
         return self

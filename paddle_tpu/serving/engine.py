@@ -43,6 +43,7 @@ from ..core import compile_cache as _cc
 from ..core import executable as _exe
 from ..core import flags as _flags
 from .bucket import BucketSet, ShapeBucket, default_batch_sizes, signature_of
+from ..utils import syncwatch as _syncwatch
 
 __all__ = [
     "EngineConfig", "ServingEngine", "ResponseFuture",
@@ -196,7 +197,8 @@ class ServingEngine:
         self._inflight = 0
         self._stopping = False
         self._workers: List[threading.Thread] = []
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = _syncwatch.lock(
+            "engine.ServingEngine._dispatch_lock")
         # executable substrate: (batch, item-sig) ledger — novel → compiles.
         # The predictor's own to_static capture owns retrace accounting and
         # the persistent-cache hookup; the engine ledger keeps the serving-
@@ -277,7 +279,7 @@ class ServingEngine:
         if self.config.warmup_on_start:
             self.warmup()
         for i in range(max(1, self.config.num_workers)):
-            t = threading.Thread(target=self._worker_loop,
+            t = _syncwatch.Thread(target=self._worker_loop,
                                  name=f"serving-worker-{i}", daemon=True)
             t.start()
             self._workers.append(t)

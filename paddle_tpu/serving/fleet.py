@@ -110,7 +110,7 @@ class SequenceLedger:
     `duplicates` counts refused second settles."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _syncwatch.lock("fleet.SequenceLedger._lock")
         self._next = 0
         self._open: Dict[int, List[int]] = {}      # seq -> replicas tried
         self._settled: Dict[int, int] = {}         # seq -> replica that won
@@ -131,15 +131,20 @@ class SequenceLedger:
     def settle(self, seq: int, replica_id: int) -> bool:
         """First settle returns True; a later one is a DUPLICATE: refused,
         counted, and the caller must drop the response."""
+        # the monitor count stays OUTSIDE the critical section: it takes
+        # the registry lock, and nesting that under the ledger lock puts
+        # a foreign lock inside the request hot path (syncwatch dogfood)
         with self._lock:
             if seq in self._settled:
                 self._duplicates += 1
-                if _monitor._ENABLED:
-                    _monitor.count("fleet.duplicates_dropped")
-                return False
-            self._settled[seq] = replica_id
-            self._open.pop(seq, None)
-            return True
+                dup = True
+            else:
+                self._settled[seq] = replica_id
+                self._open.pop(seq, None)
+                dup = False
+        if dup and _monitor._ENABLED:
+            _monitor.count("fleet.duplicates_dropped")
+        return not dup
 
     def reject(self, seq: int, why: str) -> None:
         """Terminal non-answer (deadline, no healthy replica): the caller
@@ -185,7 +190,7 @@ class ModelTenant:
         self.dirname = dirname
         self.handler_factory = handler_factory
         self._handler: Optional[Callable] = None
-        self._lock = threading.Lock()
+        self._lock = _syncwatch.lock("fleet.ModelTenant._lock")
         self.version = 0
         self.bytes = 0
         self._bytes_hint = bytes_hint
@@ -457,6 +462,7 @@ class ReplicaAgent:
 # promoted to parallel/elastic.py (the PS HA plane shares it); the
 # underscore alias keeps this module's call sites and pickles stable
 from ..parallel.elastic import PrefixStore as _PrefixStore  # noqa: E402
+from ..utils import syncwatch as _syncwatch
 
 
 # ---- router side ------------------------------------------------------------
@@ -477,7 +483,7 @@ class _ReplicaHandle:
         self.died_at: Optional[float] = None
         self.detected_dead_at: Optional[float] = None
         self._pool: List[Any] = []
-        self._pool_lock = threading.Lock()
+        self._pool_lock = _syncwatch.lock("fleet._ReplicaHandle._pool_lock")
 
     def acquire(self, connect_timeout: float):
         with self._pool_lock:
@@ -551,7 +557,7 @@ class FleetRouter:
         self.replicas: Dict[int, _ReplicaHandle] = {}
         self.ledger = SequenceLedger()
         self.slo = slo
-        self._lock = threading.Lock()
+        self._lock = _syncwatch.lock("fleet.FleetRouter._lock")
         self._stop = threading.Event()
         self._burn_weight = float(_flags.flag("fleet_route_burn_weight"))
         self._connect_timeout = float(
@@ -583,7 +589,7 @@ class FleetRouter:
             self._on_rank_dead,
             interval=min(self._health_interval,
                          self._elastic.heartbeat_interval))
-        self._health_thread = threading.Thread(
+        self._health_thread = _syncwatch.Thread(
             target=self._health_loop, daemon=True, name="fleet-health")
         self._health_thread.start()
         return self
@@ -713,6 +719,7 @@ class FleetRouter:
                 rec = json.loads(raw.decode())
             except ValueError:
                 continue
+            joined = False
             with self._lock:
                 h = self.replicas.get(rid)
                 rejoin = (h is not None
@@ -721,10 +728,15 @@ class FleetRouter:
                 if h is None or rejoin:
                     h = _ReplicaHandle(rid, rec["host"], rec["port"])
                     self.replicas[rid] = h
-                    if _monitor._ENABLED:
-                        _monitor.count("fleet.replicas_joined")
-                    _obs.record_event("fleet.replica_joined", replica=rid,
-                                      port=rec["port"], rejoin=rejoin)
+                    joined = True
+            # counter + event ride OUTSIDE the membership lock: both take
+            # foreign (monitor/obs ring) locks of their own, and nothing
+            # here needs the membership view (syncwatch dogfood)
+            if joined:
+                if _monitor._ENABLED:
+                    _monitor.count("fleet.replicas_joined")
+                _obs.record_event("fleet.replica_joined", replica=rid,
+                                  port=rec["port"], rejoin=rejoin)
             self._probe(h)
             self._reap_if_corpse(h)
 

@@ -61,6 +61,7 @@ from .engine import (
     DeadlineExceededError, EngineStoppedError, ServerOverloadedError,
     ServingError,
 )
+from ..utils import syncwatch as _syncwatch
 
 __all__ = ["LLMConfig", "LLMEngine", "LLMStream"]
 
@@ -353,7 +354,7 @@ class LLMEngine:
             return self
         if self.config.warmup_on_start:
             self._warmup()
-        self._thread = threading.Thread(target=self._run, daemon=True,
+        self._thread = _syncwatch.Thread(target=self._run, daemon=True,
                                         name="llm-scheduler")
         self._thread.start()
         return self

@@ -36,6 +36,7 @@ import numpy as np
 from .. import monitor as _monitor
 from ..core import flags as _flags
 from ..guard.checkpoint import save_guard_state
+from ..utils import syncwatch as _syncwatch
 
 __all__ = ["StalenessExceededError", "OnlineServingTable",
            "save_serving_generation", "load_serving_tables",
@@ -301,7 +302,7 @@ class OnlineRollbackGuard:
             self.check_once()
 
     def start(self) -> "OnlineRollbackGuard":
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        self._thread = _syncwatch.Thread(target=self._loop, daemon=True,
                                         name="online-guard")
         self._thread.start()
         return self

@@ -289,3 +289,35 @@ def _lint_pass(program, fail_on: str = None):
 
 _lint_pass._program_pass = True
 register_pass("lint", _lint_pass)
+
+
+def _concurrency_pass(program, fail_on: str = None):
+    """Analysis-only PROGRAM pass: run tpu-lint's concurrency rules
+    (lock-order, blocking-under-lock, unregistered-thread) over the
+    module that defines the captured function — the threading context
+    the program executes in, not the jaxpr itself. Findings are warned
+    and stored as `.concurrency_findings`; `fail_on=` gates like the
+    lint pass. Builtins/C functions have no source file: no findings."""
+    import inspect
+    import warnings
+    from ..analysis.base import severity_at_least
+    from ..analysis.concurrency import analyze_paths
+    try:
+        path = inspect.getsourcefile(program._fn)
+    except TypeError:
+        path = None
+    findings = analyze_paths([path])[0] if path else []
+    for f in findings:
+        warnings.warn(f"tpu-lint[pass]: {f.format()}")
+    program.concurrency_findings = findings
+    if fail_on is not None:
+        bad = [f for f in findings if severity_at_least(f.severity, fail_on)]
+        if bad:
+            raise ValueError(
+                f"concurrency pass: {len(bad)} finding(s) at/above "
+                f"{fail_on}:\n" + "\n".join(f.format() for f in bad))
+    return program
+
+
+_concurrency_pass._program_pass = True
+register_pass("concurrency", _concurrency_pass)

@@ -250,6 +250,7 @@ def send_frames(sock, frames) -> None:
 from .. import faults as _faults      # noqa: E402
 from .. import monitor as _monitor    # noqa: E402
 from ..core import flags as _flags    # noqa: E402
+from . import syncwatch as _syncwatch  # noqa: E402
 
 # 'PDAH' — auth handshake, sent by the client immediately after connect
 # when FLAGS_net_auth_token is set: u32 magic + 16B nonce + 16B
@@ -853,7 +854,7 @@ class RpcServer:
         self._listener_closed = False
 
     def start(self) -> "RpcServer":
-        self._thread = threading.Thread(
+        self._thread = _syncwatch.Thread(
             target=self._accept_loop, daemon=True, name=self._name)
         self._thread.start()
         return self
@@ -872,7 +873,7 @@ class RpcServer:
             except (AuthError, OSError, ValueError):
                 continue  # counted in secure_server; peer is gone
             self._conns.add(conn)
-            threading.Thread(target=self._run_handler, args=(conn, addr),
+            _syncwatch.Thread(target=self._run_handler, args=(conn, addr),
                              daemon=True,
                              name=f"{self._name}-conn").start()
 

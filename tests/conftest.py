@@ -99,30 +99,169 @@ def _no_fault_leak():
         f"(was {active_before})")
 
 
-@pytest.fixture(autouse=True)
-def _no_lazy_leak():
+def _reap_autoscaler(errors):
+    """A leaked autoscaler keeps its control loop scaling a dead fleet —
+    and holds every ReplicaAgent its pool spawned. Reaped FIRST: close()
+    also stops the pool's spawned handles, so the fleet/telemetry planes
+    below see a quiet world."""
+    from paddle_tpu.serving import autoscaler as _autoscaler
+    leaked = [a for a in list(_autoscaler._LIVE)
+              if not getattr(a, "_closed", True)]
+    for a in leaked:
+        try:
+            a.close()
+        except Exception:
+            pass
+    if leaked:
+        errors.append(
+            f"{len(leaked)} autoscaler(s) leaked out of the test "
+            f"(Autoscaler.close() never reached): "
+            f"{[type(o).__name__ for o in leaked]}")
+
+
+def _reap_fleet(errors):
+    """A fleet router or replica agent leaking out of a test keeps its
+    health/heartbeat/watcher threads probing dead endpoints under every
+    later test."""
+    from paddle_tpu.serving import fleet as _fleet
+    from paddle_tpu.serving import online as _online
+    leaked = [obj for obj in list(_fleet._LIVE)
+              if not getattr(obj, "_closed", True)]
+    leaked += [g for g in list(_online._LIVE)
+               if g._thread is not None and g._thread.is_alive()]
+    for obj in leaked:
+        try:
+            obj.close() if hasattr(obj, "close") else obj.stop(drain=False)
+        except Exception:
+            pass
+    if leaked:
+        errors.append(
+            f"{len(leaked)} fleet object(s) leaked out of the test "
+            f"(router.close()/agent.stop() never reached): "
+            f"{[type(o).__name__ for o in leaked]}")
+
+
+def _reap_telemetry(errors):
+    """A leaked exporter keeps pushing this process's metrics (and holds
+    the module-default slot) under every later test; a leaked collector
+    keeps its accept/conn/reap threads and the rendezvous record alive."""
+    from paddle_tpu.obs import telemetry as _telemetry
+    leaked = [obj for obj in list(_telemetry._LIVE)
+              if getattr(obj, "_thread", None) is not None
+              or getattr(obj, "_listener", None) is not None]
+    for obj in leaked:
+        try:
+            obj.stop()
+        except Exception:
+            pass
+    if _telemetry._DEFAULT is not None:
+        _telemetry._DEFAULT = None
+    if leaked:
+        errors.append(
+            f"{len(leaked)} telemetry object(s) leaked out of the test "
+            f"(exporter.stop()/collector.stop() never reached): "
+            f"{[type(o).__name__ for o in leaked]}")
+
+
+def _reap_ps(errors):
+    """A PS server, HA node, or WAL writer leaking out of a test keeps
+    accept/replication/communicator threads (and an open WAL segment)
+    alive under every later test."""
+    from paddle_tpu.distributed.ps import delta as _ps_delta
+    from paddle_tpu.distributed.ps import ha as _ps_ha
+    from paddle_tpu.distributed.ps import service as _ps_service
+    from paddle_tpu.distributed.ps import wal as _ps_wal
+    leaked = [n for n in list(_ps_ha._LIVE)
+              if not getattr(n, "_closed", True)]
+    leaked += [s for s in list(_ps_service._LIVE)
+               if not getattr(s, "_closed", True)
+               and not s._stop.is_set()]
+    leaked += [w for w in list(_ps_wal._LIVE_WRITERS) if not w.closed]
+    leaked += [d for d in list(_ps_delta._LIVE)
+               if d._thread is not None and d._thread.is_alive()]
+    for obj in leaked:
+        try:
+            obj.stop() if hasattr(obj, "stop") else obj.close()
+        except Exception:
+            pass
+    if leaked:
+        errors.append(
+            f"{len(leaked)} PS object(s) leaked out of the test "
+            f"(server.stop()/node.stop()/writer.close() never reached): "
+            f"{[type(o).__name__ for o in leaked]}")
+
+
+def _check_lazy(errors, flag_before):
     """A pending lazy segment (FLAGS_lazy_eager, ops/lazy.py) leaking out
     of a test would materialize inside some unrelated later test — or
-    worse, leave the flag on so every later test runs deferred. Assert the
-    calling thread's segment is drained and the flag is back to its
-    pre-test state after EVERY test (and drain/restore, so one offender
-    cannot cascade)."""
+    worse, leave the flag on so every later test runs deferred."""
     from paddle_tpu.core import flags as _flags
     from paddle_tpu.ops import lazy as _lazy
-    flag_before = _flags.flag("lazy_eager")
-    yield
     flag_after = _flags.flag("lazy_eager")
     pending = _lazy.pending_ops()
     if pending:
         _lazy.flush_pending()
+        errors.append(
+            f"{pending} deferred op(s) leaked out of the test "
+            "(paddle.sync() / flush_pending() not reached?)")
     if flag_after != flag_before:
         _flags.set_flags({"lazy_eager": flag_before})
-    assert flag_after == flag_before, (
-        f"FLAGS_lazy_eager leaked out of the test: {flag_after!r} "
-        f"(was {flag_before!r})")
-    assert pending == 0, (
-        f"{pending} deferred op(s) leaked out of the test "
-        "(paddle.sync() / flush_pending() not reached?)")
+        errors.append(
+            f"FLAGS_lazy_eager leaked out of the test: {flag_after!r} "
+            f"(was {flag_before!r})")
+
+
+def _check_obs(errors):
+    """An enabled obs plane leaking out of a test would add a
+    block_until_ready fence to every later jitted step."""
+    from paddle_tpu import obs as _obs
+    from paddle_tpu.core import flags as _flags
+    leaked = [n for n in ("obs_timeline", "obs_flight_recorder")
+              if _flags.flag(n)]
+    if leaked:
+        _flags.set_flags({n: False for n in leaked})
+        _obs.reset()
+        errors.append(f"obs flags leaked out of the test: {leaked}")
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leak():
+    """ONE teardown for every threaded plane (ISSUE 20): the per-plane
+    `_no_{autoscaler,fleet,telemetry,ps,lazy,obs}_leak` fixtures unified
+    onto the syncwatch ThreadRegistry. Every plane reaps its leftovers
+    FIRST (so one offender cannot cascade into later tests) with its
+    original assert message preserved; then the registry — which every
+    paddle_tpu thread now spawns through (`syncwatch.Thread`, lint rule
+    `unregistered-thread`) — polls for quiescence and names any still-live
+    thread by owner module + spawn stack, which the old name-list checks
+    never could."""
+    import time
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.utils import syncwatch as _syncwatch
+    lazy_flag_before = _flags.flag("lazy_eager")
+    before = {r["ident"] for r in _syncwatch.live_threads()}
+    yield
+    errors = []
+    # reap order matters: the autoscaler's close() stops the agents its
+    # pool spawned, so it runs before the fleet/telemetry checks
+    _reap_autoscaler(errors)
+    _reap_fleet(errors)
+    _reap_telemetry(errors)
+    _reap_ps(errors)
+    _check_lazy(errors, lazy_flag_before)
+    _check_obs(errors)
+    for _ in range(20):  # reaped threads need a beat to exit
+        live = [r for r in _syncwatch.live_threads()
+                if r["ident"] not in before]
+        if not live:
+            break
+        time.sleep(0.1)
+    for r in live:
+        spawned = "".join(r.get("spawned") or ["  <no spawn stack>\n"])
+        errors.append(
+            f"thread {r['name']!r} (owner {r['owner']}) leaked out of "
+            f"the test; spawned at:\n{spawned}")
+    assert not errors, "\n".join(errors)
 
 
 @pytest.fixture(autouse=True)
@@ -152,167 +291,3 @@ def _no_trace_leak():
         "test (Span.end() never reached — error path missing a close?)")
 
 
-@pytest.fixture(autouse=True)
-def _no_fleet_leak():
-    """A fleet router or replica agent leaking out of a test keeps its
-    health/heartbeat/watcher threads probing dead endpoints under every
-    later test. Assert the fleet plane is quiescent after EVERY test (and
-    reap leftovers, so one offender cannot cascade)."""
-    import threading
-    import time
-    from paddle_tpu.serving import fleet as _fleet
-    from paddle_tpu.serving import online as _online
-
-    def fleet_threads():
-        return [t.name for t in threading.enumerate()
-                if t.is_alive() and t.name in
-                ("fleet-health", "elastic-heartbeat", "elastic-watcher",
-                 "predictor-serve", "online-guard")]
-
-    before = len(fleet_threads())
-    yield
-    leaked = [obj for obj in list(_fleet._LIVE)
-              if not getattr(obj, "_closed", True)]
-    leaked += [g for g in list(_online._LIVE)
-               if g._thread is not None and g._thread.is_alive()]
-    for obj in leaked:
-        try:
-            obj.close() if hasattr(obj, "close") else obj.stop(drain=False)
-        except Exception:
-            pass
-    for _ in range(20):  # reaped threads need a beat to exit
-        after = fleet_threads()
-        if len(after) <= before:
-            break
-        time.sleep(0.1)
-    assert not leaked, (
-        f"{len(leaked)} fleet object(s) leaked out of the test "
-        f"(router.close()/agent.stop() never reached): "
-        f"{[type(o).__name__ for o in leaked]}")
-    assert len(after := fleet_threads()) <= before, (
-        f"fleet/elastic thread(s) leaked out of the test: {after}")
-
-
-@pytest.fixture(autouse=True)
-def _no_telemetry_leak():
-    """A leaked exporter keeps pushing this process's metrics (and holds
-    the module-default slot) under every later test; a leaked collector
-    keeps its accept/conn/reap threads and the rendezvous record alive.
-    Assert the telemetry plane is quiescent after EVERY test, reaping
-    leftovers so one offender cannot cascade."""
-    import threading
-    import time
-    from paddle_tpu.obs import telemetry as _telemetry
-
-    def telemetry_threads():
-        return [t.name for t in threading.enumerate()
-                if t.is_alive() and t.name.startswith("telemetry-")]
-
-    before = len(telemetry_threads())
-    yield
-    leaked = [obj for obj in list(_telemetry._LIVE)
-              if getattr(obj, "_thread", None) is not None
-              or getattr(obj, "_listener", None) is not None]
-    for obj in leaked:
-        try:
-            obj.stop()
-        except Exception:
-            pass
-    if _telemetry._DEFAULT is not None:
-        _telemetry._DEFAULT = None
-    for _ in range(20):  # reaped threads need a beat to exit
-        after = telemetry_threads()
-        if len(after) <= before:
-            break
-        time.sleep(0.1)
-    assert not leaked, (
-        f"{len(leaked)} telemetry object(s) leaked out of the test "
-        f"(exporter.stop()/collector.stop() never reached): "
-        f"{[type(o).__name__ for o in leaked]}")
-    assert len(after := telemetry_threads()) <= before, (
-        f"telemetry thread(s) leaked out of the test: {after}")
-
-
-@pytest.fixture(autouse=True)
-def _no_ps_leak():
-    """A PS server, HA node, or WAL writer leaking out of a test keeps
-    accept/replication/communicator threads (and an open WAL segment)
-    alive under every later test. Assert the PS plane is quiescent after
-    EVERY test, reaping leftovers so one offender cannot cascade."""
-    import threading
-    import time
-    from paddle_tpu.distributed.ps import delta as _ps_delta
-    from paddle_tpu.distributed.ps import ha as _ps_ha
-    from paddle_tpu.distributed.ps import service as _ps_service
-    from paddle_tpu.distributed.ps import wal as _ps_wal
-
-    def ps_threads():
-        return [t.name for t in threading.enumerate()
-                if t.is_alive() and t.name in
-                ("ps-serve", "ps-handler", "ps-repl-tail",
-                 "ps-communicator", "ps-delta-tail")]
-
-    before = len(ps_threads())
-    yield
-    leaked = [n for n in list(_ps_ha._LIVE)
-              if not getattr(n, "_closed", True)]
-    leaked += [s for s in list(_ps_service._LIVE)
-               if not getattr(s, "_closed", True)
-               and not s._stop.is_set()]
-    leaked += [w for w in list(_ps_wal._LIVE_WRITERS) if not w.closed]
-    leaked += [d for d in list(_ps_delta._LIVE)
-               if d._thread is not None and d._thread.is_alive()]
-    for obj in leaked:
-        try:
-            obj.stop() if hasattr(obj, "stop") else obj.close()
-        except Exception:
-            pass
-    for _ in range(20):  # reaped threads need a beat to exit
-        after = ps_threads()
-        if len(after) <= before:
-            break
-        time.sleep(0.1)
-    assert not leaked, (
-        f"{len(leaked)} PS object(s) leaked out of the test "
-        f"(server.stop()/node.stop()/writer.close() never reached): "
-        f"{[type(o).__name__ for o in leaked]}")
-    assert len(after := ps_threads()) <= before, (
-        f"PS thread(s) leaked out of the test: {after}")
-
-
-@pytest.fixture(autouse=True)
-def _no_autoscaler_leak():
-    """A leaked autoscaler keeps its control loop scaling a dead fleet —
-    and holds every ReplicaAgent its pool spawned — under every later
-    test. Reap stragglers (close() also stops the pool's spawned
-    handles; defined LAST so this teardown runs before the fleet/
-    telemetry fixtures assert their planes) and assert the
-    `autoscaler-*` threads are quiescent after EVERY test."""
-    import threading
-    import time
-    from paddle_tpu.serving import autoscaler as _autoscaler
-
-    def scaler_threads():
-        return [t.name for t in threading.enumerate()
-                if t.is_alive() and t.name.startswith("autoscaler-")]
-
-    before = len(scaler_threads())
-    yield
-    leaked = [a for a in list(_autoscaler._LIVE)
-              if not getattr(a, "_closed", True)]
-    for a in leaked:
-        try:
-            a.close()
-        except Exception:
-            pass
-    for _ in range(20):  # reaped threads need a beat to exit
-        after = scaler_threads()
-        if len(after) <= before:
-            break
-        time.sleep(0.1)
-    assert not leaked, (
-        f"{len(leaked)} autoscaler(s) leaked out of the test "
-        f"(Autoscaler.close() never reached): "
-        f"{[type(o).__name__ for o in leaked]}")
-    assert len(after := scaler_threads()) <= before, (
-        f"autoscaler thread(s) leaked out of the test: {after}")

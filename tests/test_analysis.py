@@ -860,3 +860,244 @@ class TestSelfLint:
             fs = [f for f in agraph.analyze_jaxpr(j, "lenet")
                   if f.rule != "unused-var"]
             assert fs == [], "\n".join(f.format() for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# level 4: concurrency analysis (lock graph, blocking, thread registry)
+# ---------------------------------------------------------------------------
+
+INVERTED_SRC = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+
+    def one(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass
+
+    def two(self):
+        with self.b_lock:
+            with self.a_lock:
+                pass
+"""
+
+CONSISTENT_SRC = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+
+    def one(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass
+
+    def two(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass
+"""
+
+BLOCKING_SRC = """
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+def tick(q, sock, t):
+    with _LOCK:
+        time.sleep(0.2)
+        q.get()
+        sock.recv(1024)
+        t.join()
+"""
+
+THREAD_SRC = """
+import threading
+
+def spawn():
+    return threading.Thread(target=print, daemon=True)
+"""
+
+
+class TestConcurrencyLint:
+    def _run(self, src):
+        from paddle_tpu.analysis.concurrency import analyze_source
+        return analyze_source(src, "fix.py")
+
+    def test_lock_order_positive_names_both_sites(self):
+        fs = self._run(INVERTED_SRC)
+        assert rules_of(fs) == ["lock-order"]
+        f = fs[0]
+        # the finding sits at one inverting site and its message cites
+        # the OTHER established site with file:line
+        assert {"Pool.one", "Pool.two"} == {f.func} | {
+            m.split(")")[0] for m in f.message.split("(in ")[1:]}
+        assert "fix.py:" in f.message
+        assert "Pool.a_lock" in f.message and "Pool.b_lock" in f.message
+        assert "deadlock" in f.message
+
+    def test_lock_order_clean_on_consistent_order(self):
+        assert self._run(CONSISTENT_SRC) == []
+
+    def test_lock_order_suppressed_at_either_site(self):
+        src = INVERTED_SRC.replace(
+            "        with self.a_lock:\n                pass",
+            "        with self.a_lock:  # tpu-lint: disable=lock-order\n"
+            "                pass")
+        assert "disable=lock-order" in src
+        assert self._run(src) == []
+
+    def test_blocking_under_lock_positive(self):
+        fs = self._run(BLOCKING_SRC)
+        assert rules_of(fs) == ["blocking-under-lock"] * 4
+        reasons = " | ".join(f.message for f in fs)
+        assert "time.sleep(0.2)" in reasons
+        assert "queue .get() with no timeout" in reasons
+        assert "socket .recv()" in reasons
+        assert ".join() with no timeout" in reasons
+        assert all("'_LOCK'" in f.message for f in fs)
+
+    def test_blocking_clean_when_bounded_or_outside(self):
+        src = """
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+def tick(q, t, counters):
+    with _LOCK:
+        time.sleep(0.001)          # under threshold
+        q.get(timeout=1.0)         # bounded
+        t.join(timeout=5.0)        # bounded
+        counters.get()             # not queue-shaped: a dict/Counter get
+    q.get()                        # blocking, but no lock held
+"""
+        assert self._run(src) == []
+
+    def test_rpc_retry_under_lock(self):
+        src = """
+import threading
+
+_LOCK = threading.Lock()
+
+def push(chan):
+    with _LOCK:
+        return chan.call_with_retry(b"PUSH", b"")
+"""
+        fs = self._run(src)
+        assert rules_of(fs) == ["blocking-under-lock"]
+        assert "call_with_retry" in fs[0].message
+
+    def test_unregistered_thread_positive_and_registered_clean(self):
+        fs = self._run(THREAD_SRC)
+        assert rules_of(fs) == ["unregistered-thread"]
+        assert "syncwatch.Thread" in fs[0].message
+        clean = THREAD_SRC.replace("threading.Thread",
+                                   "_syncwatch.Thread")
+        assert self._run(clean) == []
+
+    def test_unregistered_thread_inline_suppression(self):
+        src = THREAD_SRC.replace(
+            "target=print, daemon=True)",
+            "target=print, daemon=True)  "
+            "# tpu-lint: disable=unregistered-thread")
+        assert self._run(src) == []
+
+    def test_acquire_release_tracked_like_with(self):
+        src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mu = threading.Lock()
+
+    def fwd(self):
+        self._lock.acquire()
+        with self._mu:
+            pass
+        self._lock.release()
+
+    def rev(self):
+        with self._mu:
+            self._lock.acquire()
+            self._lock.release()
+"""
+        fs = self._run(src)
+        assert rules_of(fs) == ["lock-order"]
+
+    def test_one_level_call_inlining_carries_held_set(self):
+        src = """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        time.sleep(1.0)
+"""
+        fs = self._run(src)
+        assert rules_of(fs) == ["blocking-under-lock"]
+        assert "(called holding C._lock)" in fs[0].func
+
+    def test_rules_registered_and_listed(self, capsys):
+        from paddle_tpu.analysis.base import RULES
+        for rule in ("lock-order", "blocking-under-lock",
+                     "unregistered-thread"):
+            assert rule in RULES
+        assert lint_cli.main(["--list-rules", "x"]) == 0
+        out = capsys.readouterr().out
+        assert "lock-order" in out and "unregistered-thread" in out
+
+    def test_cli_reports_and_no_concurrency_disables(self, tmp_path,
+                                                     capsys):
+        p = tmp_path / "pool.py"
+        p.write_text(INVERTED_SRC)
+        assert lint_cli.main([str(p)]) == 1
+        assert "lock-order" in capsys.readouterr().out
+        assert lint_cli.main([str(p), "--no-concurrency"]) == 0
+
+    def test_lazy_exports(self):
+        assert analysis.analyze_concurrency is not None
+        assert analysis.lock_graph is not None
+
+    def test_concurrency_pass_attaches_findings(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static.passes import list_passes
+        from paddle_tpu.static.program import Program
+        assert "concurrency" in list_passes()
+        prog = Program.from_callable(
+            lambda x: x + 1.0, [jax.ShapeDtypeStruct((4,), jnp.float32)])
+        out = prog.apply_pass("concurrency", fail_on="error")
+        assert out.concurrency_findings == []
+
+
+class TestConcurrencySelfGate:
+    def test_repo_lock_graph_is_cycle_free_and_lint_clean(self):
+        """THE tier-1 gate (ISSUE 20): the shipped package's own static
+        lock graph has no cycles and zero concurrency findings — a future
+        PR nesting locks inconsistently, blocking under a lock, or
+        spawning a raw thread fails HERE, before any soak can wedge."""
+        from paddle_tpu.analysis.concurrency import (analyze_paths,
+                                                     find_cycles)
+        findings, n_files, sites = analyze_paths([PKG])
+        assert n_files > 150
+        assert findings == [], "\n".join(f.format() for f in findings)
+        assert find_cycles(sites) == []
+        # the graph is genuinely populated (the PS durability hierarchy),
+        # so an AST regression that stops SEEING locks also fails
+        assert ("PsServer._wal_lock", "PsServer._seq_lock") in sites

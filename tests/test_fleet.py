@@ -670,6 +670,12 @@ class TestChaosSoak:
     def test_sigkill_midburst_with_injected_resets(self, tmp_path,
                                                    fleet_flags,
                                                    monitored):
+        # the whole drill runs under the runtime deadlock sanitizer
+        # (ISSUE 20): every watched lock the router/ledger takes through
+        # kill, failover, and rejoin must keep a consistent order
+        from paddle_tpu.utils import syncwatch
+        _flags.set_flags({"sync_watch": True, "sync_order_fatal": True})
+        syncwatch._reset()
         store = _store()
         fleet = "chaos"
         procs = [_spawn_replica(store, fleet, tmp_path, i)
@@ -756,9 +762,13 @@ class TestChaosSoak:
             c.close()
             assert st == 0
             np.testing.assert_allclose(out[0], 2.0)
+            # sanitizer verdict on the whole drill: zero order violations
+            assert syncwatch.violations() == 0
         finally:
             stop_burst.set()
             router.close()
+            _flags.set_flags({"sync_watch": False})
+            syncwatch._reset()
             for rec in procs:
                 p = rec[0]
                 if p.poll() is None:

@@ -292,7 +292,7 @@ class TestOOMForensics:
         assert "[flight recorder:" in str(errs[0])
         assert getattr(errs[1], "dump_path", None) is None  # rate-limited
         doc = _latest_dump(errs[0])
-        assert doc["schema"] == "paddle_tpu.flight_recorder/4"
+        assert doc["schema"] == "paddle_tpu.flight_recorder/5"
         assert doc["reason"] == "oom"
         mem = doc["extra"]["memory"]
         top = mem["top_buffers"]
@@ -367,17 +367,35 @@ class TestOOMForensics:
             hoard.clear()
 
 
-# ---- dump schema v4 + v1/v2/v3 back-compat ----------------------------------
+# ---- dump schema v5 + v1..v4 back-compat ------------------------------------
 
 class TestDumpSchema:
-    def test_v4_dump_always_carries_memory_section(self, with_mem, tmp_path):
+    def test_v5_dump_always_carries_memory_section(self, with_mem, tmp_path):
         path = obs.dump(str(tmp_path / "manual.json"), reason="manual")
         doc = json.load(open(path))
-        assert doc["schema"] == "paddle_tpu.flight_recorder/4"
+        assert doc["schema"] == "paddle_tpu.flight_recorder/5"
         assert "census" in doc["memory"] and "phase_peaks" in doc["memory"]
         assert "traces" in doc and "slo" in doc   # v3 sections always present
+        # /5 sync section is always present; inert without FLAGS_sync_watch
+        assert doc["sync"]["enabled"] is False
         # /4 incident fields are OPTIONAL: absent on a plain local dump
         assert "incident_id" not in doc and "source" not in doc
+
+    def test_v4_fixture_still_renders(self, capsys):
+        """Back-compat gate: a checked-in /4 artifact (incident fields, no
+        sync section) must render through `show`, `mem`, and `threads` —
+        generated by the pre-/5 code before the schema bump."""
+        from paddle_tpu.monitor import _main, _is_flight_dump
+        path = os.path.join(FIXTURES, "flightrec_v4.json")
+        doc = json.load(open(path))
+        assert doc["schema"] == "paddle_tpu.flight_recorder/4"
+        assert _is_flight_dump(doc)
+        assert _main(["show", path]) == 0
+        assert _main(["mem", path]) == 0
+        assert _main(["threads", path]) == 0
+        out = capsys.readouterr().out
+        assert "flight recorder dump" in out
+        assert "no sync section" in out   # /5 section stays absent on /4
 
     def test_v4_incident_fields_round_trip(self, with_mem, tmp_path):
         from paddle_tpu.monitor import _render_flight_dump

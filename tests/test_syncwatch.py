@@ -1,0 +1,375 @@
+"""Runtime concurrency sanitizer + thread registry (utils/syncwatch.py).
+
+Acceptance properties (ISSUE 20): the seeded two-thread A/B inversion is
+reported by the sanitizer with BOTH acquisition stacks BEFORE the test
+wedges; the disabled path hands out plain threading locks behind one
+module-attribute check (PR-1-style overhead guard); hold times feed the
+`sync.lock_hold_ms` histogram and over-threshold holds warn with the
+acquisition stack; the registry names every framework thread's owner
+module + spawn stack for the unified `_no_thread_leak` fixture and the
+`python -m paddle_tpu.monitor threads` CLI; flight-recorder dumps carry
+the schema-/5 `sync` section; the fleet SequenceLedger regression (the
+monitor count moved outside the ledger critical section) stays fixed.
+"""
+import json
+import threading
+import time
+import warnings
+
+import pytest
+
+from paddle_tpu import monitor, obs
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.utils import syncwatch
+
+
+# ---- fixtures ---------------------------------------------------------------
+
+@pytest.fixture()
+def sync_on():
+    """Sanitizer armed on a clean order graph; always disarm + wipe."""
+    _flags.set_flags({"sync_watch": True, "sync_order_fatal": True})
+    syncwatch._reset()
+    yield
+    _flags.set_flags({"sync_watch": False, "sync_order_fatal": True,
+                      "sync_hold_warn_ms": 0.0})
+    syncwatch._reset()
+
+
+@pytest.fixture()
+def with_monitor():
+    _flags.set_flags({"monitor": True})
+    monitor.reset()
+    yield
+    monitor.reset()
+    _flags.set_flags({"monitor": False})
+
+
+# ---- thread registry (always on) --------------------------------------------
+
+class TestRegistry:
+    def test_thread_registers_owner_and_spawn_stack(self):
+        done = threading.Event()
+        t = syncwatch.Thread(target=done.wait, args=(5.0,),
+                             name="sw-reg-probe", daemon=True)
+        t.start()
+        try:
+            rows = [r for r in syncwatch.live_threads()
+                    if r["name"] == "sw-reg-probe"]
+            assert len(rows) == 1
+            row = rows[0]
+            # owner inferred from the spawning frame's module
+            assert row["owner"] == __name__
+            assert "test_syncwatch" in row["spawned"]
+            assert row["age_s"] >= 0.0 and row["daemon"] is True
+        finally:
+            done.set()
+            t.join(timeout=5)
+        assert not [r for r in syncwatch.live_threads()
+                    if r["name"] == "sw-reg-probe"]
+
+    def test_explicit_owner_wins(self):
+        done = threading.Event()
+        t = syncwatch.Thread(target=done.wait, args=(5.0,),
+                             name="sw-owner-probe", owner="my.plane",
+                             daemon=True)
+        t.start()
+        try:
+            row = [r for r in syncwatch.live_threads()
+                   if r["name"] == "sw-owner-probe"][0]
+            assert row["owner"] == "my.plane"
+        finally:
+            done.set()
+            t.join(timeout=5)
+
+    def test_framework_planes_spawn_registered_threads(self):
+        """The 17 migrated modules all hand out registry-visible threads
+        — spot-check one per layer through its public spawn path."""
+        from paddle_tpu.guard.watchdog import StepWatchdog
+        wd = StepWatchdog(timeout_s=30.0)
+        try:
+            assert wd.run(lambda: 42) == 42     # spawns the runner thread
+            owners = {r["owner"] for r in syncwatch.live_threads()}
+            assert "paddle_tpu.guard.watchdog" in owners
+        finally:
+            wd.close()
+
+
+# ---- factory gating ---------------------------------------------------------
+
+class TestFactory:
+    def test_disabled_returns_plain_locks(self):
+        assert syncwatch._ENABLED is False
+        assert type(syncwatch.lock("x")) is type(threading.Lock())
+        assert type(syncwatch.rlock("x")) is type(threading.RLock())
+
+    def test_enabled_returns_watched_locks(self, sync_on):
+        lk = syncwatch.lock("plane.A")
+        assert isinstance(lk, syncwatch._WatchedLock)
+        assert "plane.A" in repr(lk)
+        with lk:
+            assert lk.locked()
+        assert not lk.locked()
+
+    def test_rlock_reentry_is_not_a_violation(self, sync_on):
+        lk = syncwatch.rlock("plane.R")
+        with lk:
+            with lk:                      # outermost-only bookkeeping
+                pass
+        assert syncwatch.violations() == 0
+
+    def test_disabled_gate_is_one_attribute_check(self):
+        """PR-1 overhead-guard contract: FLAGS_sync_watch off, handing
+        out a lock costs the plain constructor plus ONE module-attribute
+        check — no wrapper, no bookkeeping."""
+        assert syncwatch._ENABLED is False
+        n = 20000
+        syncwatch.lock("warm"), threading.Lock()       # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            syncwatch.lock("guard")
+        t_gate = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            threading.Lock()
+        t_base = time.perf_counter() - t0
+        # generous: anything near this bound means the disabled path grew
+        # a lookup/allocation (same guard style as faults/monitor/lint)
+        assert t_gate < t_base + 0.05
+
+
+# ---- lock-order sanitizer ---------------------------------------------------
+
+class TestSanitizer:
+    def test_nested_acquire_records_edge(self, sync_on):
+        a, b = syncwatch.lock("t.A"), syncwatch.lock("t.B")
+        with a:
+            with b:
+                pass
+        assert syncwatch.order_edges() == {"t.A": ["t.B"]}
+        assert syncwatch.violations() == 0
+
+    def test_same_name_locks_never_form_an_edge(self, sync_on):
+        """Per-shard locks share one name: ascending-order same-class
+        acquisition is the caller's protocol, not an edge."""
+        shard0, shard1 = (syncwatch.lock("ps.client._locks[]")
+                          for _ in range(2))
+        with shard0:
+            with shard1:
+                pass
+        assert syncwatch.order_edges() == {}
+
+    def test_seeded_deadlock_names_both_stacks_before_wedging(
+            self, sync_on):
+        """THE acceptance drill: two threads acquire A/B in inverted
+        order, sequenced so both first-locks are held concurrently (the
+        canonical deadlock setup). The second thread's inverting
+        acquisition raises SyncOrderError naming the cycle and BOTH
+        stacks BEFORE it blocks — so the test joins instead of wedging."""
+        a, b = syncwatch.lock("seed.A"), syncwatch.lock("seed.B")
+        errors, t2_done = [], threading.Event()
+
+        def t1_fn():
+            with a:                       # 1. t1 holds A
+                holding_a.set()
+                b_held.wait(5.0)          # 3. wait until t2 holds B
+                with b:                   # 4. records A->B, then blocks
+                    pass                  # 7. unblocked after t2 releases
+
+        def t2_fn():
+            holding_a.wait(5.0)           # 2. wait until t1 holds A
+            with b:
+                b_held.set()
+                # 5. wait until t1 RECORDED the A->B edge (it records
+                # before blocking on the real lock, so this converges)
+                deadline = time.monotonic() + 5.0
+                while "seed.A" not in syncwatch.order_edges():
+                    assert time.monotonic() < deadline
+                    time.sleep(0.005)
+                try:
+                    with a:               # 6. inversion: raises, no block
+                        pass
+                except syncwatch.SyncOrderError as e:
+                    errors.append(e)
+            t2_done.set()
+
+        holding_a, b_held = threading.Event(), threading.Event()
+        t1 = syncwatch.Thread(target=t1_fn, name="seed-t1", daemon=True)
+        t2 = syncwatch.Thread(target=t2_fn, name="seed-t2", daemon=True)
+        t1.start(), t2.start()
+        assert t2_done.wait(10.0), "sanitizer failed: the drill wedged"
+        t1.join(timeout=10), t2.join(timeout=10)
+        assert not t1.is_alive() and not t2.is_alive()
+        assert len(errors) == 1
+        e = errors[0]
+        assert e.cycle == ["seed.A", "seed.B"]
+        msg = str(e)
+        # both stacks, named: the inverting acquisition and the
+        # first-observed established edge
+        assert "this acquisition" in msg and "first observed" in msg
+        assert msg.count("test_syncwatch") >= 2
+        assert "'seed-t2'" in msg and "'seed-t1'" in msg
+        assert syncwatch.violations() == 1
+
+    def test_nonfatal_downgrades_to_warning_and_counter(
+            self, sync_on, with_monitor):
+        _flags.set_flags({"sync_order_fatal": False})
+        a, b = syncwatch.lock("soak.A"), syncwatch.lock("soak.B")
+        with a:
+            with b:
+                pass
+        with pytest.warns(UserWarning, match="lock-order cycle"):
+            with b:
+                with a:
+                    pass
+        assert syncwatch.violations() == 1
+        assert monitor.snapshot()["counters"]["sync.order_violations"] == 1
+
+    def test_hold_histogram_and_over_threshold_warning(
+            self, sync_on, with_monitor):
+        _flags.set_flags({"sync_hold_warn_ms": 1.0})
+        lk = syncwatch.lock("hold.L")
+        with pytest.warns(UserWarning, match="hold.L.*held"):
+            with lk:
+                time.sleep(0.01)
+        snap = monitor.snapshot()
+        hist = snap["histograms"]["sync.lock_hold_ms"]
+        assert hist["count"] >= 1 and hist["max"] >= 1.0
+        assert snap["counters"]["sync.hold_warns"] == 1
+
+    def test_fast_hold_feeds_histogram_silently(self, sync_on,
+                                                with_monitor):
+        lk = syncwatch.lock("hold.fast")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            with lk:
+                pass
+        assert monitor.snapshot()["histograms"][
+            "sync.lock_hold_ms"]["count"] == 1
+
+
+# ---- dogfood regression: fleet SequenceLedger -------------------------------
+
+class TestFleetSettleRegression:
+    def test_settle_counts_duplicates_outside_the_ledger_lock(
+            self, sync_on, with_monitor, monkeypatch):
+        """The dogfood fix: `fleet.duplicates_dropped` must be counted
+        AFTER the ledger lock is released — nesting the monitor registry
+        lock under the request-hot-path ledger lock is exactly the
+        pattern the sanitizer exists to kill. Driven by the sanitizer's
+        own held-set bookkeeping: capture what the calling thread holds
+        at every monitor.count() call."""
+        from paddle_tpu.serving.fleet import SequenceLedger
+        held_at_count = []
+        real_count = monitor.count
+
+        def spying_count(name, delta=1):
+            holds = syncwatch._HELD.get(threading.get_ident(), [])
+            held_at_count.append((name, [h[0] for h in holds]))
+            return real_count(name, delta)
+
+        monkeypatch.setattr(monitor, "count", spying_count)
+        led = SequenceLedger()              # watched lock: sync_on is set
+        assert isinstance(led._lock, syncwatch._WatchedLock)
+        seq = led.next_seq()
+        assert led.settle(seq, replica_id=0) is True
+        assert led.settle(seq, replica_id=1) is False    # duplicate
+        dup_counts = [h for n, h in held_at_count
+                      if n == "fleet.duplicates_dropped"]
+        assert dup_counts, "duplicate was not counted at all"
+        for holds in dup_counts:
+            assert "fleet.SequenceLedger._lock" not in holds
+        assert monitor.snapshot()["counters"][
+            "fleet.duplicates_dropped"] == 1
+
+
+# ---- flight-recorder /5 sync section + threads CLI --------------------------
+
+class TestDumpAndCLI:
+    def test_dump_sync_shape(self, sync_on):
+        a, b = syncwatch.lock("d.A"), syncwatch.lock("d.B")
+        with a:
+            with b:
+                pass
+        doc = syncwatch.dump_sync()
+        assert doc["enabled"] is True and doc["violations"] == 0
+        assert {"src": "d.A", "dst": "d.B", "count": 1,
+                "thread": "MainThread"} in doc["lock_order"]
+        assert json.dumps(doc)              # JSON-serializable end to end
+
+    def test_flight_dump_carries_sync_section(self, sync_on, tmp_path):
+        _flags.set_flags({"obs_flight_recorder": True,
+                          "obs_dump_dir": str(tmp_path),
+                          "obs_dump_min_interval_s": 0.0})
+        obs.reset()
+        try:
+            with syncwatch.lock("fr.A"):
+                with syncwatch.lock("fr.B"):
+                    pass
+            path = obs.dump(str(tmp_path / "sync.json"), reason="manual")
+            doc = json.load(open(path))
+            assert doc["schema"] == "paddle_tpu.flight_recorder/5"
+            assert doc["sync"]["enabled"] is True
+            assert [e for e in doc["sync"]["lock_order"]
+                    if e["src"] == "fr.A" and e["dst"] == "fr.B"]
+        finally:
+            _flags.set_flags({"obs_flight_recorder": False,
+                              "obs_dump_dir": "flight_recorder",
+                              "obs_dump_min_interval_s": 30.0})
+            obs.reset()
+
+    def test_threads_cli_live_and_dump(self, sync_on, tmp_path, capsys):
+        from paddle_tpu.monitor import _main
+        done = threading.Event()
+        lk = syncwatch.lock("cli.L")
+
+        def holder():
+            with lk:
+                entered.set()
+                done.wait(10.0)
+
+        entered = threading.Event()
+        t = syncwatch.Thread(target=holder, name="cli-holder",
+                             daemon=True)
+        t.start()
+        try:
+            assert entered.wait(5.0)
+            assert _main(["threads"]) == 0
+            out = capsys.readouterr().out
+            assert "cli-holder" in out and __name__ in out
+            assert "cli.L" in out
+            # dump path: render the artifact's sync section
+            doc = {"schema": "paddle_tpu.flight_recorder/5",
+                   "sync": syncwatch.dump_sync()}
+            p = tmp_path / "d.json"
+            p.write_text(json.dumps(doc))
+            assert _main(["threads", str(p)]) == 0
+            assert "cli-holder" in capsys.readouterr().out
+        finally:
+            done.set()
+            t.join(timeout=5)
+
+    def test_threads_cli_dumps_stuck_stack_over_threshold(
+            self, sync_on, capsys):
+        from paddle_tpu.monitor import _main
+        done, entered = threading.Event(), threading.Event()
+        lk = syncwatch.lock("stuck.L")
+
+        def holder():
+            with lk:
+                entered.set()
+                done.wait(10.0)
+
+        t = syncwatch.Thread(target=holder, name="stuck-holder",
+                             daemon=True)
+        t.start()
+        try:
+            assert entered.wait(5.0)
+            time.sleep(0.02)
+            assert _main(["threads", "--hold-warn-ms", "1"]) == 0
+            out = capsys.readouterr().out
+            assert "holding 'stuck.L'" in out
+            assert "acquired at:" in out and "test_syncwatch" in out
+        finally:
+            done.set()
+            t.join(timeout=5)

@@ -459,7 +459,7 @@ class TestCorrelatedIncident:
                 "replica-0", "replica-1", "replica-2"]
             docs = [json.load(open(d["path"])) for d in inc["dumps"]]
             assert {d["incident_id"] for d in docs} == {iid}
-            assert all(d["schema"] == "paddle_tpu.flight_recorder/4"
+            assert all(d["schema"] == "paddle_tpu.flight_recorder/5"
                        for d in docs)
             # a second error inside the rate-limit window does NOT storm
             obs.recorder()._last_dump.clear()   # un-rate-limit the LOCAL dump

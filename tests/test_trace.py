@@ -363,7 +363,7 @@ class TestDumpAndCli:
         trace.span("doomed").end(status=trace.STATUS_DEADLINE)
         path = obs.dump(str(tmp_path / "d.json"), reason="manual")
         doc = json.load(open(path))
-        assert doc["schema"] == "paddle_tpu.flight_recorder/4"
+        assert doc["schema"] == "paddle_tpu.flight_recorder/5"
         assert len(doc["traces"]["kept"]) == 1
         assert _main(["show", path]) == 0
         out_trace = str(tmp_path / "d.trace.json")
@@ -425,7 +425,7 @@ class TestCrossProcessE2E:
 
         # the server-side half, out of the child's flight recorder
         doc = json.load(open(dump_path))
-        assert doc["schema"] == "paddle_tpu.flight_recorder/4"
+        assert doc["schema"] == "paddle_tpu.flight_recorder/5"
         ring = doc["traces"]["ring"] + doc["traces"]["kept"]
         server_docs = [d for d in ring if d["trace_id"] == tid]
         assert len(server_docs) == 1, (
